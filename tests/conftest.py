@@ -1,0 +1,14 @@
+"""Test configuration: force a virtual 8-device CPU mesh before jax imports.
+
+Multi-chip sharding paths are exercised on CPU via
+``--xla_force_host_platform_device_count`` (real TPU hardware in CI has one
+chip; the driver separately dry-runs the multi-chip path).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

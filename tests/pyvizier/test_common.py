@@ -1,0 +1,110 @@
+"""Tests for namespaced metadata."""
+
+import pytest
+
+from vizier_tpu.pyvizier import common
+
+
+class TestNamespace:
+    def test_empty(self):
+        ns = common.Namespace()
+        assert len(ns) == 0
+        assert ns.encode() == ""
+        assert common.Namespace.decode("") == ns
+
+    def test_roundtrip_simple(self):
+        ns = common.Namespace(("a", "b", "c"))
+        assert common.Namespace.decode(ns.encode()) == ns
+
+    @pytest.mark.parametrize(
+        "components",
+        [
+            ("a:b",),
+            ("a\\", "b"),
+            ("a:b", "c\\:d"),
+            ("", "x"),
+            (":", "\\"),
+        ],
+    )
+    def test_roundtrip_escaping(self, components):
+        ns = common.Namespace(components)
+        assert tuple(common.Namespace.decode(ns.encode())) == components
+
+    def test_single_string_is_one_component(self):
+        assert tuple(common.Namespace("abc")) == ("abc",)
+
+    def test_encoded_string_decodes(self):
+        assert tuple(common.Namespace(":a:b")) == ("a", "b")
+
+    def test_add(self):
+        ns = common.Namespace(("a",)) + ("b",)
+        assert tuple(ns) == ("a", "b")
+
+    def test_startswith(self):
+        ns = common.Namespace(("a", "b", "c"))
+        assert ns.startswith(("a", "b"))
+        assert not ns.startswith(("b",))
+
+    def test_ancestors(self):
+        ns = common.Namespace(("a", "b"))
+        assert [tuple(a) for a in ns.ancestors()] == [(), ("a",), ("a", "b")]
+
+
+class TestMetadata:
+    def test_root_store(self):
+        md = common.Metadata()
+        md["k"] = "v"
+        assert md["k"] == "v"
+        assert "k" in md
+        assert len(md) == 1
+
+    def test_init_kwargs(self):
+        md = common.Metadata({"a": "1"}, b="2")
+        assert md["a"] == "1"
+        assert md["b"] == "2"
+
+    def test_ns_isolation(self):
+        md = common.Metadata()
+        md["k"] = "root"
+        md.ns("sub")["k"] = "sub"
+        assert md["k"] == "root"
+        assert md.ns("sub")["k"] == "sub"
+        assert md.abs_ns(common.Namespace(("sub",)))["k"] == "sub"
+
+    def test_nested_ns(self):
+        md = common.Metadata()
+        md.ns("a").ns("b")["k"] = "v"
+        assert md.abs_ns(common.Namespace(("a", "b")))["k"] == "v"
+        assert ("a", "b") in [tuple(n) for n in md.namespaces()]
+
+    def test_value_types(self):
+        md = common.Metadata()
+        md["s"] = "str"
+        md["f"] = 1.5
+        md["b"] = b"bytes"
+        assert md["f"] == 1.5
+        assert md["b"] == b"bytes"
+
+    def test_attach_merge(self):
+        a = common.Metadata()
+        a.ns("x")["k"] = "a"
+        b = common.Metadata()
+        b.ns("x")["k"] = "b"
+        b.ns("y")["j"] = "c"
+        a.attach(b)
+        assert a.ns("x")["k"] == "b"
+        assert a.ns("y")["j"] == "c"
+
+    def test_eq_ignores_empty_namespaces(self):
+        a = common.Metadata()
+        a.ns("x")  # creates nothing
+        b = common.Metadata()
+        assert a == b
+
+    def test_subnamespaces(self):
+        md = common.Metadata()
+        md.ns("a").ns("b")["k"] = "v"
+        md.ns("a")["k"] = "v"
+        md.ns("c")["k"] = "v"
+        subs = {tuple(n) for n in md.subnamespaces(("a",))}
+        assert subs == {("a",), ("a", "b")}

@@ -1,0 +1,171 @@
+"""Tests for parameter configs and search spaces."""
+
+import pytest
+
+from vizier_tpu import pyvizier as vz
+
+
+class TestParameterConfigFactory:
+    def test_double(self):
+        c = vz.ParameterConfig.factory("x", bounds=(0.0, 1.0))
+        assert c.type == vz.ParameterType.DOUBLE
+        assert c.bounds == (0.0, 1.0)
+        assert c.num_feasible_values == float("inf")
+        assert c.contains(0.5)
+        assert not c.contains(1.5)
+        assert not c.contains("a")
+
+    def test_integer(self):
+        c = vz.ParameterConfig.factory("n", bounds=(1, 5))
+        assert c.type == vz.ParameterType.INTEGER
+        assert c.num_feasible_values == 5
+        assert c.feasible_values == [1, 2, 3, 4, 5]
+        assert c.contains(3)
+        assert c.contains(3.0)
+        assert not c.contains(3.5)
+        assert not c.contains(0)
+
+    def test_discrete(self):
+        c = vz.ParameterConfig.factory("d", feasible_values=[3, 1, 2])
+        assert c.type == vz.ParameterType.DISCRETE
+        assert c.feasible_values == [1.0, 2.0, 3.0]
+        assert c.bounds == (1.0, 3.0)
+        assert c.contains(2)
+        assert not c.contains(2.5)
+
+    def test_categorical(self):
+        c = vz.ParameterConfig.factory("c", feasible_values=["b", "a"])
+        assert c.type == vz.ParameterType.CATEGORICAL
+        assert c.feasible_values == ["a", "b"]
+        assert c.contains("a")
+        assert not c.contains("z")
+        assert not c.contains(1)
+
+    def test_both_bounds_and_values_rejected(self):
+        with pytest.raises(ValueError):
+            vz.ParameterConfig.factory("x", bounds=(0, 1), feasible_values=[1, 2])
+
+    def test_bad_bounds(self):
+        with pytest.raises(ValueError):
+            vz.ParameterConfig.factory("x", bounds=(2.0, 1.0))
+
+    def test_log_scale_positive_bounds(self):
+        with pytest.raises(ValueError):
+            vz.ParameterConfig.factory("x", bounds=(0.0, 1.0), scale_type=vz.ScaleType.LOG)
+
+    def test_default_value_validated(self):
+        with pytest.raises(ValueError):
+            vz.ParameterConfig.factory("x", bounds=(0.0, 1.0), default_value=2.0)
+
+    def test_mixed_feasible_values_rejected(self):
+        with pytest.raises(ValueError):
+            vz.ParameterConfig.factory("x", feasible_values=["a", 1])
+
+    def test_duplicate_feasible_values_rejected(self):
+        with pytest.raises(ValueError):
+            vz.ParameterConfig.factory("x", feasible_values=[1, 1, 2])
+
+    def test_continuify(self):
+        c = vz.ParameterConfig.factory("n", bounds=(1, 5)).continuify()
+        assert c.type == vz.ParameterType.DOUBLE
+        assert c.bounds == (1.0, 5.0)
+        with pytest.raises(ValueError):
+            vz.ParameterConfig.factory("c", feasible_values=["a"]).continuify()
+
+
+class TestSearchSpaceBuilders:
+    def test_flat_space(self):
+        space = vz.SearchSpace()
+        root = space.root
+        root.add_float_param("lr", 1e-4, 1e-1, scale_type=vz.ScaleType.LOG)
+        root.add_int_param("layers", 1, 8)
+        root.add_discrete_param("batch", [32, 64, 128])
+        root.add_categorical_param("opt", ["adam", "sgd"])
+        root.add_bool_param("use_bn")
+        assert space.parameter_names() == ["lr", "layers", "batch", "opt", "use_bn"]
+        assert space.num_parameters() == 5
+        assert space.num_parameters(vz.ParameterType.DOUBLE) == 1
+        assert space.get("batch").external_type == vz.ExternalType.INTEGER
+        assert space.get("use_bn").external_type == vz.ExternalType.BOOLEAN
+        assert not space.is_conditional
+
+    def test_duplicate_name_rejected(self):
+        space = vz.SearchSpace()
+        space.root.add_float_param("x", 0, 1)
+        with pytest.raises(ValueError):
+            space.root.add_float_param("x", 0, 1)
+
+    def test_conditional_children(self):
+        space = vz.SearchSpace()
+        model = space.root.add_categorical_param("model", ["linear", "dnn"])
+        dnn = model.select_values(["dnn"])
+        dnn.add_float_param("hidden_lr", 1e-5, 1e-2, scale_type=vz.ScaleType.LOG)
+        assert space.is_conditional
+        assert "hidden_lr" in space
+        cfg = space.get("model")
+        assert len(cfg.children) == 1
+        assert cfg.children[0].matching_parent_values == ("dnn",)
+
+    def test_nested_conditional(self):
+        space = vz.SearchSpace()
+        a = space.root.add_categorical_param("a", ["x", "y"])
+        b = a.select_values(["x"]).add_categorical_param("b", ["p", "q"])
+        b.select_values(["p"]).add_float_param("c", 0.0, 1.0)
+        names = space.parameter_names()
+        assert names == ["a", "b", "c"]
+        assert len(space.get("a").children) == 1
+        assert len(space.get("a").children[0].children) == 1
+
+    def test_conditional_requires_selected_values(self):
+        space = vz.SearchSpace()
+        sel = space.root.add_categorical_param("a", ["x", "y"])
+        with pytest.raises(ValueError):
+            sel.add_float_param("child", 0.0, 1.0)
+
+
+class TestSearchSpaceContains:
+    @pytest.fixture
+    def space(self):
+        s = vz.SearchSpace()
+        root = s.root
+        root.add_float_param("x", 0.0, 1.0)
+        model = root.add_categorical_param("model", ["linear", "dnn"])
+        model.select_values(["dnn"]).add_int_param("depth", 1, 4)
+        return s
+
+    def test_valid_flat(self, space):
+        assert space.contains({"x": 0.5, "model": "linear"})
+
+    def test_valid_conditional(self, space):
+        assert space.contains({"x": 0.5, "model": "dnn", "depth": 2})
+
+    def test_missing_active_child(self, space):
+        assert not space.contains({"x": 0.5, "model": "dnn"})
+
+    def test_inactive_child_assigned(self, space):
+        assert not space.contains({"x": 0.5, "model": "linear", "depth": 2})
+
+    def test_unknown_param(self, space):
+        assert not space.contains({"x": 0.5, "model": "linear", "zzz": 1})
+
+    def test_infeasible_value(self, space):
+        assert not space.contains({"x": 5.0, "model": "linear"})
+
+    def test_parameter_value_objects(self, space):
+        params = vz.ParameterDict({"x": 0.5, "model": "linear"})
+        assert space.contains(params)
+
+
+class TestReviewRegressions:
+    """Regressions from the initial code review."""
+
+    def test_continuify_parent_raises(self):
+        s = vz.SearchSpace()
+        sel = s.root.add_discrete_param("d", [1, 2, 3])
+        sel.select_values([1]).add_float_param("x", 0, 1)
+        with pytest.raises(ValueError, match="parent"):
+            s.get("d").continuify()
+
+    def test_discrete_log_scale_positivity(self):
+        with pytest.raises(ValueError, match="positive"):
+            vz.ParameterConfig.factory("d", feasible_values=[0, 1, 10], scale_type=vz.ScaleType.LOG)

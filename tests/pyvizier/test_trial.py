@@ -1,0 +1,191 @@
+"""Tests for trials, measurements and parameter values."""
+
+import math
+
+import pytest
+
+from vizier_tpu import pyvizier as vz
+
+
+class TestParameterValue:
+    def test_casts(self):
+        assert vz.ParameterValue(3).as_float == 3.0
+        assert vz.ParameterValue(3.0).as_int == 3
+        assert vz.ParameterValue(True).as_str == "True"
+        assert vz.ParameterValue("true").as_bool is True
+        assert vz.ParameterValue("False").as_bool is False
+        assert vz.ParameterValue(1).as_bool is True
+
+    def test_bad_casts(self):
+        with pytest.raises(ValueError):
+            vz.ParameterValue(3.5).as_int
+        with pytest.raises(ValueError):
+            vz.ParameterValue("xyz").as_bool
+
+    def test_type_check(self):
+        with pytest.raises(TypeError):
+            vz.ParameterValue([1, 2])  # type: ignore
+
+
+class TestParameterDict:
+    def test_wraps_raw(self):
+        d = vz.ParameterDict({"a": 1})
+        assert isinstance(d["a"], vz.ParameterValue)
+        assert d.get_value("a") == 1
+        assert d.as_dict() == {"a": 1}
+
+    def test_eq_with_mapping(self):
+        assert vz.ParameterDict({"a": 1}) == {"a": 1}
+
+
+class TestMeasurement:
+    def test_numbers_coerced(self):
+        m = vz.Measurement(metrics={"loss": 0.5})
+        assert m.metrics["loss"] == vz.Metric(0.5)
+
+    def test_negative_elapsed_rejected(self):
+        with pytest.raises(ValueError):
+            vz.Measurement(elapsed_secs=-1.0)
+
+
+class TestTrialLifecycle:
+    def test_active_by_default(self):
+        t = vz.Trial(id=1, parameters={"x": 1.0})
+        assert t.status == vz.TrialStatus.ACTIVE
+        assert not t.is_completed
+
+    def test_requested(self):
+        t = vz.Trial(id=1, is_requested=True)
+        assert t.status == vz.TrialStatus.REQUESTED
+
+    def test_complete_with_measurement(self):
+        t = vz.Trial(id=1)
+        t.complete(vz.Measurement(metrics={"obj": 1.0}))
+        assert t.status == vz.TrialStatus.COMPLETED
+        assert t.final_measurement.metrics["obj"].value == 1.0
+        assert not t.infeasible
+        assert t.duration is not None
+
+    def test_complete_promotes_last_intermediate(self):
+        t = vz.Trial(id=1)
+        t.measurements.append(vz.Measurement(metrics={"obj": 1.0}, steps=1))
+        t.measurements.append(vz.Measurement(metrics={"obj": 2.0}, steps=2))
+        t.complete()
+        assert t.final_measurement.metrics["obj"].value == 2.0
+
+    def test_complete_empty_is_infeasible(self):
+        t = vz.Trial(id=1)
+        t.complete()
+        assert t.infeasible
+
+    def test_nan_metric_marks_infeasible(self):
+        t = vz.Trial(id=1)
+        t.complete(vz.Measurement(metrics={"obj": math.nan}))
+        assert t.infeasible
+
+    def test_complete_not_inplace(self):
+        t = vz.Trial(id=1)
+        done = t.complete(vz.Measurement(metrics={"obj": 1.0}), inplace=False)
+        assert not t.is_completed
+        assert done.is_completed
+
+    def test_stop(self):
+        t = vz.Trial(id=1)
+        t.stop("plateau")
+        assert t.status == vz.TrialStatus.STOPPING
+        assert t.stopping_reason == "plateau"
+
+    def test_suggestion_roundtrip(self):
+        s = vz.TrialSuggestion(parameters={"x": 1.0})
+        s.metadata["k"] = "v"
+        t = s.to_trial(7)
+        assert t.id == 7
+        assert t.parameters.get_value("x") == 1.0
+        assert t.to_suggestion().parameters == s.parameters
+
+
+class TestTrialFilter:
+    def _trials(self):
+        a = vz.Trial(id=1)
+        b = vz.Trial(id=2)
+        b.complete(vz.Measurement(metrics={"m": 1.0}))
+        c = vz.Trial(id=3, is_requested=True)
+        return [a, b, c]
+
+    def test_by_status(self):
+        f = vz.TrialFilter(status=[vz.TrialStatus.COMPLETED])
+        assert [t.id for t in filter(f, self._trials())] == [2]
+
+    def test_by_ids_and_min_id(self):
+        f = vz.TrialFilter(ids=[1, 3], min_id=2)
+        assert [t.id for t in filter(f, self._trials())] == [3]
+
+
+class TestContainers:
+    def test_completed_trials_validates(self):
+        t = vz.Trial(id=1)
+        with pytest.raises(ValueError):
+            vz.CompletedTrials([t])
+        t.complete(vz.Measurement(metrics={"m": 1.0}))
+        assert len(vz.CompletedTrials([t]).trials) == 1
+
+    def test_active_trials_validates(self):
+        t = vz.Trial(id=1, is_requested=True)
+        with pytest.raises(ValueError):
+            vz.ActiveTrials([t])
+
+
+class TestMetadataDelta:
+    def test_assign(self):
+        d = vz.MetadataDelta()
+        assert d.empty
+        d.assign("ns", "k", "v")
+        d.assign("ns", "k2", "v2", trial_id=5)
+        assert not d.empty
+        assert d.on_study.abs_ns(vz.Namespace(("ns",)))["k"] == "v"
+        assert d.on_trials[5].abs_ns(vz.Namespace(("ns",)))["k2"] == "v2"
+
+
+class TestStudyConfig:
+    def test_trial_parameters_external_types(self):
+        cfg = vz.StudyConfig()
+        root = cfg.search_space.root
+        root.add_bool_param("flag")
+        root.add_discrete_param("bs", [32, 64])
+        root.add_float_param("lr", 0.0, 1.0)
+        t = vz.Trial(id=1, parameters={"flag": "True", "bs": 64.0, "lr": 0.5})
+        mapped = cfg.trial_parameters(t)
+        assert mapped == {"flag": True, "bs": 64, "lr": 0.5}
+        assert isinstance(mapped["bs"], int)
+
+    def test_from_problem(self):
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0, 1)
+        problem.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        cfg = vz.StudyConfig.from_problem(problem, vz.Algorithm.RANDOM_SEARCH)
+        assert cfg.algorithm == "RANDOM_SEARCH"
+        assert cfg.is_single_objective
+        assert cfg.single_objective_metric_name == "obj"
+
+    def test_metrics_config(self):
+        mc = vz.MetricsConfig([vz.MetricInformation(name="a")])
+        mc.append(vz.MetricInformation(name="safe", safety_threshold=0.5))
+        assert mc.is_single_objective
+        assert mc.is_safety_metric_present
+        assert mc.item().name == "a"
+        with pytest.raises(ValueError):
+            mc.append(vz.MetricInformation(name="a"))
+
+
+class TestReviewRegressions:
+    """Regressions from the initial code review."""
+
+    def test_parameter_dict_eq_bad_mapping_is_false(self):
+        assert (vz.ParameterDict({"x": 1}) == {"x": None}) is False
+
+    def test_stopping_takes_precedence_over_requested(self):
+        t = vz.Trial(id=1, is_requested=True)
+        t.stop("why")
+        assert t.status == vz.TrialStatus.STOPPING

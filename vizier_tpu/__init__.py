@@ -1,0 +1,25 @@
+"""vizier-tpu: a TPU-native black-box optimization (Vizier) framework.
+
+A from-scratch, JAX/XLA-first re-design of the capabilities of OSS Vizier
+(google/vizier): a study/trial service, a Pythia algorithm-hosting protocol,
+and a Gaussian-Process-Bandit suggestion stack whose numerical core runs as
+jit-compiled XLA programs on TPU, sharded over device meshes with
+``jax.sharding`` + ``shard_map``.
+
+Public namespaces (mirroring the reference facade layout,
+``/root/reference/vizier/__init__.py``):
+
+- ``vizier_tpu.pyvizier``   — shared data model (search spaces, trials, ...)
+- ``vizier_tpu.pythia``     — algorithm-hosting protocol (Policy, supporters)
+- ``vizier_tpu.algorithms`` — Designer abstractions + designer→policy wrappers
+- ``vizier_tpu.designers``  — the algorithm zoo (GP bandit, eagle, NSGA-II, ...)
+- ``vizier_tpu.models``     — JAX stochastic-process models (GP kernels, ARD)
+- ``vizier_tpu.ops``        — XLA/Pallas numerical kernels (pareto, distances)
+- ``vizier_tpu.optimizers`` — ARD optimizers + vectorized acquisition optimizers
+- ``vizier_tpu.parallel``   — device-mesh sharding utilities (ICI data plane)
+- ``vizier_tpu.converters`` — trial⇄array converters, padded types
+- ``vizier_tpu.service``    — gRPC/in-process study service, datastores, clients
+- ``vizier_tpu.benchmarks`` — experimenters, runners, convergence analyzers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,80 @@
+"""Public PyVizier facade: the shared data model.
+
+Mirrors the reference facade ``/root/reference/vizier/pyvizier/__init__.py``.
+"""
+
+from vizier_tpu.pyvizier.base_study_config import (
+    MetricInformation,
+    MetricsConfig,
+    ObjectiveMetricGoal,
+    ProblemStatement,
+)
+from vizier_tpu.pyvizier.common import Metadata, MetadataValue, Namespace
+from vizier_tpu.pyvizier.parameter_config import (
+    ExternalType,
+    FidelityConfig,
+    InvalidParameterError,
+    ParameterConfig,
+    ParameterType,
+    ParameterValueTypes,
+    ScaleType,
+    SearchSpace,
+    SearchSpaceSelector,
+)
+from vizier_tpu.pyvizier.study import StudyDescriptor, StudyState, StudyStateInfo
+from vizier_tpu.pyvizier.study_config import (
+    Algorithm,
+    AutomatedStoppingConfig,
+    ObservationNoise,
+    StudyConfig,
+)
+from vizier_tpu.pyvizier.trial import (
+    ActiveTrials,
+    CompletedTrials,
+    Measurement,
+    MetadataDelta,
+    Metric,
+    ParameterDict,
+    ParameterValue,
+    Trial,
+    TrialFilter,
+    TrialStatus,
+    TrialSuggestion,
+)
+
+__all__ = [
+    "ActiveTrials",
+    "Algorithm",
+    "AutomatedStoppingConfig",
+    "CompletedTrials",
+    "ExternalType",
+    "FidelityConfig",
+    "InvalidParameterError",
+    "Measurement",
+    "Metadata",
+    "MetadataDelta",
+    "MetadataValue",
+    "Metric",
+    "MetricInformation",
+    "MetricsConfig",
+    "Namespace",
+    "ObjectiveMetricGoal",
+    "ObservationNoise",
+    "ParameterConfig",
+    "ParameterDict",
+    "ParameterType",
+    "ParameterValue",
+    "ParameterValueTypes",
+    "ProblemStatement",
+    "ScaleType",
+    "SearchSpace",
+    "SearchSpaceSelector",
+    "StudyConfig",
+    "StudyDescriptor",
+    "StudyState",
+    "StudyStateInfo",
+    "Trial",
+    "TrialFilter",
+    "TrialStatus",
+    "TrialSuggestion",
+]

@@ -1,0 +1,33 @@
+"""Pythia-side study types.
+
+Parity with ``/root/reference/vizier/_src/pyvizier/pythia/study.py:25,39``:
+the study lifecycle state and the lightweight descriptor handed to policies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+from vizier_tpu.pyvizier import study_config as sc
+
+
+class StudyState(enum.Enum):
+    ACTIVE = "ACTIVE"
+    ABORTED = "ABORTED"
+    COMPLETED = "COMPLETED"
+
+
+@dataclasses.dataclass(frozen=True)
+class StudyStateInfo:
+    state: StudyState
+    explanation: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class StudyDescriptor:
+    """What a Policy needs to know about a study to make suggestions."""
+
+    config: sc.StudyConfig
+    guid: str = ""
+    max_trial_id: int = 0
