@@ -1,0 +1,288 @@
+"""Tests for trial⇄array converters and padded types."""
+
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu import types
+from vizier_tpu.converters import core as converters
+from vizier_tpu.converters import padding as padding_lib
+
+
+def _problem():
+    p = vz.ProblemStatement()
+    root = p.search_space.root
+    root.add_float_param("lin", 0.0, 10.0)
+    root.add_float_param("log", 1e-4, 1e-1, scale_type=vz.ScaleType.LOG)
+    root.add_int_param("n", 1, 5)
+    root.add_discrete_param("d", [1, 4, 9])
+    root.add_categorical_param("c", ["a", "b", "z"])
+    p.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return p
+
+
+def _trial(i, **params):
+    t = vz.Trial(id=i, parameters=params)
+    return t
+
+
+class TestSearchSpaceEncoder:
+    def test_shapes_and_specs(self):
+        enc = converters.SearchSpaceEncoder(_problem().search_space)
+        assert enc.num_continuous == 4
+        assert enc.num_categorical == 1
+        assert enc.category_sizes == [3]
+        assert enc.onehot_dim == 4 + 3
+
+    def test_encode_ranges(self):
+        enc = converters.SearchSpaceEncoder(_problem().search_space)
+        trials = [
+            _trial(1, lin=0.0, log=1e-4, n=1, d=1, c="a"),
+            _trial(2, lin=10.0, log=1e-1, n=5, d=9, c="z"),
+            _trial(3, lin=5.0, log=1e-2, n=3, d=4, c="b"),
+        ]
+        cont, cat = enc.encode(trials)
+        assert cont.shape == (3, 4)
+        assert cat.shape == (3, 1)
+        np.testing.assert_allclose(cont[0], [0.0, 0.0, 0.0, 0.0], atol=1e-9)
+        np.testing.assert_allclose(cont[1], [1.0, 1.0, 1.0, 1.0], atol=1e-9)
+        # log param: 1e-2 is 2/3 of the way from 1e-4 to 1e-1 in log space.
+        np.testing.assert_allclose(cont[2, 1], 2.0 / 3.0, atol=1e-9)
+        assert list(cat[:, 0]) == [0, 2, 1]
+
+    def test_roundtrip(self):
+        space = _problem().search_space
+        enc = converters.SearchSpaceEncoder(space)
+        trials = [
+            _trial(1, lin=3.3, log=5e-3, n=4, d=9, c="b"),
+            _trial(2, lin=0.1, log=2e-4, n=1, d=1, c="a"),
+        ]
+        cont, cat = enc.encode(trials)
+        decoded = enc.decode(cont, cat)
+        for t, params in zip(trials, decoded):
+            assert space.contains(params)
+            assert params.get_value("n") == t.parameters.get_value("n")
+            assert params.get_value("d") == t.parameters.get_value("d")
+            assert params.get_value("c") == t.parameters.get_value("c")
+            np.testing.assert_allclose(
+                params.get_value("lin"), t.parameters.get_value("lin"), rtol=1e-6
+            )
+            np.testing.assert_allclose(
+                params.get_value("log"), t.parameters.get_value("log"), rtol=1e-5
+            )
+
+    def test_decode_snaps_and_clips(self):
+        enc = converters.SearchSpaceEncoder(_problem().search_space)
+        cont = np.array([[1.7, -0.3, 0.49, 0.4]])
+        cat = np.array([[99]])
+        (params,) = enc.decode(cont, cat)
+        assert params.get_value("lin") == 10.0  # clipped
+        assert params.get_value("log") == pytest.approx(1e-4)
+        assert params.get_value("n") == 3  # 1 + 0.49*4 = 2.96 -> round 3
+        assert params.get_value("d") == 4.0  # nearest feasible to 0.4*8+1=4.2
+        assert params.get_value("c") == "z"  # clipped to last index
+
+    def test_onehot_roundtrip(self):
+        enc = converters.SearchSpaceEncoder(_problem().search_space)
+        trials = [_trial(1, lin=2.0, log=1e-3, n=2, d=4, c="b")]
+        flat = enc.onehot_encode(trials)
+        assert flat.shape == (1, enc.onehot_dim)
+        assert flat[0, 4:].tolist() == [0.0, 1.0, 0.0]
+        cont, cat = enc.onehot_to_split(flat)
+        (params,) = enc.decode(cont, cat)
+        assert params.get_value("c") == "b"
+
+    def test_conditional_rejected(self):
+        s = vz.SearchSpace()
+        sel = s.root.add_categorical_param("m", ["a", "b"])
+        sel.select_values(["a"]).add_float_param("x", 0, 1)
+        with pytest.raises(ValueError):
+            converters.SearchSpaceEncoder(s)
+
+    def test_max_discrete_indices(self):
+        s = vz.SearchSpace()
+        s.root.add_int_param("small", 1, 3)
+        s.root.add_int_param("big", 1, 100)
+        enc = converters.SearchSpaceEncoder(s, max_discrete_indices=10)
+        assert enc.num_continuous == 1
+        assert enc.num_categorical == 1
+        assert enc.category_sizes == [3]
+        (params,) = enc.decode(np.array([[0.5]]), np.array([[2]]))
+        assert params.get_value("small") == 3
+        assert params.get_value("big") == 50
+
+
+class TestMetricsEncoder:
+    def test_sign_flip_and_nan(self):
+        mc = vz.MetricsConfig(
+            [
+                vz.MetricInformation(name="up", goal=vz.ObjectiveMetricGoal.MAXIMIZE),
+                vz.MetricInformation(name="down", goal=vz.ObjectiveMetricGoal.MINIMIZE),
+            ]
+        )
+        enc = converters.MetricsEncoder(mc)
+        t1 = vz.Trial(id=1)
+        t1.complete(vz.Measurement(metrics={"up": 1.0, "down": 2.0}))
+        t2 = vz.Trial(id=2)  # not completed
+        t3 = vz.Trial(id=3)
+        t3.complete(vz.Measurement(metrics={"up": 5.0}))  # missing 'down'
+        labels = enc.encode([t1, t2, t3])
+        np.testing.assert_allclose(labels[0], [1.0, -2.0])
+        assert np.isnan(labels[1]).all()
+        assert labels[2][0] == 5.0 and np.isnan(labels[2][1])
+        back = enc.decode(labels)
+        np.testing.assert_allclose(back[0], [1.0, 2.0])
+
+
+class TestPaddedArray:
+    def test_from_array_and_masks(self):
+        pa = types.PaddedArray.from_array(np.arange(6.0).reshape(2, 3), (4, 3))
+        assert pa.shape == (4, 3)
+        assert pa.valid_mask(0).tolist() == [True, True, False, False]
+        assert pa.valid_mask(1).tolist() == [True, True, True]
+        assert int(pa.num_valid(0)) == 2
+        np.testing.assert_array_equal(pa.unpad(), np.arange(6.0).reshape(2, 3))
+
+    def test_replace_fill_value(self):
+        pa = types.PaddedArray.from_array(np.ones((2, 2)), (3, 2), fill_value=0.0)
+        pa2 = pa.replace_fill_value(-5.0)
+        assert pa2.padded_array[2, 0] == -5.0
+        assert pa2.padded_array[0, 0] == 1.0
+
+    def test_pad_down_rejected(self):
+        with pytest.raises(ValueError):
+            types.PaddedArray.from_array(np.ones((4, 2)), (2, 2))
+
+    def test_pytree(self):
+        import jax
+
+        pa = types.PaddedArray.from_array(np.ones((2, 2)), (4, 2))
+        mapped = jax.tree_util.tree_map(lambda x: x * 2, pa)
+        assert mapped.padded_array[0, 0] == 2.0
+
+    def test_joint_mask(self):
+        pa = types.PaddedArray.from_array(np.ones((2, 2)), (3, 4))
+        m = pa.joint_valid_mask()
+        assert m.shape == (3, 4)
+        assert bool(m[1, 1]) and not bool(m[2, 1]) and not bool(m[1, 3])
+
+
+class TestPadding:
+    def test_powers_of_2(self):
+        p = padding_lib.PaddingType.POWERS_OF_2
+        assert p.pad(0) == 8
+        assert p.pad(7) == 8
+        assert p.pad(9) == 16
+        assert p.pad(1000) == 1024
+
+    def test_multiples_of_10(self):
+        p = padding_lib.PaddingType.MULTIPLES_OF_10
+        assert p.pad(1) == 10
+        assert p.pad(11) == 20
+
+    def test_stable_jit_shapes(self):
+        """Growing trials within one bucket must not change padded shapes."""
+        problem = _problem()
+        conv = converters.TrialToModelInputConverter.from_problem(problem)
+        trials = []
+        for i in range(1, 9):
+            t = _trial(i, lin=1.0, log=1e-3, n=2, d=4, c="a")
+            t.complete(vz.Measurement(metrics={"obj": float(i)}))
+            trials.append(t)
+        shapes = set()
+        for k in (5, 6, 7, 8):
+            data = conv.to_xy(trials[:k])
+            shapes.add(
+                (
+                    data.features.continuous.shape,
+                    data.features.categorical.shape,
+                    data.labels.shape,
+                )
+            )
+        assert len(shapes) == 1  # all in the 8-bucket
+
+
+class TestTrialToModelInputConverter:
+    def test_to_xy(self):
+        problem = _problem()
+        conv = converters.TrialToModelInputConverter.from_problem(problem)
+        trials = []
+        for i in range(3):
+            t = _trial(i + 1, lin=float(i), log=1e-3, n=2, d=4, c="a")
+            t.complete(vz.Measurement(metrics={"obj": float(i)}))
+            trials.append(t)
+        data = conv.to_xy(trials)
+        assert data.features.continuous.shape == (8, 4)
+        assert data.features.categorical.shape == (8, 1)
+        assert data.labels.shape == (8, 1)
+        assert int(data.labels.num_valid(0)) == 3
+        # Padded label rows are NaN-filled.
+        assert np.isnan(np.asarray(data.labels.padded_array)[3:]).all()
+
+
+class TestTrialToArrayConverter:
+    def test_roundtrip(self):
+        problem = _problem()
+        conv = converters.TrialToArrayConverter.from_study_config(problem)
+        t = _trial(1, lin=2.0, log=1e-3, n=2, d=4, c="b")
+        t.complete(vz.Measurement(metrics={"obj": 3.0}))
+        feats, labels = conv.to_xy([t])
+        assert feats.shape == (1, conv.output_dim)
+        assert labels[0, 0] == 3.0
+        (params,) = conv.to_parameters(feats)
+        assert problem.search_space.contains(params)
+        assert params.get_value("c") == "b"
+
+
+class TestReviewRegressions:
+    """Regressions from the second code review."""
+
+    def test_wide_int_range_encodes_fast(self):
+        s = vz.SearchSpace()
+        s.root.add_int_param("seed", 0, 50_000_000)
+        enc = converters.SearchSpaceEncoder(s)
+        cont, _ = enc.encode([_trial(i, seed=i * 1000) for i in range(3)])
+        assert cont.shape == (3, 1)
+
+    def test_decode_1d_continuous(self):
+        s = vz.SearchSpace()
+        s.root.add_float_param("x", 0.0, 1.0)
+        enc = converters.SearchSpaceEncoder(s)
+        out = enc.decode(np.array([0.1, 0.5, 0.9]), np.zeros((3, 0)))
+        assert [round(p.get_value("x"), 2) for p in out] == [0.1, 0.5, 0.9]
+
+    def test_decode_row_mismatch_raises(self):
+        s = vz.SearchSpace()
+        s.root.add_float_param("x", 0.0, 1.0)
+        s.root.add_categorical_param("c", ["a", "b"])
+        enc = converters.SearchSpaceEncoder(s)
+        with pytest.raises(ValueError, match="Row mismatch"):
+            enc.decode(np.zeros((2, 1)), np.zeros((3, 1)))
+
+    def test_unknown_category_raises(self):
+        s = vz.SearchSpace()
+        s.root.add_categorical_param("c", ["a", "b"])
+        enc = converters.SearchSpaceEncoder(s)
+        with pytest.raises(ValueError, match="not a known category"):
+            enc.encode([_trial(1, c="zzz")])
+
+    def test_bool_param_contains_python_bool(self):
+        s = vz.SearchSpace()
+        s.root.add_bool_param("b")
+        assert s.contains({"b": True})
+
+    def test_complete_not_inplace_deep_copies(self):
+        t = vz.Trial(id=1)
+        t.measurements.append(vz.Measurement(metrics={"m": 1.0}))
+        t2 = t.complete(inplace=False)
+        assert t.measurements is not t2.measurements
+
+    def test_metadata_mutable_mapping(self):
+        md = vz.Metadata()
+        md["k"] = "v"
+        assert md.pop("k") == "v"
+        assert md.setdefault("j", "w") == "w"
+        md.clear()
+        assert len(md) == 0

@@ -14,7 +14,7 @@ matters; see the property tests in ``tests/pyvizier/test_common.py``.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Iterator, List, Mapping, Optional, Tuple, Union
+from typing import Any, Dict, Iterable, Iterator, List, Mapping, MutableMapping, Optional, Tuple, Union
 
 # Metadata values: plain scalars/bytes, or any protobuf-like object.
 MetadataValue = Union[str, float, int, bytes, Any]
@@ -100,7 +100,7 @@ class Namespace(tuple):
         return f"Namespace({self.encode()!r})"
 
 
-class _NamespaceView(Mapping[str, MetadataValue]):
+class _NamespaceView(MutableMapping[str, MetadataValue]):
     """A mutable dict-like view of one namespace inside a Metadata."""
 
     def __init__(self, metadata: "Metadata", ns: Namespace):

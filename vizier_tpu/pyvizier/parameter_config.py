@@ -237,6 +237,8 @@ class ParameterConfig:
                 return False
             return any(_is_close(float(value), float(v)) for v in self._feasible_values)  # type: ignore[arg-type]
         if self.type == ParameterType.CATEGORICAL:
+            if isinstance(value, bool) and self.external_type == ExternalType.BOOLEAN:
+                value = "True" if value else "False"
             return isinstance(value, str) and value in self._feasible_values
         return True  # CUSTOM accepts anything.
 
@@ -314,7 +316,11 @@ class ParameterConfig:
         if self.type == ParameterType.DOUBLE:
             lo, hi = self.bounds
             return (lo + hi) / 2.0
-        return self.feasible_values[0]
+        if self.type == ParameterType.INTEGER:
+            # Arithmetic, not feasible_values[0]: wide integer ranges must not
+            # materialize the whole range.
+            return int(self._bounds[0])  # type: ignore[index]
+        return self._feasible_values[0]
 
 
 class InvalidParameterError(Exception):

@@ -256,7 +256,14 @@ class Trial:
         marked infeasible (matching the service semantics of the reference's
         ``CompleteTrial``, ``vizier_service.py:568``).
         """
-        target = self if inplace else dataclasses.replace(self)
+        if inplace:
+            target = self
+        else:
+            target = dataclasses.replace(
+                self,
+                parameters=ParameterDict(dict(self.parameters)),
+                measurements=list(self.measurements),
+            )
         if measurement is None and infeasibility_reason is None:
             if target.measurements:
                 measurement = target.measurements[-1]
