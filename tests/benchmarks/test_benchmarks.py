@@ -1,0 +1,225 @@
+"""Tests for experimenters, the benchmark runner, and convergence analyzers."""
+
+import numpy as np
+import pytest
+
+from vizier_tpu import benchmarks
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.benchmarks.experimenters.synthetic import bbob
+from vizier_tpu.benchmarks.experimenters.synthetic import multiobjective
+from vizier_tpu.benchmarks.experimenters.synthetic import simplekd
+from vizier_tpu.benchmarks.experimenters import wrappers
+from vizier_tpu.designers import GridSearchDesigner, RandomDesigner
+
+
+class TestBBOB:
+    @pytest.mark.parametrize("name,fn", sorted(bbob.BBOB_FUNCTIONS.items()))
+    def test_optimum_value_is_zero(self, name, fn):
+        for dim in (2, 5):
+            if name == "LinearSlope":
+                # Linear function: the optimum sits at the +5 corner.
+                opt = np.full((1, dim), 5.0)
+            else:
+                opt = np.zeros((1, dim))
+            val = fn(opt)[0]
+            assert np.isfinite(val), name
+            assert val == pytest.approx(0.0, abs=1e-6), f"{name}: f(opt)={val}"
+
+    @pytest.mark.parametrize("name,fn", sorted(bbob.BBOB_FUNCTIONS.items()))
+    def test_batch_and_positive(self, name, fn):
+        rng = np.random.default_rng(0)
+        x = rng.uniform(-5, 5, size=(16, 4))
+        vals = fn(x)
+        assert vals.shape == (16,)
+        assert np.all(np.isfinite(vals)), name
+        assert np.all(vals >= -1e-9), f"{name} has negative values"
+
+    def test_sphere_exact(self):
+        np.testing.assert_allclose(
+            bbob.Sphere(np.array([[1.0, 2.0], [0.0, 3.0]])), [5.0, 9.0]
+        )
+
+
+class TestNumpyExperimenter:
+    def test_evaluate_completes_trials(self):
+        problem = benchmarks.bbob_problem(2)
+        exp = benchmarks.NumpyExperimenter(bbob.Sphere, problem)
+        t = vz.Trial(id=1, parameters={"x0": 1.0, "x1": 2.0})
+        exp.evaluate([t])
+        assert t.final_measurement.metrics["bbob_eval"].value == pytest.approx(5.0)
+
+    def test_nan_marks_infeasible(self):
+        problem = benchmarks.bbob_problem(1)
+        exp = benchmarks.NumpyExperimenter(lambda x: np.full(x.shape[0], np.nan), problem)
+        t = vz.Trial(id=1, parameters={"x0": 0.0})
+        exp.evaluate([t])
+        assert t.infeasible
+
+
+class TestWrappers:
+    def _sphere(self, dim=2):
+        return benchmarks.NumpyExperimenter(bbob.Sphere, benchmarks.bbob_problem(dim))
+
+    def test_noisy(self):
+        exp = wrappers.NoisyExperimenter(self._sphere(), noise_std=0.1, seed=1)
+        t = vz.Trial(id=1, parameters={"x0": 0.0, "x1": 0.0})
+        exp.evaluate([t])
+        v = t.final_measurement.metrics["bbob_eval"].value
+        assert v != 0.0 and abs(v) < 1.0
+
+    def test_shifting_moves_optimum(self):
+        exp = wrappers.ShiftingExperimenter(self._sphere(), shift=np.array([1.0, -2.0]))
+        at_shift = vz.Trial(id=1, parameters={"x0": 1.0, "x1": -2.0})
+        at_origin = vz.Trial(id=2, parameters={"x0": 0.0, "x1": 0.0})
+        exp.evaluate([at_shift, at_origin])
+        assert at_shift.final_measurement.metrics["bbob_eval"].value == pytest.approx(0.0)
+        assert at_origin.final_measurement.metrics["bbob_eval"].value > 0
+
+    def test_sign_flip(self):
+        exp = wrappers.SignFlipExperimenter(self._sphere())
+        assert (
+            exp.problem_statement().metric_information.item().goal
+            == vz.ObjectiveMetricGoal.MAXIMIZE
+        )
+        t = vz.Trial(id=1, parameters={"x0": 1.0, "x1": 0.0})
+        exp.evaluate([t])
+        assert t.final_measurement.metrics["bbob_eval"].value == pytest.approx(-1.0)
+
+    def test_discretizing(self):
+        exp = wrappers.DiscretizingExperimenter(
+            self._sphere(), {"x0": [-1.0, 0.0, 1.0]}
+        )
+        space = exp.problem_statement().search_space
+        assert space.get("x0").type == vz.ParameterType.DISCRETE
+        assert space.get("x1").type == vz.ParameterType.DOUBLE
+
+    def test_infeasible(self):
+        exp = wrappers.InfeasibleExperimenter(self._sphere(), infeasible_prob=1.0, seed=0)
+        t = vz.Trial(id=1, parameters={"x0": 0.0, "x1": 0.0})
+        exp.evaluate([t])
+        assert t.infeasible
+
+
+class TestSimpleKD:
+    def test_optimum(self):
+        exp = simplekd.SimpleKDExperimenter("corner")
+        best = exp.optimal_trial()
+        exp.evaluate([best])
+        assert best.final_measurement.metrics["value"].value == pytest.approx(0.0)
+
+    def test_suboptimal_is_worse(self):
+        exp = simplekd.SimpleKDExperimenter("corner")
+        t = vz.Trial(
+            parameters={
+                "categorical": "center",
+                "discrete": 5.0,
+                "int": 4,
+                "float_0": 0.9,
+                "float_1": 0.9,
+            }
+        )
+        exp.evaluate([t])
+        assert t.final_measurement.metrics["value"].value < -1.0
+
+
+class TestMultiObjective:
+    @pytest.mark.parametrize("which", ["zdt1", "zdt2", "zdt3", "zdt4", "zdt6"])
+    def test_zdt_shapes(self, which):
+        exp = multiobjective.MultiObjectiveExperimenter.zdt(which, dimension=5)
+        t = vz.Trial(parameters={f"x{i}": 0.5 for i in range(5)})
+        exp.evaluate([t])
+        assert len(t.final_measurement.metrics) == 2
+
+    def test_zdt1_pareto_front(self):
+        # On the front (x1..=0), f2 = 1 - sqrt(f1).
+        exp = multiobjective.MultiObjectiveExperimenter.zdt("zdt1", dimension=4)
+        t = vz.Trial(parameters={"x0": 0.25, "x1": 0.0, "x2": 0.0, "x3": 0.0})
+        exp.evaluate([t])
+        m = t.final_measurement.metrics
+        assert m["zdt1_f0"].value == pytest.approx(0.25)
+        assert m["zdt1_f1"].value == pytest.approx(1 - 0.5)
+
+    def test_dtlz2(self):
+        exp = multiobjective.MultiObjectiveExperimenter.dtlz("dtlz2", dimension=4)
+        t = vz.Trial(parameters={f"x{i}": 0.5 for i in range(4)})
+        exp.evaluate([t])
+        m = list(t.final_measurement.metrics.values())
+        # On the unit sphere: sum of squares == 1 when g == 0.
+        assert sum(v.value**2 for v in m) == pytest.approx(1.0)
+
+
+class TestRunnerAndAnalyzers:
+    def test_benchmark_loop_and_convergence(self):
+        problem = benchmarks.bbob_problem(2)
+        exp = benchmarks.NumpyExperimenter(bbob.Sphere, problem)
+        state = benchmarks.BenchmarkState.from_designer_factory(
+            exp, lambda p, **kw: RandomDesigner(p.search_space, seed=kw.get("seed", 0)), seed=1
+        )
+        runner = benchmarks.BenchmarkRunner(
+            benchmark_subroutines=[benchmarks.GenerateAndEvaluate(5)], num_repeats=6
+        )
+        runner.run(state)
+        trials = state.algorithm.supporter.GetTrials(
+            status_matches=vz.TrialStatus.COMPLETED
+        )
+        assert len(trials) == 30
+        curve = benchmarks.ConvergenceCurveConverter(
+            problem.metric_information.item()
+        ).convert(trials)
+        assert curve.ys.shape == (1, 30)
+        # Best-so-far must be monotone non-increasing for MINIMIZE.
+        assert np.all(np.diff(curve.ys[0]) <= 1e-12)
+
+    def test_suggest_then_evaluate_subroutines(self):
+        problem = benchmarks.bbob_problem(2)
+        exp = benchmarks.NumpyExperimenter(bbob.Sphere, problem)
+        state = benchmarks.BenchmarkState.from_designer_factory(
+            exp, lambda p, **kw: RandomDesigner(p.search_space, seed=0)
+        )
+        benchmarks.BenchmarkRunner(
+            [benchmarks.GenerateSuggestions(4), benchmarks.EvaluateActiveTrials()],
+            num_repeats=2,
+        ).run(state)
+        assert (
+            len(state.algorithm.supporter.GetTrials(status_matches=vz.TrialStatus.COMPLETED))
+            == 8
+        )
+
+    def test_log_efficiency_comparator(self):
+        # A faster-converging curve should score positive.
+        xs = np.arange(1, 21)
+        slow = benchmarks.ConvergenceCurve(
+            xs=xs, ys=(xs / 20.0)[None, :], trend=benchmarks.ConvergenceCurve.YTrend.INCREASING
+        )
+        fast = benchmarks.ConvergenceCurve(
+            xs=xs,
+            ys=np.minimum(xs / 5.0, 1.0)[None, :],
+            trend=benchmarks.ConvergenceCurve.YTrend.INCREASING,
+        )
+        comparator = benchmarks.LogEfficiencyConvergenceCurveComparator(slow)
+        assert comparator.score(fast) > 0.5
+        assert benchmarks.LogEfficiencyConvergenceCurveComparator(fast).score(slow) < -0.5
+
+    def test_win_rate(self):
+        xs = np.arange(1, 4)
+        a = benchmarks.ConvergenceCurve(
+            xs=xs, ys=np.array([[1, 2, 3.0]]), trend=benchmarks.ConvergenceCurve.YTrend.INCREASING
+        )
+        b = benchmarks.ConvergenceCurve(
+            xs=xs, ys=np.array([[1, 2, 5.0]]), trend=benchmarks.ConvergenceCurve.YTrend.INCREASING
+        )
+        assert benchmarks.WinRateComparator(a).score(b) == 1.0
+
+    def test_grid_beats_random_on_1d(self):
+        """Sanity: exhaustive grid finds the 1-D optimum exactly."""
+        problem = benchmarks.bbob_problem(1)
+        exp = benchmarks.NumpyExperimenter(bbob.Sphere, problem)
+        state = benchmarks.BenchmarkState.from_designer_factory(
+            exp, lambda p, **kw: GridSearchDesigner(p.search_space, double_grid_resolution=21)
+        )
+        benchmarks.BenchmarkRunner([benchmarks.GenerateAndEvaluate(21)]).run(state)
+        trials = state.algorithm.supporter.GetTrials(
+            status_matches=vz.TrialStatus.COMPLETED
+        )
+        best = min(t.final_measurement.metrics["bbob_eval"].value for t in trials)
+        assert best == pytest.approx(0.0, abs=1e-9)

@@ -1,0 +1,141 @@
+"""Tests for the pythia protocol, supporters, and designer-policy wrappers."""
+
+import pytest
+
+from vizier_tpu import algorithms as alg
+from vizier_tpu import pythia
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.designers import QuasiRandomDesigner, RandomDesigner
+from vizier_tpu.testing import test_studies
+
+
+def _study_config(algorithm="RANDOM_SEARCH"):
+    return vz.StudyConfig(
+        search_space=test_studies.flat_space_with_all_types(),
+        metric_information=test_studies.metrics_objective_maximize(),
+        algorithm=algorithm,
+    )
+
+
+def _complete(trials, value=1.0):
+    for t in trials:
+        t.complete(vz.Measurement(metrics={"objective": value}))
+
+
+class TestInRamPolicySupporter:
+    def test_suggest_assigns_ids(self):
+        supporter = pythia.InRamPolicySupporter(_study_config())
+        policy = alg.RandomPolicy(supporter, seed=1)
+        trials = supporter.SuggestTrials(policy, 5)
+        assert [t.id for t in trials] == [1, 2, 3, 4, 5]
+        assert supporter.study_descriptor().max_trial_id == 5
+
+    def test_get_trials_filters(self):
+        supporter = pythia.InRamPolicySupporter(_study_config())
+        policy = alg.RandomPolicy(supporter, seed=1)
+        trials = supporter.SuggestTrials(policy, 4)
+        _complete(trials[:2])
+        completed = supporter.GetTrials(status_matches=vz.TrialStatus.COMPLETED)
+        active = supporter.GetTrials(status_matches=vz.TrialStatus.ACTIVE)
+        assert [t.id for t in completed] == [1, 2]
+        assert [t.id for t in active] == [3, 4]
+        assert [t.id for t in supporter.GetTrials(min_trial_id=3)] == [3, 4]
+
+    def test_early_stop(self):
+        supporter = pythia.InRamPolicySupporter(_study_config())
+        policy = alg.RandomPolicy(supporter, seed=1)
+        trials = supporter.SuggestTrials(policy, 3)
+        decisions = supporter.EarlyStopTrials(policy, [t.id for t in trials])
+        stopped = [d.id for d in decisions.decisions if d.should_stop]
+        assert len(stopped) == 1
+        (stopped_trial,) = [t for t in supporter.trials if t.id == stopped[0]]
+        assert stopped_trial.status == vz.TrialStatus.STOPPING
+
+    def test_prior_study(self):
+        main = pythia.InRamPolicySupporter(_study_config())
+        prior = pythia.InRamPolicySupporter(_study_config(), study_guid="prior")
+        prior.AddTrials([vz.Trial(parameters={"lineardouble": 0.5})])
+        main.SetPriorStudy(prior)
+        assert len(main.GetTrials(study_guid="prior")) == 1
+
+
+class TestDesignerPolicy:
+    def test_stateless_replay(self):
+        supporter = pythia.InRamPolicySupporter(_study_config())
+        policy = alg.DesignerPolicy(
+            supporter, lambda p, **kw: RandomDesigner(p.search_space, seed=0)
+        )
+        trials = supporter.SuggestTrials(policy, 3)
+        assert len(trials) == 3
+        _complete(trials)
+        more = supporter.SuggestTrials(policy, 2)
+        assert len(more) == 2
+
+    def test_seeding_uses_default(self):
+        config = _study_config()
+        config.search_space.get("lineardouble")  # exists
+        supporter = pythia.InRamPolicySupporter(config)
+        policy = alg.DesignerPolicy(
+            supporter,
+            lambda p, **kw: RandomDesigner(p.search_space, seed=0),
+            use_seeding=True,
+        )
+        (first, second) = supporter.SuggestTrials(policy, 2)
+        # Seed suggestion: center of lineardouble [-1, 2] is 0.5.
+        assert first.parameters.get_value("lineardouble") == pytest.approx(0.5)
+
+    def test_partially_serializable_policy_checkpoints(self):
+        config = vz.StudyConfig(
+            search_space=test_studies.flat_continuous_space_with_scaling(),
+            metric_information=test_studies.metrics_objective_maximize(),
+        )
+        supporter = pythia.InRamPolicySupporter(config)
+        factory = lambda p, **kw: QuasiRandomDesigner(p.search_space, seed=9)
+        policy = alg.PartiallySerializableDesignerPolicy(supporter, factory)
+        first = supporter.SuggestTrials(policy, 3)
+        # State was persisted into study metadata.
+        ns = config.metadata.abs_ns(vz.Namespace(("designer_policy_v0",)))
+        assert "designer" in ns and "incorporated_trial_ids" in ns
+        # A brand-new policy object resumes the Halton stream rather than
+        # restarting: its next suggestions differ from the first three.
+        policy2 = alg.PartiallySerializableDesignerPolicy(supporter, factory)
+        second = supporter.SuggestTrials(policy2, 3)
+        firsts = [t.parameters.as_dict() for t in first]
+        seconds = [t.parameters.as_dict() for t in second]
+        assert firsts != seconds
+        # And a fresh-from-scratch designer would have repeated `firsts`.
+        fresh = QuasiRandomDesigner(config.search_space, seed=9).suggest(3)
+        assert [s.parameters.as_dict() for s in fresh] == firsts
+
+    def test_corrupt_state_falls_back_to_replay(self):
+        config = vz.StudyConfig(
+            search_space=test_studies.flat_continuous_space_with_scaling(),
+            metric_information=test_studies.metrics_objective_maximize(),
+        )
+        supporter = pythia.InRamPolicySupporter(config)
+        factory = lambda p, **kw: QuasiRandomDesigner(p.search_space, seed=9)
+        policy = alg.PartiallySerializableDesignerPolicy(supporter, factory)
+        supporter.SuggestTrials(policy, 2)
+        config.metadata.abs_ns(vz.Namespace(("designer_policy_v0",)))["designer"] = "%%corrupt%%"
+        # Must not raise; falls back to a fresh designer.
+        trials = supporter.SuggestTrials(
+            alg.PartiallySerializableDesignerPolicy(supporter, factory), 2
+        )
+        assert len(trials) == 2
+
+
+class TestSuggestRequestValidation:
+    def test_count_positive(self):
+        desc = vz.StudyDescriptor(config=_study_config())
+        with pytest.raises(ValueError):
+            pythia.SuggestRequest(study_descriptor=desc, count=0)
+
+
+class TestEarlyStopEmptyIds:
+    def test_empty_ids_considers_all_active(self):
+        supporter = pythia.InRamPolicySupporter(_study_config())
+        policy = alg.RandomPolicy(supporter, seed=1)
+        supporter.SuggestTrials(policy, 3)
+        decisions = supporter.EarlyStopTrials(policy)  # no ids given
+        assert len(decisions.decisions) == 3
+        assert sum(d.should_stop for d in decisions.decisions) == 1

@@ -1,0 +1,20 @@
+"""Designer abstractions and designer->policy wrappers."""
+
+from vizier_tpu.algorithms.core import (
+    ActiveTrials,
+    CompletedTrials,
+    Designer,
+    DesignerFactory,
+    PartiallySerializableDesigner,
+    Prediction,
+    Predictor,
+    SerializableDesigner,
+)
+from vizier_tpu.algorithms.designer_policy import (
+    DesignerPolicy,
+    InRamDesignerPolicy,
+    PartiallySerializableDesignerPolicy,
+    SerializableDesignerPolicy,
+    default_suggestion,
+)
+from vizier_tpu.algorithms.random_policy import RandomPolicy

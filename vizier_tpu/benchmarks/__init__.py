@@ -1,0 +1,23 @@
+"""Benchmark experimenters, runners, and analyzers."""
+
+from vizier_tpu.benchmarks.analyzers.convergence_curve import (
+    ConvergenceCurve,
+    ConvergenceCurveConverter,
+    LogEfficiencyConvergenceCurveComparator,
+    SimpleRegretComparator,
+    WinRateComparator,
+)
+from vizier_tpu.benchmarks.experimenters.base import (
+    Experimenter,
+    NumpyExperimenter,
+    bbob_problem,
+)
+from vizier_tpu.benchmarks.runners.benchmark_runner import (
+    AddPriorTrials,
+    BenchmarkRunner,
+    BenchmarkSubroutine,
+    EvaluateActiveTrials,
+    GenerateAndEvaluate,
+    GenerateSuggestions,
+)
+from vizier_tpu.benchmarks.runners.benchmark_state import BenchmarkState, PolicySuggester

@@ -1,0 +1,170 @@
+"""Convergence curves and designer comparators.
+
+Parity with
+``/root/reference/vizier/_src/benchmarks/analyzers/convergence_curve.py:35,714,837``:
+best-so-far curves extracted from trials, interpolation/alignment across
+repeats, and comparators (log-efficiency score, win rate) used by the
+statistical convergence tests that gate every algorithm change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+
+@dataclasses.dataclass
+class ConvergenceCurve:
+    """ys[b, t]: best objective seen by batch b after t+1 trials."""
+
+    xs: np.ndarray  # [T] trial counts (1-based)
+    ys: np.ndarray  # [B, T]
+    trend: "ConvergenceCurve.YTrend" = None  # type: ignore[assignment]
+
+    class YTrend(enum.Enum):
+        UNKNOWN = "UNKNOWN"
+        INCREASING = "INCREASING"
+        DECREASING = "DECREASING"
+
+    def __post_init__(self):
+        self.xs = np.asarray(self.xs)
+        self.ys = np.atleast_2d(np.asarray(self.ys))
+        if self.trend is None:
+            self.trend = ConvergenceCurve.YTrend.UNKNOWN
+        if self.ys.shape[-1] != len(self.xs):
+            raise ValueError(f"ys {self.ys.shape} does not match xs {self.xs.shape}.")
+
+    @property
+    def num_batches(self) -> int:
+        return self.ys.shape[0]
+
+    @classmethod
+    def align_xs(cls, curves: Sequence["ConvergenceCurve"]) -> "ConvergenceCurve":
+        """Stacks curves onto a common x grid (interpolating where needed)."""
+        if not curves:
+            raise ValueError("No curves to align.")
+        trend = curves[0].trend
+        max_x = max(float(c.xs[-1]) for c in curves)
+        xs = np.arange(1, int(max_x) + 1)
+        ys = []
+        for c in curves:
+            for row in c.ys:
+                ys.append(np.interp(xs, c.xs, row))
+        return cls(xs=xs, ys=np.stack(ys), trend=trend)
+
+    def percentile_curve(self, percentile: float = 50.0) -> np.ndarray:
+        return np.percentile(self.ys, percentile, axis=0)
+
+
+class ConvergenceCurveConverter:
+    """Trials → best-so-far ConvergenceCurve for one objective metric."""
+
+    def __init__(
+        self,
+        metric_information: base_study_config.MetricInformation,
+        *,
+        flip_signs_for_min: bool = False,
+    ):
+        self._metric = metric_information
+        self._flip = flip_signs_for_min
+
+    def convert(self, trials: Sequence[trial_.Trial]) -> ConvergenceCurve:
+        goal = self._metric.goal
+        values = []
+        for t in trials:
+            if t.final_measurement and self._metric.name in t.final_measurement.metrics:
+                values.append(t.final_measurement.metrics[self._metric.name].value)
+            else:
+                values.append(np.nan)
+        values = np.asarray(values, dtype=np.float64)
+        if goal.is_maximize:
+            with np.errstate(invalid="ignore"):
+                ys = np.fmax.accumulate(np.where(np.isnan(values), -np.inf, values))
+            trend = ConvergenceCurve.YTrend.INCREASING
+        else:
+            with np.errstate(invalid="ignore"):
+                ys = np.fmin.accumulate(np.where(np.isnan(values), np.inf, values))
+            trend = ConvergenceCurve.YTrend.DECREASING
+        if self._flip and goal.is_minimize:
+            ys = -ys
+            trend = ConvergenceCurve.YTrend.INCREASING
+        return ConvergenceCurve(
+            xs=np.arange(1, len(values) + 1), ys=ys[None, :], trend=trend
+        )
+
+
+@dataclasses.dataclass
+class LogEfficiencyConvergenceCurveComparator:
+    """Sample-efficiency score of ``compared`` vs ``baseline``.
+
+    Score ≈ log(baseline trials needed / compared trials needed) to reach the
+    same objective quantile: positive = compared is more sample-efficient.
+    Curves must share trend (both INCREASING after any flips).
+    """
+
+    baseline_curve: ConvergenceCurve
+
+    def score(self, compared: ConvergenceCurve) -> float:
+        base = self.baseline_curve
+        if base.trend != compared.trend:
+            raise ValueError(f"Trend mismatch: {base.trend} vs {compared.trend}.")
+        sign = 1.0 if base.trend == ConvergenceCurve.YTrend.INCREASING else -1.0
+        base_med = sign * base.percentile_curve(50.0)
+        comp_med = sign * compared.percentile_curve(50.0)
+        # Objective threshold: final median of the baseline.
+        target = base_med[-1]
+        base_t = _first_index_reaching(base_med, target)
+        comp_t = _first_index_reaching(comp_med, target)
+        if comp_t is None:
+            # Compared never reaches it; score by how far it got in log-ratio
+            # of trials at its best value.
+            reached = comp_med[-1]
+            base_at = _first_index_reaching(base_med, reached)
+            if base_at is None:
+                return 0.0
+            return float(np.log((base_at + 1) / len(comp_med)))
+        return float(np.log((base_t + 1) / (comp_t + 1)))
+
+
+def _first_index_reaching(values: np.ndarray, target: float) -> Optional[int]:
+    hits = np.nonzero(values >= target - 1e-12)[0]
+    return int(hits[0]) if len(hits) else None
+
+
+@dataclasses.dataclass
+class WinRateComparator:
+    """Fraction of (baseline, compared) batch pairs where compared wins."""
+
+    baseline_curve: ConvergenceCurve
+
+    def score(self, compared: ConvergenceCurve) -> float:
+        base = self.baseline_curve
+        sign = 1.0 if base.trend == ConvergenceCurve.YTrend.INCREASING else -1.0
+        wins, total = 0, 0
+        for b in base.ys:
+            for c in compared.ys:
+                total += 1
+                if sign * c[-1] > sign * b[-1]:
+                    wins += 1
+        return wins / max(total, 1)
+
+
+@dataclasses.dataclass
+class SimpleRegretComparator:
+    """Simple regret vs a known optimum at a fixed trial budget."""
+
+    optimum: float
+    goal: base_study_config.ObjectiveMetricGoal
+
+    def regret(self, curve: ConvergenceCurve, at_trial: Optional[int] = None) -> float:
+        idx = -1 if at_trial is None else min(at_trial - 1, curve.ys.shape[1] - 1)
+        best = np.median(curve.ys[:, idx])
+        if self.goal.is_maximize:
+            return float(self.optimum - best)
+        return float(best - self.optimum)

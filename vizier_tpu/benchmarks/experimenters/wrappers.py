@@ -1,0 +1,209 @@
+"""Experimenter wrappers: noise, shifting, discretizing, sign-flip, etc.
+
+Parity with the reference wrapper experimenters
+(``/root/reference/vizier/_src/benchmarks/experimenters/``: noisy_experimenter,
+shifting_experimenter, discretizing_experimenter, normalizing_experimenter,
+sign_flip_experimenter, infeasible_experimenter, permuting_experimenter).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from vizier_tpu.benchmarks.experimenters import base
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import parameter_config as pc
+from vizier_tpu.pyvizier import trial as trial_
+
+
+class _Wrapper(base.Experimenter):
+    def __init__(self, exptr: base.Experimenter):
+        self._exptr = exptr
+
+    def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
+        self._exptr.evaluate(suggestions)
+
+    def problem_statement(self) -> base_study_config.ProblemStatement:
+        return self._exptr.problem_statement()
+
+
+class NoisyExperimenter(_Wrapper):
+    """Adds Gaussian noise to every metric after evaluation."""
+
+    def __init__(
+        self,
+        exptr: base.Experimenter,
+        *,
+        noise_std: float = 1.0,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(exptr)
+        self._std = noise_std
+        self._rng = np.random.default_rng(seed)
+
+    def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
+        self._exptr.evaluate(suggestions)
+        for t in suggestions:
+            if t.final_measurement is None:
+                continue
+            noisy = {
+                name: trial_.Metric(m.value + self._rng.normal(0.0, self._std))
+                for name, m in t.final_measurement.metrics.items()
+            }
+            t.final_measurement = trial_.Measurement(
+                metrics=noisy,
+                elapsed_secs=t.final_measurement.elapsed_secs,
+                steps=t.final_measurement.steps,
+            )
+
+
+class ShiftingExperimenter(_Wrapper):
+    """Shifts the optimum: evaluates f(x - shift) with clipped bounds."""
+
+    def __init__(self, exptr: base.Experimenter, shift: np.ndarray):
+        super().__init__(exptr)
+        self._shift = np.asarray(shift, dtype=np.float64)
+        self._params = [
+            p for p in exptr.problem_statement().search_space.parameters
+        ]
+        if len(self._shift) != len(self._params):
+            raise ValueError(
+                f"shift has {len(self._shift)} dims for {len(self._params)} parameters."
+            )
+
+    def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
+        shifted = []
+        for t in suggestions:
+            params = trial_.ParameterDict()
+            for p, s in zip(self._params, self._shift):
+                lo, hi = p.bounds
+                v = float(t.parameters.get_value(p.name)) - s
+                params[p.name] = float(np.clip(v, lo, hi))
+            shifted.append(trial_.Trial(id=t.id, parameters=params))
+        self._exptr.evaluate(shifted)
+        for orig, sh in zip(suggestions, shifted):
+            orig.final_measurement = sh.final_measurement
+            orig.infeasibility_reason = sh.infeasibility_reason
+            orig.completion_time = sh.completion_time
+
+
+class SignFlipExperimenter(_Wrapper):
+    """Negates metrics and flips goals (MINIMIZE ⇄ MAXIMIZE)."""
+
+    def __init__(self, exptr: base.Experimenter):
+        super().__init__(exptr)
+        original = exptr.problem_statement()
+        self._problem = base_study_config.ProblemStatement(
+            search_space=original.search_space,
+            metric_information=base_study_config.MetricsConfig(
+                [m.flip_goal() for m in original.metric_information]
+            ),
+            metadata=original.metadata,
+        )
+
+    def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
+        self._exptr.evaluate(suggestions)
+        for t in suggestions:
+            if t.final_measurement is None:
+                continue
+            t.final_measurement = trial_.Measurement(
+                metrics={
+                    name: trial_.Metric(-m.value)
+                    for name, m in t.final_measurement.metrics.items()
+                },
+                elapsed_secs=t.final_measurement.elapsed_secs,
+                steps=t.final_measurement.steps,
+            )
+
+    def problem_statement(self) -> base_study_config.ProblemStatement:
+        return self._problem
+
+
+class DiscretizingExperimenter(_Wrapper):
+    """Restricts selected DOUBLE parameters to discrete feasible points."""
+
+    def __init__(
+        self,
+        exptr: base.Experimenter,
+        discretization: Dict[str, Sequence[float]],
+    ):
+        super().__init__(exptr)
+        original = exptr.problem_statement()
+        space = pc.SearchSpace()
+        for p in original.search_space.parameters:
+            if p.name in discretization:
+                space.root.add_discrete_param(
+                    p.name, list(discretization[p.name]), auto_cast=False
+                )
+            else:
+                space.parameters = space.parameters + [p]
+        self._problem = base_study_config.ProblemStatement(
+            search_space=space,
+            metric_information=original.metric_information,
+            metadata=original.metadata,
+        )
+
+    def problem_statement(self) -> base_study_config.ProblemStatement:
+        return self._problem
+
+
+class NormalizingExperimenter(_Wrapper):
+    """Normalizes metrics by |f| statistics sampled on a random grid."""
+
+    def __init__(self, exptr: base.Experimenter, *, num_samples: int = 100, seed: int = 0):
+        super().__init__(exptr)
+        problem = exptr.problem_statement()
+        rng = np.random.default_rng(seed)
+        from vizier_tpu.designers import random as random_designer
+
+        probes = []
+        for _ in range(num_samples):
+            params = random_designer.sample_point(problem.search_space, rng)
+            probes.append(trial_.Trial(parameters=params))
+        exptr.evaluate(probes)
+        names = [m.name for m in problem.metric_information]
+        self._scale = {}
+        for name in names:
+            vals = [
+                t.final_measurement.metrics[name].value
+                for t in probes
+                if t.final_measurement is not None and name in t.final_measurement.metrics
+            ]
+            std = float(np.std(vals)) if vals else 1.0
+            self._scale[name] = std if std > 1e-12 else 1.0
+
+    def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
+        self._exptr.evaluate(suggestions)
+        for t in suggestions:
+            if t.final_measurement is None:
+                continue
+            t.final_measurement = trial_.Measurement(
+                metrics={
+                    name: trial_.Metric(m.value / self._scale.get(name, 1.0))
+                    for name, m in t.final_measurement.metrics.items()
+                },
+                elapsed_secs=t.final_measurement.elapsed_secs,
+                steps=t.final_measurement.steps,
+            )
+
+
+class InfeasibleExperimenter(_Wrapper):
+    """Marks a random fraction of evaluations infeasible."""
+
+    def __init__(
+        self, exptr: base.Experimenter, *, infeasible_prob: float = 0.1, seed: int = 0
+    ):
+        super().__init__(exptr)
+        self._prob = infeasible_prob
+        self._rng = np.random.default_rng(seed)
+
+    def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
+        self._exptr.evaluate(suggestions)
+        for t in suggestions:
+            if self._rng.uniform() < self._prob:
+                t.final_measurement = None
+                t.infeasibility_reason = "Randomly infeasible (benchmark wrapper)."

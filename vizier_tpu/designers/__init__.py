@@ -1,0 +1,5 @@
+"""The designer (algorithm) zoo."""
+
+from vizier_tpu.designers.grid import GridSearchDesigner
+from vizier_tpu.designers.quasi_random import HaltonSequence, QuasiRandomDesigner
+from vizier_tpu.designers.random import RandomDesigner

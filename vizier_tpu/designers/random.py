@@ -1,0 +1,79 @@
+"""Uniform random designer.
+
+Parity with ``/root/reference/vizier/_src/algorithms/designers/random.py:27``.
+Handles conditional search spaces by sampling the tree top-down.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import parameter_config as pc
+from vizier_tpu.pyvizier import trial as trial_
+
+
+def sample_parameter(
+    config: pc.ParameterConfig, rng: np.random.Generator
+) -> pc.ParameterValueTypes:
+    """Uniformly samples one feasible value (log-uniform for LOG scale)."""
+    if config.type == pc.ParameterType.DOUBLE:
+        lo, hi = config.bounds
+        if config.scale_type == pc.ScaleType.LOG and lo > 0:
+            return float(np.exp(rng.uniform(np.log(lo), np.log(hi))))
+        return float(rng.uniform(lo, hi))
+    if config.type == pc.ParameterType.INTEGER:
+        lo, hi = config.bounds
+        return int(rng.integers(int(lo), int(hi) + 1))
+    values = config.feasible_values
+    return values[int(rng.integers(0, len(values)))]
+
+
+def sample_point(
+    search_space: pc.SearchSpace, rng: np.random.Generator
+) -> trial_.ParameterDict:
+    """Samples a full (conditionally-consistent) point."""
+    params = trial_.ParameterDict()
+
+    def walk(config: pc.ParameterConfig) -> None:
+        value = sample_parameter(config, rng)
+        params[config.name] = config.cast_value(value)
+        for child in config.children:
+            if any(pc.parent_value_matches(value, pv) for pv in child.matching_parent_values):
+                walk(child)
+
+    for config in search_space.parameters:
+        walk(config)
+    return params
+
+
+class RandomDesigner(core_lib.Designer):
+    """Stateless uniform sampling."""
+
+    def __init__(
+        self,
+        search_space: pc.SearchSpace,
+        *,
+        seed: Optional[int] = None,
+    ):
+        self._search_space = search_space
+        self._rng = np.random.default_rng(seed)
+
+    @classmethod
+    def from_problem(
+        cls, problem: base_study_config.ProblemStatement, seed: Optional[int] = None
+    ) -> "RandomDesigner":
+        return cls(problem.search_space, seed=seed)
+
+    def update(self, completed, all_active=core_lib.ActiveTrials()) -> None:
+        del completed, all_active
+
+    def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
+        count = count or 1
+        return [
+            trial_.TrialSuggestion(parameters=sample_point(self._search_space, self._rng))
+            for _ in range(count)
+        ]

@@ -1,0 +1,41 @@
+"""Public Pythia facade: the algorithm-hosting protocol."""
+
+from vizier_tpu.pythia.errors import (
+    CachedPolicyIsStaleError,
+    CancelComputeError,
+    CancelledByVizierError,
+    InactivateStudyError,
+    PythiaProtocolError,
+    TemporaryPythiaError,
+    VizierDatabaseError,
+)
+from vizier_tpu.pythia.local_policy_supporters import InRamPolicySupporter
+from vizier_tpu.pythia.policy import (
+    EarlyStopDecision,
+    EarlyStopDecisions,
+    EarlyStopRequest,
+    Policy,
+    SuggestDecision,
+    SuggestRequest,
+)
+from vizier_tpu.pythia.policy_factory import PolicyFactory
+from vizier_tpu.pythia.policy_supporter import PolicySupporter
+
+__all__ = [
+    "CachedPolicyIsStaleError",
+    "CancelComputeError",
+    "CancelledByVizierError",
+    "EarlyStopDecision",
+    "EarlyStopDecisions",
+    "EarlyStopRequest",
+    "InRamPolicySupporter",
+    "InactivateStudyError",
+    "Policy",
+    "PolicyFactory",
+    "PolicySupporter",
+    "PythiaProtocolError",
+    "SuggestDecision",
+    "SuggestRequest",
+    "TemporaryPythiaError",
+    "VizierDatabaseError",
+]

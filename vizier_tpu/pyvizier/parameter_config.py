@@ -154,11 +154,15 @@ class ParameterConfig:
                     f"all-numeric (discrete); got {feasible_values!r}."
                 )
             cfg_bounds = None
-        if scale_type == ScaleType.LOG:
+        if scale_type in (ScaleType.LOG, ScaleType.REVERSE_LOG):
             if cfg_bounds is not None and cfg_bounds[0] <= 0:
-                raise ValueError(f"{name}: LOG scale requires positive bounds, got {cfg_bounds}.")
+                raise ValueError(
+                    f"{name}: {scale_type.value} scale requires positive bounds, got {cfg_bounds}."
+                )
             if ptype == ParameterType.DISCRETE and any(float(v) <= 0 for v in values):  # type: ignore[arg-type]
-                raise ValueError(f"{name}: LOG scale requires positive values, got {values}.")
+                raise ValueError(
+                    f"{name}: {scale_type.value} scale requires positive values, got {values}."
+                )
         child_tuple = tuple(
             dataclasses.replace(child, matching_parent_values=tuple(parent_values))
             for parent_values, child in children
@@ -633,7 +637,18 @@ class SearchSpace:
         raise KeyError(f"No top-level parameter named {path[0][0]!r}.")
 
 
-def _parent_value_matches(assigned: ParameterValueTypes, parent_value: ParameterValueTypes) -> bool:
+def parent_value_matches(
+    assigned: ParameterValueTypes, parent_value: ParameterValueTypes
+) -> bool:
+    """Whether an assigned parent value activates a child keyed on parent_value.
+
+    The single source of truth for conditional activation — used by
+    ``SearchSpace.assert_contains``, the random/default samplers, and the
+    service converters. Numerics compare with tolerance, strings exactly.
+    """
     if isinstance(assigned, str) or isinstance(parent_value, str):
-        return assigned == parent_value
+        return str(assigned) == str(parent_value)
     return _is_close(float(assigned), float(parent_value))
+
+
+_parent_value_matches = parent_value_matches  # internal alias
