@@ -1,14 +1,23 @@
-"""Test configuration: force a virtual 8-device CPU mesh before jax imports.
+"""Test configuration: force a virtual 8-device CPU mesh before jax init.
 
 Multi-chip sharding paths are exercised on CPU via
 ``--xla_force_host_platform_device_count`` (real TPU hardware in CI has one
 chip; the driver separately dry-runs the multi-chip path).
+
+The platform override must go through ``jax.config`` (not just the env var):
+the environment may pre-set ``JAX_PLATFORMS`` to a TPU plugin and pre-import
+jax via sitecustomize, in which case only a config update before the first
+backend initialization reliably selects CPU.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

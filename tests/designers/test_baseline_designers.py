@@ -167,3 +167,16 @@ class TestReviewRegressions:
             vz.ParameterConfig.factory(
                 "x", bounds=(0.0, 1.0), scale_type=vz.ScaleType.REVERSE_LOG
             )
+
+    def test_reverse_log_sampling_density(self):
+        """REVERSE_LOG concentrates samples near the upper bound."""
+        from vizier_tpu.designers.random import unit_to_double
+
+        cfg = vz.ParameterConfig.factory(
+            "x", bounds=(0.1, 1.0), scale_type=vz.ScaleType.REVERSE_LOG
+        )
+        vals = np.array([unit_to_double(cfg, u) for u in np.linspace(0, 1, 101)])
+        assert vals[0] == pytest.approx(0.1) and vals[-1] == pytest.approx(1.0)
+        assert (np.diff(vals) > 0).all()
+        # More than half the u-grid maps above the midpoint of the range.
+        assert np.mean(vals > 0.55) > 0.6

@@ -1,0 +1,261 @@
+"""Tests for acquisitions, the vectorized/eagle optimizers, and GP-Bandit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.designers.gp import acquisitions
+from vizier_tpu.designers.gp_bandit import VizierGPBandit
+from vizier_tpu.models import kernels
+from vizier_tpu.optimizers import eagle as eagle_lib
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+from vizier_tpu.optimizers import vectorized as vectorized_lib
+from vizier_tpu.testing import test_runners
+
+_FAST_ARD = lbfgs_lib.AdamOptimizer(maxiter=40)
+
+
+class TestAcquisitions:
+    def test_ucb_monotone_in_stddev(self):
+        acq = acquisitions.UCB(2.0)
+        lo = acq(jnp.asarray([0.0]), jnp.asarray([0.1]), jnp.asarray(0.0))
+        hi = acq(jnp.asarray([0.0]), jnp.asarray([1.0]), jnp.asarray(0.0))
+        assert float(hi[0]) > float(lo[0])
+
+    def test_ei_nonnegative_and_increasing_in_mean(self):
+        acq = acquisitions.EI()
+        m = jnp.asarray([-1.0, 0.0, 1.0])
+        s = jnp.full((3,), 0.5)
+        vals = np.asarray(acq(m, s, jnp.asarray(0.0)))
+        assert (vals >= 0).all()
+        assert vals[2] > vals[1] > vals[0]
+
+    def test_log_ei_matches_ei_argmax_region(self):
+        acq_ei = acquisitions.EI()
+        acq_log = acquisitions.LogEI()
+        m = jnp.linspace(-2, 2, 41)
+        s = jnp.full((41,), 0.3)
+        ei = np.asarray(acq_ei(m, s, jnp.asarray(0.0)))
+        lei = np.asarray(acq_log(m, s, jnp.asarray(0.0)))
+        np.testing.assert_allclose(np.log(ei[ei > 1e-20]), lei[ei > 1e-20], atol=1e-3)
+        assert np.argmax(ei) == np.argmax(lei)
+
+    def test_pi_in_unit_interval(self):
+        acq = acquisitions.PI()
+        vals = np.asarray(
+            acq(jnp.linspace(-3, 3, 10), jnp.full((10,), 1.0), jnp.asarray(0.0))
+        )
+        assert (vals >= 0).all() and (vals <= 1).all()
+
+    def test_q_acquisition(self):
+        rng = jax.random.PRNGKey(0)
+        means = jnp.asarray([[0.0, 2.0]])
+        stds = jnp.asarray([[0.5, 0.5]])
+        qei = acquisitions.q_acquisition(
+            means, stds, rng, best_label=jnp.asarray(0.0), kind="qei"
+        )
+        assert float(qei[1]) > float(qei[0])
+
+
+class TestTrustRegion:
+    def test_penalty_zero_near_data(self):
+        obs = kernels.MixedFeatures(
+            jnp.asarray([[0.5, 0.5]], jnp.float32), jnp.zeros((1, 0), jnp.int32)
+        )
+        tr = acquisitions.TrustRegion(
+            observed_continuous=obs.continuous,
+            observed_cat=obs.categorical,
+            row_mask=jnp.asarray([True]),
+        )
+        near = kernels.MixedFeatures(
+            jnp.asarray([[0.55, 0.5]], jnp.float32), jnp.zeros((1, 0), jnp.int32)
+        )
+        far = kernels.MixedFeatures(
+            jnp.asarray([[0.0, 1.0]], jnp.float32), jnp.zeros((1, 0), jnp.int32)
+        )
+        assert float(tr.penalty(near)[0]) == 0.0
+        assert float(tr.penalty(far)[0]) > 0.0
+
+    def test_no_observations_no_penalty(self):
+        tr = acquisitions.TrustRegion(
+            observed_continuous=jnp.zeros((4, 2), jnp.float32),
+            observed_cat=jnp.zeros((4, 0), jnp.int32),
+            row_mask=jnp.zeros((4,), bool),
+        )
+        q = kernels.MixedFeatures(
+            jnp.asarray([[0.9, 0.9]], jnp.float32), jnp.zeros((1, 0), jnp.int32)
+        )
+        assert float(tr.penalty(q)[0]) == 0.0
+
+
+def _quadratic_score(feats: kernels.MixedFeatures):
+    """Max at continuous=(0.7, 0.3), categorical=[1]."""
+    target = jnp.asarray([0.7, 0.3])
+    score = -jnp.sum((feats.continuous - target) ** 2, axis=-1)
+    if feats.categorical.shape[-1]:
+        score = score + 0.5 * (feats.categorical[:, 0] == 1)
+    return score
+
+
+class TestVectorizedOptimizers:
+    def test_random_strategy_finds_region(self):
+        result = vectorized_lib.optimize_random(
+            _quadratic_score,
+            jax.random.PRNGKey(0),
+            num_continuous=2,
+            category_sizes=(3,),
+            count=1,
+            max_evaluations=4000,
+        )
+        best = np.asarray(result.features.continuous[0])
+        assert np.abs(best - [0.7, 0.3]).max() < 0.15
+        assert int(result.features.categorical[0, 0]) == 1
+
+    def test_eagle_beats_random_budget_for_budget(self):
+        budget = 2500
+        rand = vectorized_lib.optimize_random(
+            _quadratic_score,
+            jax.random.PRNGKey(1),
+            num_continuous=2,
+            category_sizes=(3,),
+            count=1,
+            max_evaluations=budget,
+        )
+        strategy = eagle_lib.VectorizedEagleStrategy(
+            num_continuous=2, category_sizes=(3,)
+        )
+        eagle = vectorized_lib.VectorizedOptimizer(strategy, max_evaluations=budget)(
+            _quadratic_score, jax.random.PRNGKey(1), count=1
+        )
+        assert float(eagle.scores[0]) >= float(rand.scores[0]) - 1e-6
+        assert float(eagle.scores[0]) > 0.49  # ~optimum is 0.5
+
+    def test_eagle_topk_sorted_and_count(self):
+        strategy = eagle_lib.VectorizedEagleStrategy(num_continuous=2, category_sizes=())
+        res = vectorized_lib.VectorizedOptimizer(strategy, max_evaluations=1000)(
+            _quadratic_score, jax.random.PRNGKey(2), count=5
+        )
+        scores = np.asarray(res.scores)
+        assert len(scores) == 5
+        assert (np.diff(scores) <= 1e-9).all()
+
+    def test_prior_features_seed_pool(self):
+        strategy = eagle_lib.VectorizedEagleStrategy(num_continuous=2, category_sizes=())
+        prior = kernels.MixedFeatures(
+            jnp.asarray([[0.7, 0.3]], jnp.float32), jnp.zeros((1, 0), jnp.int32)
+        )
+        res = vectorized_lib.VectorizedOptimizer(strategy, max_evaluations=200)(
+            _quadratic_score, jax.random.PRNGKey(3), count=1, prior_features=prior
+        )
+        assert float(res.scores[0]) > -0.01  # prior point is already optimal
+
+
+class TestGPBandit:
+    def _problem(self):
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", -1.0, 1.0)
+        p.search_space.root.add_float_param("y", -1.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        return p
+
+    def test_seeding_before_enough_trials(self):
+        designer = VizierGPBandit(self._problem(), num_seed_trials=3, ard_optimizer=_FAST_ARD)
+        suggestions = designer.suggest(3)
+        assert len(suggestions) == 3
+        # First-ever suggestion is the search-space center.
+        assert suggestions[0].parameters.get_value("x") == pytest.approx(0.0)
+
+    def test_converges_on_sphere(self):
+        problem = self._problem()
+
+        def f(params):
+            return -((params.get_value("x") - 0.4) ** 2 + (params.get_value("y")) ** 2)
+
+        designer = VizierGPBandit(
+            problem, max_acquisition_evaluations=1500, ard_restarts=4, ard_optimizer=_FAST_ARD
+        )
+        tid = 0
+        best = -np.inf
+        for _ in range(9):
+            batch = designer.suggest(2)
+            done = []
+            for s in batch:
+                tid += 1
+                t = s.to_trial(tid)
+                t.complete(vz.Measurement(metrics={"obj": f(s.parameters)}))
+                best = max(best, f(s.parameters))
+                done.append(t)
+            designer.update(core_lib.CompletedTrials(done))
+        assert best > -0.05  # found the neighborhood of (0.4, 0)
+
+    def test_mixed_space_smoke(self):
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", 0.0, 1.0)
+        p.search_space.root.add_categorical_param("c", ["u", "v", "w"])
+        p.search_space.root.add_int_param("i", 1, 4)
+        p.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        designer = VizierGPBandit(
+            p, max_acquisition_evaluations=500, num_seed_trials=2, ard_optimizer=_FAST_ARD
+        )
+        trials = test_runners.RandomMetricsRunner(
+            p, iters=4, batch_size=2, seed=1
+        ).run_designer(designer)
+        assert len(trials) == 8
+
+    def test_predict_and_metadata(self):
+        problem = self._problem()
+        designer = VizierGPBandit(
+            problem, max_acquisition_evaluations=500, ard_restarts=2, ard_optimizer=_FAST_ARD
+        )
+        trials = []
+        rng = np.random.default_rng(0)
+        for i in range(6):
+            t = vz.Trial(
+                id=i + 1,
+                parameters={"x": float(rng.uniform(-1, 1)), "y": float(rng.uniform(-1, 1))},
+            )
+            t.complete(vz.Measurement(metrics={"obj": float(rng.uniform())}))
+            trials.append(t)
+        designer.update(core_lib.CompletedTrials(trials))
+        suggestions = designer.suggest(2)
+        assert len(suggestions) == 2
+        for s in suggestions:
+            assert "acquisition" in s.metadata.ns("gp_bandit")
+        pred = designer.predict(suggestions)
+        assert pred.mean.shape == (2,) and pred.stddev.shape == (2,)
+        assert (pred.stddev > 0).all()
+
+    def test_infeasible_trials_handled(self):
+        problem = self._problem()
+        designer = VizierGPBandit(
+            problem, max_acquisition_evaluations=500, ard_restarts=2, ard_optimizer=_FAST_ARD
+        )
+        trials = []
+        rng = np.random.default_rng(0)
+        for i in range(6):
+            t = vz.Trial(
+                id=i + 1,
+                parameters={"x": float(rng.uniform(-1, 1)), "y": float(rng.uniform(-1, 1))},
+            )
+            if i % 3 == 0:
+                t.complete(infeasibility_reason="failed")
+            else:
+                t.complete(vz.Measurement(metrics={"obj": float(rng.uniform())}))
+            trials.append(t)
+        designer.update(core_lib.CompletedTrials(trials))
+        assert len(designer.suggest(1)) == 1
+
+    def test_conditional_space_rejected(self):
+        p = vz.ProblemStatement()
+        sel = p.search_space.root.add_categorical_param("m", ["a", "b"])
+        sel.select_values(["a"]).add_float_param("x", 0, 1)
+        p.metric_information.append(vz.MetricInformation(name="obj"))
+        with pytest.raises(ValueError):
+            VizierGPBandit(p)

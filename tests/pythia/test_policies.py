@@ -139,3 +139,55 @@ class TestEarlyStopEmptyIds:
         decisions = supporter.EarlyStopTrials(policy)  # no ids given
         assert len(decisions.decisions) == 3
         assert sum(d.should_stop for d in decisions.decisions) == 1
+
+
+class TestReviewRegressions2:
+    """Regressions from the fourth code review."""
+
+    def test_add_trials_copies(self):
+        main = pythia.InRamPolicySupporter(_study_config())
+        prior = pythia.InRamPolicySupporter(_study_config(), study_guid="prior")
+        prior.AddTrials([vz.Trial(parameters={"lineardouble": 0.5})])
+        original_ids = [t.id for t in prior.trials]
+        main.AddTrials([vz.Trial(parameters={"lineardouble": 0.1})])
+        main.AddTrials(prior.trials)
+        assert [t.id for t in prior.trials] == original_ids
+
+    def test_serializable_designer_without_load_falls_back(self):
+        from vizier_tpu.algorithms import core as core_lib
+        from vizier_tpu.pyvizier import common
+        from vizier_tpu.utils import serializable as ser
+
+        class RecoverOnly(core_lib.SerializableDesigner):
+            def __init__(self, space):
+                self._space = space
+
+            @classmethod
+            def recover(cls, metadata):
+                raise ser.DecodeError("always fails")
+
+            def dump(self):
+                md = common.Metadata()
+                md["k"] = "v"
+                return md
+
+            def update(self, completed, all_active=core_lib.ActiveTrials()):
+                pass
+
+            def suggest(self, count=None):
+                from vizier_tpu.designers import random as rd
+                import numpy as np
+
+                rng = np.random.default_rng(0)
+                return [
+                    vz.TrialSuggestion(parameters=rd.sample_point(self._space, rng))
+                    for _ in range(count or 1)
+                ]
+
+        supporter = pythia.InRamPolicySupporter(_study_config())
+        factory = lambda p, **kw: RecoverOnly(p.search_space)
+        policy1 = alg.SerializableDesignerPolicy(supporter, factory)
+        assert len(supporter.SuggestTrials(policy1, 2)) == 2
+        # Second policy: stored state exists, recover raises -> replay fallback.
+        policy2 = alg.SerializableDesignerPolicy(supporter, factory)
+        assert len(supporter.SuggestTrials(policy2, 2)) == 2

@@ -177,7 +177,19 @@ class PartiallySerializableDesignerPolicy(_SerializableDesignerPolicyBase):
                         md[k] = v
                 except (ValueError, TypeError) as e:
                     raise serializable.DecodeError(str(e))
-            designer.load(md)  # type: ignore[attr-defined]
+            try:
+                if hasattr(designer, "load"):
+                    designer.load(md)
+                elif hasattr(type(designer), "recover"):
+                    designer = type(designer).recover(md)
+                else:
+                    raise serializable.DecodeError(
+                        f"{type(designer).__name__} implements neither load nor recover."
+                    )
+            except serializable.DecodeError:
+                raise
+            except Exception as e:  # bad stored state must degrade to replay
+                raise serializable.DecodeError(str(e))
         return designer
 
     def _dump_designer(self, designer) -> common.Metadata:

@@ -1,0 +1,217 @@
+"""Acquisition functions and the trust region.
+
+Parity with
+``/root/reference/vizier/_src/algorithms/designers/gp/acquisitions.py``
+(UCB/LCB/EI/PI/Sample at ``:177-300``, q-variants ``:496-569``, TrustRegion
+``:691``), rebuilt as stateless jax functions over posterior (mean, stddev)
+so they fuse into the vectorized optimizer's scoring graph on device.
+All-MAXIMIZE convention (labels are pre-flipped by the converters).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Protocol, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from vizier_tpu.models import gp as gp_lib
+from vizier_tpu.models import kernels
+
+Array = jax.Array
+
+_NORM_CONST = 0.3989422804014327  # 1/sqrt(2*pi)
+
+
+def _norm_pdf(z: Array) -> Array:
+    return _NORM_CONST * jnp.exp(-0.5 * z * z)
+
+
+def _norm_cdf(z: Array) -> Array:
+    return 0.5 * (1.0 + jax.scipy.special.erf(z / jnp.sqrt(2.0)))
+
+
+class Acquisition(Protocol):
+    def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
+        ...
+
+
+@flax.struct.dataclass
+class UCB:
+    """Upper confidence bound: mean + c·stddev."""
+
+    coefficient: float = flax.struct.field(pytree_node=False, default=1.8)
+
+    def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
+        del best_label
+        return mean + self.coefficient * stddev
+
+
+@flax.struct.dataclass
+class LCB:
+    coefficient: float = flax.struct.field(pytree_node=False, default=1.8)
+
+    def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
+        del best_label
+        return mean - self.coefficient * stddev
+
+
+@flax.struct.dataclass
+class EI:
+    """Expected improvement over the best observed label."""
+
+    def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
+        z = (mean - best_label) / stddev
+        return stddev * (z * _norm_cdf(z) + _norm_pdf(z))
+
+
+@flax.struct.dataclass
+class LogEI:
+    """Numerically-robust log(EI); same argmax as EI, better gradients."""
+
+    def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
+        z = (mean - best_label) / stddev
+        # log(s*(z Φ(z)+φ(z))). For very negative z use the asymptotic
+        # log φ(z) - log(z²) tail to avoid log(0).
+        body = z * _norm_cdf(z) + _norm_pdf(z)
+        safe = jnp.log(jnp.maximum(body, 1e-30)) + jnp.log(stddev)
+        tail = (
+            -0.5 * z * z
+            - jnp.log(jnp.maximum(z * z - 1.0, 1.0))
+            + jnp.log(stddev)
+            - 0.5 * jnp.log(2.0 * jnp.pi)
+        )
+        return jnp.where(z > -6.0, safe, tail)
+
+
+@flax.struct.dataclass
+class PI:
+    """Probability of improvement."""
+
+    def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
+        return _norm_cdf((mean - best_label) / stddev)
+
+
+@flax.struct.dataclass
+class PE:
+    """Pure exploration: maximize posterior stddev (GP-UCB-PE batches)."""
+
+    def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
+        del mean, best_label
+        return stddev
+
+
+@flax.struct.dataclass
+class Sample:
+    """Thompson sampling via one marginal posterior sample."""
+
+    seed: Array
+
+    def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
+        del best_label
+        eps = jax.random.normal(self.seed, mean.shape, dtype=mean.dtype)
+        return mean + stddev * eps
+
+
+def q_acquisition(
+    per_member_means: Array,  # [E, M]
+    per_member_stddevs: Array,  # [E, M]
+    rng: Array,
+    *,
+    best_label: Array,
+    num_samples: int = 32,
+    kind: str = "qei",
+) -> Array:
+    """Monte-Carlo q-style score per point: E[max(improvement, 0)] etc.
+
+    Used for parallel-batch (q) acquisitions: samples fantasize over member
+    × posterior draws (parity with QEI/QUCB, ``acquisitions.py:496-569``).
+    """
+    e, m = per_member_means.shape
+    eps = jax.random.normal(rng, (num_samples, e, m), dtype=per_member_means.dtype)
+    draws = per_member_means[None] + per_member_stddevs[None] * eps  # [S, E, M]
+    draws = draws.reshape(-1, m)
+    if kind == "qei":
+        return jnp.mean(jnp.maximum(draws - best_label, 0.0), axis=0)
+    if kind == "qpi":
+        return jnp.mean((draws > best_label).astype(draws.dtype), axis=0)
+    if kind == "qucb":
+        mean = jnp.mean(draws, axis=0)
+        return mean + 1.8 * jnp.std(draws, axis=0)
+    raise ValueError(f"Unknown q-acquisition {kind!r}.")
+
+
+@flax.struct.dataclass
+class TrustRegion:
+    """L∞ trust region around observed points.
+
+    Parity with the reference ``TrustRegion`` (``acquisitions.py:691``):
+    candidates farther than the trust radius from every observed point are
+    penalized linearly, pushing the acquisition argmax back toward explored
+    space until enough trials justify global moves. The radius grows with
+    the number of observed trials.
+    """
+
+    observed_continuous: Array  # [N, Dc] scaled features
+    observed_cat: Array  # [N, Ds]
+    row_mask: Array  # [N]
+    min_radius: float = flax.struct.field(pytree_node=False, default=0.2)
+    penalty_weight: float = flax.struct.field(pytree_node=False, default=30.0)
+
+    @classmethod
+    def from_data(cls, data: gp_lib.GPData, **kwargs) -> "TrustRegion":
+        return cls(
+            observed_continuous=data.continuous,
+            observed_cat=data.categorical,
+            row_mask=data.row_mask,
+            **kwargs,
+        )
+
+    def trust_radius(self) -> Array:
+        n = jnp.sum(self.row_mask.astype(jnp.float32))
+        dim = self.observed_continuous.shape[-1] + self.observed_cat.shape[-1]
+        # 0.2 → 1.0 as observations accumulate relative to dimension.
+        grow = 0.1 * n / jnp.maximum(jnp.sqrt(jnp.asarray(dim, jnp.float32)), 1.0)
+        return jnp.minimum(self.min_radius + grow * 0.05, 1.0)
+
+    def linf_distance(self, query: kernels.MixedFeatures) -> Array:
+        """[M] distance to the nearest valid observed point (L∞, mismatches=1)."""
+        qc, qs = query.continuous, query.categorical
+        dc = jnp.abs(qc[:, None, :] - self.observed_continuous[None, :, :])  # [M,N,Dc]
+        if qs.shape[-1]:
+            ds = (qs[:, None, :] != self.observed_cat[None, :, :]).astype(qc.dtype)
+            full = jnp.concatenate([dc, ds], axis=-1)
+        else:
+            full = dc
+        linf = jnp.max(full, axis=-1)  # [M, N]
+        linf = jnp.where(self.row_mask[None, :], linf, jnp.inf)
+        dist = jnp.min(linf, axis=-1)
+        # No observations at all -> everything is trusted.
+        return jnp.where(jnp.isfinite(dist), dist, 0.0)
+
+    def penalty(self, query: kernels.MixedFeatures) -> Array:
+        excess = jnp.maximum(self.linf_distance(query) - self.trust_radius(), 0.0)
+        return self.penalty_weight * excess
+
+
+@flax.struct.dataclass
+class ScoringFunction:
+    """Predictive + acquisition + optional trust region, as one callable.
+
+    This is the function the vectorized optimizer maximizes on device; it is
+    a pytree, so it can be donated/captured by jitted loops.
+    """
+
+    predictive: gp_lib.EnsemblePredictive
+    acquisition: UCB  # any Acquisition pytree
+    best_label: Array
+    trust_region: Optional[TrustRegion] = None
+
+    def score(self, query: kernels.MixedFeatures) -> Array:
+        mean, stddev = self.predictive.predict(query)
+        values = self.acquisition(mean, stddev, self.best_label)
+        if self.trust_region is not None:
+            values = values - self.trust_region.penalty(query)
+        return values

@@ -1,0 +1,284 @@
+"""VizierGPBandit: the flagship TPU-native GP Bayesian-optimization designer.
+
+Parity with ``/root/reference/vizier/_src/algorithms/designers/gp_bandit.py:88``
+("The Vizier GP Bandit Algorithm", arXiv:2408.11527), rebuilt TPU-first:
+
+- quasi-random (+default-point) seeding for the first trials;
+- output warping (half-rank → z-score → infeasible imputation);
+- ARD via multi-restart pure-JAX L-BFGS — one jitted program, restarts
+  vmapped (shardable over the mesh);
+- hyperparameter *ensembles* (top-k restarts) combined as a uniform mixture;
+- UCB/EI acquisition with an L∞ trust region;
+- acquisition maximized by the vectorized Eagle strategy inside a jitted
+  ``fori_loop`` (75k evaluations per suggest, no host round-trips).
+
+Padding keeps jit caches stable as the study grows (``converters.padding``);
+every model-side op is mask-safe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vizier_tpu import types
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.converters import core as converters
+from vizier_tpu.converters import padding as padding_lib
+from vizier_tpu.designers import quasi_random
+from vizier_tpu.designers.gp import acquisitions
+from vizier_tpu.models import gp as gp_lib
+from vizier_tpu.models import kernels
+from vizier_tpu.models import output_warpers
+from vizier_tpu.models import params as params_lib
+from vizier_tpu.optimizers import eagle as eagle_lib
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+from vizier_tpu.optimizers import vectorized as vectorized_lib
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+Array = jax.Array
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "optimizer", "num_restarts", "ensemble_size")
+)
+def _train_gp(
+    model: gp_lib.VizierGaussianProcess,
+    optimizer: lbfgs_lib.LbfgsOptimizer,
+    data: gp_lib.GPData,
+    rng: Array,
+    num_restarts: int,
+    ensemble_size: int,
+) -> gp_lib.GPState:
+    """ARD: restarts → L-BFGS (vmapped) → top-k precomputed posteriors."""
+    coll = model.param_collection()
+    inits = coll.batch_random_init_unconstrained(rng, num_restarts)
+    loss_fn = lambda p: model.neg_log_likelihood(p, data)
+    result = optimizer(loss_fn, inits, best_n=ensemble_size)
+    return jax.vmap(lambda p: model.precompute(p, data))(result.params)
+
+
+@functools.partial(jax.jit, static_argnames=("vec_opt", "count"))
+def _maximize_acquisition(
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    scoring: acquisitions.ScoringFunction,
+    rng: Array,
+    count: int,
+    prior_features: kernels.MixedFeatures,
+) -> vectorized_lib.VectorizedOptimizerResult:
+    return vec_opt(scoring.score, rng, count=count, prior_features=prior_features)
+
+
+@dataclasses.dataclass
+class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
+    """GP-UCB/EI designer over flat (non-conditional) search spaces."""
+
+    problem: base_study_config.ProblemStatement
+    acquisition: str = "ucb"  # 'ucb' | 'ei' | 'pi' | 'pe'
+    ucb_coefficient: float = 1.8
+    num_seed_trials: int = 2
+    ard_restarts: int = lbfgs_lib.DEFAULT_RANDOM_RESTARTS
+    ensemble_size: int = 1
+    max_acquisition_evaluations: int = 75_000
+    use_trust_region: bool = True
+    padding: Optional[padding_lib.PaddingSchedule] = None
+    metric_index: int = 0
+    rng_seed: int = 0
+    # Injectable ARD optimizer (tests swap in a cheaper one; must be hashable).
+    ard_optimizer: Optional[lbfgs_lib.Optimizer] = None
+
+    def __post_init__(self):
+        if self.problem.search_space.is_conditional:
+            raise ValueError("VizierGPBandit requires a flat search space.")
+        if self.problem.search_space.is_empty():
+            raise ValueError("Empty search space.")
+        self._converter = converters.TrialToModelInputConverter.from_problem(
+            self.problem, padding=self.padding
+        )
+        enc = self._converter.encoder
+        self._model = gp_lib.VizierGaussianProcess(
+            num_continuous=enc.num_continuous, num_categorical=enc.num_categorical
+        )
+        self._ard = self.ard_optimizer or lbfgs_lib.LbfgsOptimizer()
+        # The acquisition optimizer works in the (possibly feature-padded)
+        # model space so its candidates match the GP kernel's shapes; padded
+        # dims are masked out of the kernel and sliced off at decode time.
+        pad = self._converter.padding
+        self._cont_width = pad.pad_features(enc.num_continuous)
+        self._cat_width = pad.pad_features(enc.num_categorical)
+        cat_sizes = tuple(enc.category_sizes) + (1,) * (
+            self._cat_width - enc.num_categorical
+        )
+        strategy = eagle_lib.VectorizedEagleStrategy(
+            num_continuous=self._cont_width,
+            category_sizes=cat_sizes,
+        )
+        self._vec_opt = vectorized_lib.VectorizedOptimizer(
+            strategy, max_evaluations=self.max_acquisition_evaluations
+        )
+        self._warper = output_warpers.create_default_warper()
+        self._seeder = quasi_random.QuasiRandomDesigner(
+            self.problem.search_space, seed=self.rng_seed
+        )
+        self._trials: List[trial_.Trial] = []
+        self._rng = jax.random.PRNGKey(self.rng_seed)
+        self._last_predictive: Optional[gp_lib.EnsemblePredictive] = None
+
+    # -- Designer ----------------------------------------------------------
+
+    def update(
+        self,
+        completed: core_lib.CompletedTrials,
+        all_active: core_lib.ActiveTrials = core_lib.ActiveTrials(),
+    ) -> None:
+        del all_active
+        self._trials.extend(completed.trials)
+
+    def _next_rng(self) -> Array:
+        self._rng, out = jax.random.split(self._rng)
+        return out
+
+    def _warped_model_data(self) -> types.ModelData:
+        """Encode + warp labels + pad. Labels leave here all-MAXIMIZE ~N(0,1)."""
+        conv = self._converter
+        raw_labels = conv.metrics.encode(self._trials)  # [N, M], NaN infeasible
+        warped = self._warper(raw_labels[:, self.metric_index])
+        n_pad = conv.padding.pad_trials(len(self._trials))
+        features = conv.to_features(self._trials)
+        labels = types.PaddedArray.from_array(
+            warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
+        )
+        return types.ModelData(features=features, labels=labels)
+
+    def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
+        count = count or 1
+        n = len(self._trials)
+        if n < self.num_seed_trials:
+            return self._seed_suggestions(count)
+
+        data = gp_lib.GPData.from_model_data(self._warped_model_data())
+        states = _train_gp(
+            self._model,
+            self._ard,
+            data,
+            self._next_rng(),
+            self.ard_restarts,
+            self.ensemble_size,
+        )
+        predictive = gp_lib.EnsemblePredictive(states)
+        self._last_predictive = predictive
+
+        best_label = jnp.max(jnp.where(data.row_mask, data.labels, -jnp.inf))
+        acq = self._make_acquisition()
+        trust = (
+            acquisitions.TrustRegion.from_data(data) if self.use_trust_region else None
+        )
+        scoring = acquisitions.ScoringFunction(
+            predictive=predictive,
+            acquisition=acq,
+            best_label=best_label,
+            trust_region=trust,
+        )
+        prior = self._prior_features(data)
+        result = _maximize_acquisition(
+            self._vec_opt, scoring, self._next_rng(), count, prior
+        )
+        cont = np.asarray(result.features.continuous)[:count]
+        cat = np.asarray(result.features.categorical)[:count]
+        scores = np.asarray(result.scores)[:count]
+        suggestions = []
+        for row_cont, row_cat, score in zip(cont, cat, scores):
+            params = self._converter.to_parameters(
+                row_cont[None, : self._converter.encoder.num_continuous],
+                row_cat[None, : self._converter.encoder.num_categorical],
+            )[0]
+            s = trial_.TrialSuggestion(parameters=params)
+            s.metadata.ns("gp_bandit")["acquisition"] = float(score)
+            s.metadata.ns("gp_bandit")["acquisition_kind"] = self.acquisition
+            suggestions.append(s)
+        return suggestions
+
+    # -- pieces ------------------------------------------------------------
+
+    def _make_acquisition(self):
+        if self.acquisition == "ucb":
+            return acquisitions.UCB(self.ucb_coefficient)
+        if self.acquisition == "ei":
+            return acquisitions.EI()
+        if self.acquisition == "pi":
+            return acquisitions.PI()
+        if self.acquisition == "pe":
+            return acquisitions.PE()
+        raise ValueError(f"Unknown acquisition {self.acquisition!r}.")
+
+    def _seed_suggestions(self, count: int) -> List[trial_.TrialSuggestion]:
+        out: List[trial_.TrialSuggestion] = []
+        if not self._trials:
+            from vizier_tpu.algorithms import designer_policy
+
+            out.append(designer_policy.default_suggestion(self.problem))
+        while len(out) < count:
+            out.extend(self._seeder.suggest(count - len(out)))
+        return out[:count]
+
+    def _prior_features(self, data: gp_lib.GPData) -> kernels.MixedFeatures:
+        """Top observed points (by warped label) to seed the eagle pool."""
+        labels = jnp.where(data.row_mask, data.labels, -jnp.inf)
+        k = min(10, data.num_rows)
+        _, idx = jax.lax.top_k(labels, k)
+        return kernels.MixedFeatures(data.continuous[idx], data.categorical[idx])
+
+    # -- Predictor ---------------------------------------------------------
+
+    def predict(
+        self,
+        suggestions: Sequence[trial_.TrialSuggestion],
+        rng: Optional[np.random.Generator] = None,
+        num_samples: Optional[int] = None,
+    ) -> core_lib.Prediction:
+        """Posterior prediction in *warped* label space (all-MAXIMIZE)."""
+        del rng, num_samples
+        predictive = self._require_predictive()
+        feats = self._encode_suggestions(suggestions)
+        mean, stddev = predictive.predict(feats)
+        return core_lib.Prediction(mean=np.asarray(mean), stddev=np.asarray(stddev))
+
+    def _require_predictive(self) -> gp_lib.EnsemblePredictive:
+        if self._last_predictive is None:
+            if len(self._trials) < max(self.num_seed_trials, 1):
+                raise ValueError("Not enough completed trials to predict.")
+            data = gp_lib.GPData.from_model_data(self._warped_model_data())
+            states = _train_gp(
+                self._model,
+                self._ard,
+                data,
+                self._next_rng(),
+                self.ard_restarts,
+                self.ensemble_size,
+            )
+            self._last_predictive = gp_lib.EnsemblePredictive(states)
+        return self._last_predictive
+
+    def _encode_suggestions(
+        self, suggestions: Sequence[trial_.TrialSuggestion]
+    ) -> kernels.MixedFeatures:
+        trials = [s.to_trial(i + 1) for i, s in enumerate(suggestions)]
+        cont, cat = self._converter.encoder.encode(trials)
+        n = len(trials)
+        cont_p = np.zeros((n, self._cont_width), dtype=np.float32)
+        cont_p[:, : cont.shape[1]] = cont
+        cat_p = np.zeros((n, self._cat_width), dtype=np.int32)
+        cat_p[:, : cat.shape[1]] = cat
+        return kernels.MixedFeatures(jnp.asarray(cont_p), jnp.asarray(cat_p))
+
+
+def default_factory(
+    problem: base_study_config.ProblemStatement, seed: Optional[int] = None, **kwargs
+) -> VizierGPBandit:
+    return VizierGPBandit(problem, rng_seed=seed or 0, **kwargs)

@@ -27,11 +27,12 @@ def _axis_values(
         lo, hi = config.bounds
         if lo == hi:
             return [lo]
-        if config.scale_type == pc.ScaleType.LOG and lo > 0:
-            return [
-                float(v) for v in np.exp(np.linspace(np.log(lo), np.log(hi), resolution))
-            ]
-        return [float(v) for v in np.linspace(lo, hi, resolution)]
+        from vizier_tpu.designers import random as random_designer
+
+        return [
+            random_designer.unit_to_double(config, u)
+            for u in np.linspace(0.0, 1.0, resolution)
+        ]
     return list(config.feasible_values)
 
 

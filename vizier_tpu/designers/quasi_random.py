@@ -109,10 +109,9 @@ class QuasiRandomDesigner(core_lib.PartiallySerializableDesigner):
 
     def _to_value(self, config: pc.ParameterConfig, u: float) -> pc.ParameterValueTypes:
         if config.type == pc.ParameterType.DOUBLE:
-            lo, hi = config.bounds
-            if config.scale_type == pc.ScaleType.LOG and lo > 0:
-                return float(np.exp(np.log(lo) + u * (np.log(hi) - np.log(lo))))
-            return float(lo + u * (hi - lo))
+            from vizier_tpu.designers import random as random_designer
+
+            return random_designer.unit_to_double(config, u)
         if config.type == pc.ParameterType.INTEGER:
             lo, hi = config.bounds
             return int(np.clip(int(lo) + int(u * (int(hi) - int(lo) + 1)), int(lo), int(hi)))

@@ -16,15 +16,29 @@ from vizier_tpu.pyvizier import parameter_config as pc
 from vizier_tpu.pyvizier import trial as trial_
 
 
+def unit_to_double(config: pc.ParameterConfig, u: float) -> float:
+    """Maps u ∈ [0, 1] to the parameter's range honoring its scale type.
+
+    Shared by the random/quasi-random/grid samplers so LOG and REVERSE_LOG
+    parameters get the density their scale type promises.
+    """
+    lo, hi = config.bounds
+    if hi <= lo:
+        return float(lo)
+    scale = config.scale_type
+    if scale == pc.ScaleType.LOG and lo > 0:
+        return float(np.exp(np.log(lo) + u * (np.log(hi) - np.log(lo))))
+    if scale == pc.ScaleType.REVERSE_LOG and lo > 0:
+        return float(hi + lo - np.exp(np.log(lo) + (1.0 - u) * (np.log(hi) - np.log(lo))))
+    return float(lo + u * (hi - lo))
+
+
 def sample_parameter(
     config: pc.ParameterConfig, rng: np.random.Generator
 ) -> pc.ParameterValueTypes:
-    """Uniformly samples one feasible value (log-uniform for LOG scale)."""
+    """Uniformly samples one feasible value (scale-aware for DOUBLEs)."""
     if config.type == pc.ParameterType.DOUBLE:
-        lo, hi = config.bounds
-        if config.scale_type == pc.ScaleType.LOG and lo > 0:
-            return float(np.exp(rng.uniform(np.log(lo), np.log(hi))))
-        return float(rng.uniform(lo, hi))
+        return unit_to_double(config, float(rng.uniform()))
     if config.type == pc.ParameterType.INTEGER:
         lo, hi = config.bounds
         return int(rng.integers(int(lo), int(hi) + 1))

@@ -1,0 +1,101 @@
+"""ARD Matern-5/2 kernel over mixed continuous + categorical features.
+
+TPU-first replacement for the reference's TFP kernel stack
+(``FeatureScaledWithCategorical`` over Matern-5/2,
+``/root/reference/vizier/_src/jax/models/tuned_gp_models.py:132-220``):
+pure jax.numpy, batched [N, D] x [M, D] → [N, M], MXU-friendly (the squared
+distance is computed via the ||a||² - 2a·b + ||b||² expansion so the inner
+product rides the systolic array in one matmul).
+
+Categorical features are integer category indices; the ARD distance adds
+(mismatch / lengthscale²) per categorical dimension (the exact-match kernel
+the reference builds from one-hot + feature scaling, but without
+materializing one-hots).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_SQRT5 = 2.2360679774997896
+
+
+def matern52(sq_dist: Array) -> Array:
+    """Matern-5/2 of a *squared* scaled distance."""
+    d = jnp.sqrt(jnp.maximum(sq_dist, 1e-20))
+    return (1.0 + _SQRT5 * d + (5.0 / 3.0) * sq_dist) * jnp.exp(-_SQRT5 * d)
+
+
+_DIRECT_DIST_MAX_DIM = 64
+
+
+def scaled_sq_distance_continuous(
+    x1: Array, x2: Array, length_scales: Array, *, dim_mask: Optional[Array] = None
+) -> Array:
+    """[N, D], [M, D] -> [N, M] sum_d ((x1-x2)/l)^2, optionally dim-masked.
+
+    For D <= 64 (the typical Vizier regime) uses exact elementwise diffs —
+    the ||a||²-2a·b+||b||² MXU expansion suffers f32 cancellation (~1e-3
+    absolute on near-duplicate points), which poisons the Cholesky diagonal.
+    Wide feature spaces fall back to the matmul expansion with clamping.
+    """
+    inv = 1.0 / length_scales
+    if dim_mask is not None:
+        inv = jnp.where(dim_mask, inv, 0.0)
+    a = x1 * inv
+    b = x2 * inv
+    if x1.shape[-1] <= _DIRECT_DIST_MAX_DIM:
+        diff = a[:, None, :] - b[None, :, :]
+        return jnp.sum(diff * diff, axis=-1)
+    a2 = jnp.sum(a * a, axis=-1, keepdims=True)  # [N, 1]
+    b2 = jnp.sum(b * b, axis=-1, keepdims=True).T  # [1, M]
+    cross = jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), precision=jax.lax.Precision.HIGHEST
+    )
+    return jnp.maximum(a2 + b2 - 2.0 * cross, 0.0)
+
+
+def categorical_sq_distance(
+    z1: Array, z2: Array, length_scales: Array, *, dim_mask: Optional[Array] = None
+) -> Array:
+    """[N, S] int, [M, S] int -> [N, M] sum_s mismatch/l_s^2."""
+    if z1.shape[-1] == 0:
+        return jnp.zeros((z1.shape[0], z2.shape[0]), dtype=jnp.float32)
+    inv_sq = 1.0 / (length_scales * length_scales)
+    if dim_mask is not None:
+        inv_sq = jnp.where(dim_mask, inv_sq, 0.0)
+    mismatch = (z1[:, None, :] != z2[None, :, :]).astype(jnp.float32)  # [N, M, S]
+    return jnp.einsum("nms,s->nm", mismatch, inv_sq)
+
+
+class MixedFeatures(NamedTuple):
+    """Plain-array view of model inputs (already scaled/indexed)."""
+
+    continuous: Array  # [N, Dc] float
+    categorical: Array  # [N, Ds] int
+
+
+def matern52_ard(
+    f1: MixedFeatures,
+    f2: MixedFeatures,
+    *,
+    amplitude: Array,
+    continuous_length_scales: Array,
+    categorical_length_scales: Array,
+    continuous_dim_mask: Optional[Array] = None,
+    categorical_dim_mask: Optional[Array] = None,
+) -> Array:
+    """Full mixed-feature ARD Matern-5/2 kernel matrix [N, M]."""
+    sq = scaled_sq_distance_continuous(
+        f1.continuous, f2.continuous, continuous_length_scales, dim_mask=continuous_dim_mask
+    )
+    sq = sq + categorical_sq_distance(
+        f1.categorical, f2.categorical, categorical_length_scales,
+        dim_mask=categorical_dim_mask,
+    )
+    return (amplitude * amplitude) * matern52(sq)

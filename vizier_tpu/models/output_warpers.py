@@ -1,0 +1,123 @@
+"""Label (output) warping for GP robustness.
+
+Parity with
+``/root/reference/vizier/_src/algorithms/designers/gp/output_warpers.py``:
+half-rank gaussianization of the bad tail, z-scoring, and infeasibility
+imputation. Host-side numpy (runs once per suggest on a small vector, before
+padding/device transfer); the GP then sees ~N(0,1) labels, which is what its
+log-normal hyperparameter priors assume.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+from scipy import special
+
+
+class OutputWarper(abc.ABC):
+    """Maps a [N, 1] label column (NaN = infeasible) to warped values."""
+
+    @abc.abstractmethod
+    def warp(self, labels: np.ndarray) -> np.ndarray:
+        ...
+
+    def __call__(self, labels: np.ndarray) -> np.ndarray:
+        labels = np.asarray(labels, dtype=np.float64)
+        squeeze = labels.ndim == 1
+        if squeeze:
+            labels = labels[:, None]
+        out = self.warp(labels)
+        return out[:, 0] if squeeze else out
+
+
+@dataclasses.dataclass
+class HalfRankWarper(OutputWarper):
+    """Gaussianizes the below-median half by rank (robust to bad outliers).
+
+    Values >= median are kept; values below are replaced by
+    ``median + std * Phi^{-1}(quantile)`` so a catastrophically bad trial
+    cannot stretch the GP's length scales. MAXIMIZE convention.
+    """
+
+    def warp(self, labels: np.ndarray) -> np.ndarray:
+        out = labels.copy()
+        for j in range(labels.shape[1]):
+            y = labels[:, j]
+            finite = np.isfinite(y)
+            vals = y[finite]
+            if len(vals) < 2:
+                continue
+            med = np.median(vals)
+            upper = vals[vals >= med]
+            # Robust scale from the good half; fall back to overall std.
+            std = np.std(upper - med)
+            if std <= 1e-12:
+                std = np.std(vals) + 1e-12
+            ranks = np.argsort(np.argsort(vals))  # 0..n-1
+            quantiles = (ranks + 0.5) / len(vals)
+            bad = vals < med
+            mapped = vals.copy()
+            mapped[bad] = med + std * np.sqrt(2.0) * special.erfinv(
+                2.0 * quantiles[bad] - 1.0
+            )
+            out[finite, j] = mapped
+        return out
+
+
+@dataclasses.dataclass
+class ZScoreWarper(OutputWarper):
+    def warp(self, labels: np.ndarray) -> np.ndarray:
+        out = labels.copy()
+        for j in range(labels.shape[1]):
+            y = labels[:, j]
+            finite = np.isfinite(y)
+            if finite.sum() == 0:
+                continue
+            mu = np.mean(y[finite])
+            sigma = np.std(y[finite])
+            if sigma <= 1e-12:
+                sigma = 1.0
+            out[finite, j] = (y[finite] - mu) / sigma
+        return out
+
+
+@dataclasses.dataclass
+class InfeasibleWarper(OutputWarper):
+    """Imputes NaN (infeasible) labels with a value worse than every real one."""
+
+    margin: float = 0.5
+
+    def warp(self, labels: np.ndarray) -> np.ndarray:
+        out = labels.copy()
+        for j in range(labels.shape[1]):
+            y = out[:, j]
+            finite = np.isfinite(y)
+            if finite.sum() == 0:
+                out[:, j] = 0.0
+                continue
+            lo, hi = np.min(y[finite]), np.max(y[finite])
+            span = max(hi - lo, 1.0)
+            out[~finite, j] = lo - self.margin * span
+        return out
+
+
+@dataclasses.dataclass
+class WarperPipeline(OutputWarper):
+    warpers: Sequence[OutputWarper] = ()
+
+    def warp(self, labels: np.ndarray) -> np.ndarray:
+        for w in self.warpers:
+            labels = w.warp(labels)
+        return labels
+
+
+def create_default_warper(*, infeasible: bool = True) -> OutputWarper:
+    """The reference's default pipeline: half-rank → z-score → infeasible."""
+    warpers: List[OutputWarper] = [HalfRankWarper(), ZScoreWarper()]
+    if infeasible:
+        warpers.append(InfeasibleWarper())
+    return WarperPipeline(warpers)

@@ -1,0 +1,258 @@
+"""ARD hyperparameter optimizers: pure-JAX L-BFGS with vmapped restarts.
+
+TPU-first replacement for the reference's scipy-driven L-BFGS-B
+(``/root/reference/vizier/_src/jax/optimizers/jaxopt_wrappers.py:113,234`` and
+``optax_wrappers.py:38``): bounds are handled by the soft-clip
+reparameterization (``models.params``), so plain L-BFGS suffices — the whole
+multi-restart train is ONE jitted XLA program: ``vmap`` over restarts, no
+host round-trips, shardable over the ``restarts`` mesh axis
+(``vizier_tpu.parallel``).
+
+The L-BFGS here is a compact hand-rolled implementation (two-loop recursion
+over fixed-size history buffers + Armijo backtracking line search in a
+bounded ``while_loop``). Library zoom line searches produce enormous XLA
+graphs under vmap; this one keeps compile times in seconds and contains only
+fixed-shape ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple, Optional, Protocol, Tuple
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import optax
+
+from vizier_tpu.models import params as params_lib
+
+Array = jax.Array
+Params = params_lib.Params
+LossFn = Callable[[Params], Array]
+
+DEFAULT_RANDOM_RESTARTS = 8
+
+
+class OptimizeResult(NamedTuple):
+    params: Params  # best (or top-k stacked) unconstrained params
+    losses: Array  # [num_restarts] final losses
+    best_loss: Array
+
+
+class Optimizer(Protocol):
+    """(loss_fn, batched inits) -> best unconstrained params + diagnostics."""
+
+    def __call__(
+        self, loss_fn: LossFn, init_batch: Params, *, best_n: Optional[int] = None
+    ) -> OptimizeResult:
+        ...
+
+
+class _LbfgsState(NamedTuple):
+    x: Array  # [n] current point
+    f: Array  # scalar loss
+    g: Array  # [n] gradient
+    s_hist: Array  # [m, n] position diffs
+    y_hist: Array  # [m, n] gradient diffs
+    rho: Array  # [m] 1 / (s·y)
+    k: Array  # iteration counter (int32)
+    done: Array  # bool convergence flag
+
+
+def _two_loop_direction(state: _LbfgsState, memory: int) -> Array:
+    """H·g via the standard two-loop recursion over the circular history."""
+    q = state.g
+    k = state.k
+    valid_count = jnp.minimum(k, memory)
+
+    def bwd(i, carry):
+        q, alphas = carry
+        # i = 0 is the newest pair.
+        idx = jnp.mod(k - 1 - i, memory)
+        valid = i < valid_count
+        alpha = jnp.where(valid, state.rho[idx] * jnp.dot(state.s_hist[idx], q), 0.0)
+        q = q - jnp.where(valid, alpha, 0.0) * state.y_hist[idx]
+        alphas = alphas.at[i].set(alpha)
+        return q, alphas
+
+    q, alphas = jax.lax.fori_loop(0, memory, bwd, (q, jnp.zeros(memory, q.dtype)))
+
+    # Initial Hessian scaling gamma = s·y / y·y of the newest pair.
+    newest = jnp.mod(k - 1, memory)
+    sy = jnp.dot(state.s_hist[newest], state.y_hist[newest])
+    yy = jnp.dot(state.y_hist[newest], state.y_hist[newest])
+    gamma = jnp.where((k > 0) & (yy > 1e-20), sy / yy, 1.0)
+    r = gamma * q
+
+    def fwd(i, r):
+        # Reverse order: oldest first = i counts from the back.
+        j = memory - 1 - i
+        idx = jnp.mod(k - 1 - j, memory)
+        valid = j < valid_count
+        beta = jnp.where(valid, state.rho[idx] * jnp.dot(state.y_hist[idx], r), 0.0)
+        return r + jnp.where(valid, alphas[j] - beta, 0.0) * state.s_hist[idx]
+
+    return jax.lax.fori_loop(0, memory, fwd, r)
+
+
+def lbfgs_minimize(
+    loss_fn: Callable[[Array], Array],
+    x0: Array,
+    *,
+    maxiter: int = 50,
+    memory: int = 10,
+    max_linesearch_steps: int = 20,
+    gtol: float = 1e-5,
+    armijo_c1: float = 1e-4,
+) -> Tuple[Array, Array]:
+    """Minimizes a flat-vector loss; returns (x, f(x)). jit/vmap-safe."""
+    value_and_grad = jax.value_and_grad(loss_fn)
+    f0, g0 = value_and_grad(x0)
+    n = x0.shape[0]
+    init = _LbfgsState(
+        x=x0,
+        f=f0,
+        g=g0,
+        s_hist=jnp.zeros((memory, n), x0.dtype),
+        y_hist=jnp.zeros((memory, n), x0.dtype),
+        rho=jnp.zeros((memory,), x0.dtype),
+        k=jnp.asarray(0, jnp.int32),
+        done=jnp.asarray(False),
+    )
+
+    def cond(state: _LbfgsState) -> Array:
+        return (state.k < maxiter) & ~state.done
+
+    def step(state: _LbfgsState) -> _LbfgsState:
+        d = -_two_loop_direction(state, memory)
+        # Fall back to steepest descent if d is not a descent direction.
+        gd = jnp.dot(state.g, d)
+        bad = (gd >= 0.0) | ~jnp.isfinite(gd)
+        d = jnp.where(bad, -state.g, d)
+        gd = jnp.where(bad, -jnp.dot(state.g, state.g), gd)
+
+        # Armijo backtracking: t <- t/2 until sufficient decrease.
+        def ls_cond(carry):
+            t, f_new, i = carry
+            insufficient = f_new > state.f + armijo_c1 * t * gd
+            return (insufficient | ~jnp.isfinite(f_new)) & (i < max_linesearch_steps)
+
+        def ls_body(carry):
+            t, _, i = carry
+            t = t * 0.5
+            return t, loss_fn(state.x + t * d), i + 1
+
+        t0 = jnp.asarray(1.0, state.x.dtype)
+        t, f_new, _ = jax.lax.while_loop(
+            ls_cond, ls_body, (t0, loss_fn(state.x + t0 * d), jnp.asarray(0))
+        )
+        accepted = jnp.isfinite(f_new) & (f_new <= state.f)
+        x_new = jnp.where(accepted, state.x + t * d, state.x)
+        f_new = jnp.where(accepted, f_new, state.f)
+        g_new = jnp.where(accepted, value_and_grad(x_new)[1], state.g)
+
+        s = x_new - state.x
+        y = g_new - state.g
+        sy = jnp.dot(s, y)
+        slot = jnp.mod(state.k, memory)
+        update_hist = accepted & (sy > 1e-10)
+        s_hist = jnp.where(
+            update_hist, state.s_hist.at[slot].set(s), state.s_hist
+        )
+        y_hist = jnp.where(
+            update_hist, state.y_hist.at[slot].set(y), state.y_hist
+        )
+        rho = jnp.where(
+            update_hist, state.rho.at[slot].set(1.0 / jnp.maximum(sy, 1e-20)), state.rho
+        )
+        converged = jnp.max(jnp.abs(g_new)) < gtol
+        return _LbfgsState(
+            x=x_new,
+            f=f_new,
+            g=g_new,
+            s_hist=s_hist,
+            y_hist=y_hist,
+            rho=rho,
+            k=state.k + 1,
+            done=converged | ~accepted,
+        )
+
+    final = jax.lax.while_loop(cond, step, init)
+    return final.x, final.f
+
+
+def _select_best(finals: Params, losses: Array, best_n: Optional[int]) -> OptimizeResult:
+    losses = jnp.where(jnp.isfinite(losses), losses, jnp.inf)
+    if best_n is None:
+        best = jnp.argmin(losses)
+        best_params = jax.tree_util.tree_map(lambda a: a[best], finals)
+        return OptimizeResult(best_params, losses, losses[best])
+    _, top_idx = jax.lax.top_k(-losses, best_n)
+    top_params = jax.tree_util.tree_map(lambda a: a[top_idx], finals)
+    return OptimizeResult(top_params, losses, losses[top_idx[0]])
+
+
+@dataclasses.dataclass(frozen=True)
+class LbfgsOptimizer:
+    """Multi-restart L-BFGS, fully jitted; ``best_n`` keeps an ensemble."""
+
+    maxiter: int = 50
+    memory_size: int = 10
+    max_linesearch_steps: int = 20
+
+    def __call__(
+        self, loss_fn: LossFn, init_batch: Params, *, best_n: Optional[int] = None
+    ) -> OptimizeResult:
+        template = jax.tree_util.tree_map(lambda a: a[0], init_batch)
+        _, unravel = jax.flatten_util.ravel_pytree(template)
+
+        def flat_loss(x: Array) -> Array:
+            return loss_fn(unravel(x))
+
+        def run_one(init: Params) -> Tuple[Params, Array]:
+            x0, _ = jax.flatten_util.ravel_pytree(init)
+            x, f = lbfgs_minimize(
+                flat_loss,
+                x0,
+                maxiter=self.maxiter,
+                memory=self.memory_size,
+                max_linesearch_steps=self.max_linesearch_steps,
+            )
+            return unravel(x), f
+
+        finals, losses = jax.vmap(run_one)(init_batch)
+        return _select_best(finals, losses, best_n)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdamOptimizer:
+    """Adam fallback (parity with the reference's OptaxTrain wrapper)."""
+
+    learning_rate: float = 5e-2
+    maxiter: int = 200
+
+    def __call__(
+        self, loss_fn: LossFn, init_batch: Params, *, best_n: Optional[int] = None
+    ) -> OptimizeResult:
+        opt = optax.adam(self.learning_rate)
+
+        def run_single(init: Params) -> Tuple[Params, Array]:
+            def step(carry, _):
+                prms, state = carry
+                value, grad = jax.value_and_grad(loss_fn)(prms)
+                updates, state = opt.update(grad, state, prms)
+                prms = optax.apply_updates(prms, updates)
+                return (prms, state), value
+
+            (final, _), _ = jax.lax.scan(
+                step, (init, opt.init(init)), None, length=self.maxiter
+            )
+            return final, loss_fn(final)
+
+        finals, losses = jax.vmap(run_single)(init_batch)
+        return _select_best(finals, losses, best_n)
+
+
+def default_optimizer() -> Optimizer:
+    return LbfgsOptimizer()

@@ -1,0 +1,167 @@
+"""Vectorized acquisition optimizer: a jitted ask-evaluate-tell loop.
+
+Parity with the reference ``VectorizedOptimizer``
+(``/root/reference/vizier/_src/algorithms/optimizers/vectorized_base.py:279``):
+a strategy proposes candidate batches, the scoring function evaluates them on
+device, the strategy updates, and a running top-k of the best candidates is
+maintained — all inside one ``jax.lax.fori_loop`` under jit (75k evaluations
+per suggest by default, zero host round-trips). The candidate batch axis is
+the natural ``shard_map`` axis for multi-chip acquisition sweeps
+(``vizier_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple, Optional, Protocol, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from vizier_tpu.models import kernels
+
+Array = jax.Array
+
+# (features) -> [B] scores. Must be jit-traceable.
+ScoreFn = Callable[[kernels.MixedFeatures], Array]
+
+
+class VectorizedStrategy(Protocol):
+    """Ask/tell strategy over scaled feature space [0,1]^Dc × categories."""
+
+    def init_state(self, rng: Array, *, prior_features: Optional[kernels.MixedFeatures]):
+        ...
+
+    def suggest(self, state, rng: Array) -> kernels.MixedFeatures:
+        ...
+
+    def update(self, state, rng: Array, candidates: kernels.MixedFeatures, scores: Array):
+        ...
+
+    @property
+    def batch_size(self) -> int:
+        ...
+
+
+class VectorizedOptimizerResult(NamedTuple):
+    features: kernels.MixedFeatures  # top-k candidates [K, ...]
+    scores: Array  # [K]
+
+
+@dataclasses.dataclass(frozen=True)
+class VectorizedOptimizer:
+    """Runs a strategy for ``max_evaluations`` scores, keeps the top-k."""
+
+    strategy: VectorizedStrategy
+    max_evaluations: int = 75_000
+
+    def __call__(
+        self,
+        score_fn: ScoreFn,
+        rng: Array,
+        *,
+        count: int = 1,
+        prior_features: Optional[kernels.MixedFeatures] = None,
+    ) -> VectorizedOptimizerResult:
+        strategy = self.strategy
+        batch = strategy.batch_size
+        iterations = max(self.max_evaluations // batch, 1)
+
+        rng, init_rng = jax.random.split(rng)
+        state = strategy.init_state(init_rng, prior_features=prior_features)
+
+        def body(i, carry):
+            state, best_feats, best_scores, rng = carry
+            rng, s_rng, u_rng = jax.random.split(rng, 3)
+            candidates = strategy.suggest(state, s_rng)
+            scores = score_fn(candidates)
+            scores = jnp.where(jnp.isfinite(scores), scores, -jnp.inf)
+            state = strategy.update(state, u_rng, candidates, scores)
+            # Merge into running top-k.
+            all_scores = jnp.concatenate([best_scores, scores])
+            all_cont = jnp.concatenate([best_feats.continuous, candidates.continuous])
+            all_cat = jnp.concatenate([best_feats.categorical, candidates.categorical])
+            top_scores, idx = jax.lax.top_k(all_scores, count)
+            new_best = kernels.MixedFeatures(all_cont[idx], all_cat[idx])
+            return state, new_best, top_scores, rng
+
+        # Initialize the top-k buffer with the right static shapes.
+        probe = strategy.suggest(state, rng)
+        best_feats = kernels.MixedFeatures(
+            jnp.zeros((count,) + probe.continuous.shape[1:], probe.continuous.dtype),
+            jnp.zeros((count,) + probe.categorical.shape[1:], probe.categorical.dtype),
+        )
+        best_scores = jnp.full((count,), -jnp.inf, dtype=jnp.float32)
+
+        state, best_feats, best_scores, _ = jax.lax.fori_loop(
+            0, iterations, body, (state, best_feats, best_scores, rng)
+        )
+        return VectorizedOptimizerResult(best_feats, best_scores)
+
+
+@flax.struct.dataclass
+class _RandomState:
+    num_continuous: int = flax.struct.field(pytree_node=False)
+    num_categorical: int = flax.struct.field(pytree_node=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomVectorizedStrategy:
+    """Uniform random search under the vectorized interface.
+
+    Parity with ``random_vectorized_optimizer.py:146``.
+    """
+
+    num_continuous: int
+    num_categorical: int
+    category_sizes: Tuple[int, ...]
+    suggestion_batch_size: int = 64
+
+    @property
+    def batch_size(self) -> int:
+        return self.suggestion_batch_size
+
+    def init_state(self, rng, *, prior_features=None):
+        del rng, prior_features
+        return _RandomState(self.num_continuous, self.num_categorical)
+
+    def suggest(self, state, rng: Array) -> kernels.MixedFeatures:
+        del state
+        c_rng, s_rng = jax.random.split(rng)
+        cont = jax.random.uniform(
+            c_rng, (self.suggestion_batch_size, self.num_continuous), dtype=jnp.float32
+        )
+        if self.num_categorical:
+            sizes = jnp.asarray(self.category_sizes, dtype=jnp.int32)
+            u = jax.random.uniform(
+                s_rng, (self.suggestion_batch_size, self.num_categorical)
+            )
+            cat = jnp.minimum((u * sizes[None, :]).astype(jnp.int32), sizes[None, :] - 1)
+        else:
+            cat = jnp.zeros((self.suggestion_batch_size, 0), dtype=jnp.int32)
+        return kernels.MixedFeatures(cont, cat)
+
+    def update(self, state, rng, candidates, scores):
+        del rng, candidates, scores
+        return state
+
+
+def optimize_random(
+    score_fn: ScoreFn,
+    rng: Array,
+    *,
+    num_continuous: int,
+    category_sizes: Tuple[int, ...],
+    count: int = 1,
+    max_evaluations: int = 10_000,
+) -> VectorizedOptimizerResult:
+    """Convenience: random-search acquisition maximization."""
+    strategy = RandomVectorizedStrategy(
+        num_continuous=num_continuous,
+        num_categorical=len(category_sizes),
+        category_sizes=tuple(category_sizes),
+    )
+    return VectorizedOptimizer(strategy, max_evaluations=max_evaluations)(
+        score_fn, rng, count=count
+    )

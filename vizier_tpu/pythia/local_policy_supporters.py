@@ -101,8 +101,13 @@ class InRamPolicySupporter(policy_supporter.PolicySupporter):
     # -- service-like operations ------------------------------------------
 
     def AddTrials(self, trials: Sequence[trial_.Trial]) -> None:
-        """Adds externally-built trials, assigning fresh ids."""
+        """Adds copies of externally-built trials, assigning fresh ids.
+
+        Copies, so transferring a prior study's trials cannot rewrite the
+        prior study's ids in place.
+        """
         for t in trials:
+            t = copy.deepcopy(t)
             t.id = len(self._trials) + 1
             self._trials.append(t)
 
