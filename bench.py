@@ -1,0 +1,104 @@
+"""Flagship benchmark: GP-UCB suggest() latency at 1000 trials / 20-D.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}``.
+
+The north-star target (BASELINE.md) is suggest() p50 < 1000 ms at 1000
+trials, 20-D, on TPU; ``vs_baseline`` is target_ms / measured_p50 (>1 beats
+the target). The measured step is the full device-side suggest compute:
+output-warped labels → ARD train (multi-restart L-BFGS) → ensemble
+posterior → UCB + trust region → vectorized Eagle sweep (75k evaluations)
+→ top-k candidates, excluding the first-compile run (jit caches are
+reusable across suggests in a real serving process).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from vizier_tpu import types
+    from vizier_tpu.designers.gp import acquisitions
+    from vizier_tpu.models import gp as gp_lib
+    from vizier_tpu.models import kernels
+    from vizier_tpu.models import output_warpers
+    from vizier_tpu.optimizers import eagle as eagle_lib
+    from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+    from vizier_tpu.optimizers import vectorized as vectorized_lib
+    from vizier_tpu.designers.gp_bandit import _maximize_acquisition, _train_gp
+
+    num_trials, dim = 1000, 20
+    n_pad = 1024  # next power-of-2 padding bucket
+    batch_count = 25  # suggestion batch (reference default batch)
+
+    rng = np.random.default_rng(0)
+    x = rng.uniform(size=(num_trials, dim)).astype(np.float32)
+    y_raw = -np.sum((x - 0.5) ** 2, axis=1) + 0.1 * rng.normal(size=num_trials)
+    warped = output_warpers.create_default_warper()(y_raw)
+
+    features = types.ContinuousAndCategorical(
+        continuous=types.PaddedArray.from_array(x, (n_pad, dim)),
+        categorical=types.PaddedArray.from_array(
+            np.zeros((num_trials, 0), np.int32), (n_pad, 0), fill_value=0
+        ),
+    )
+    labels = types.PaddedArray.from_array(
+        warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
+    )
+    data = gp_lib.GPData.from_model_data(types.ModelData(features, labels))
+
+    model = gp_lib.VizierGaussianProcess(num_continuous=dim, num_categorical=0)
+    ard = lbfgs_lib.LbfgsOptimizer(maxiter=50)
+    strategy = eagle_lib.VectorizedEagleStrategy(num_continuous=dim, category_sizes=())
+    vec_opt = vectorized_lib.VectorizedOptimizer(strategy, max_evaluations=75_000)
+
+    def one_suggest(seed: int):
+        key = jax.random.PRNGKey(seed)
+        k_train, k_acq = jax.random.split(key)
+        states = _train_gp(model, ard, data, k_train, 8, 4)
+        predictive = gp_lib.EnsemblePredictive(states)
+        best_label = jax.numpy.max(
+            jax.numpy.where(data.row_mask, data.labels, -jax.numpy.inf)
+        )
+        scoring = acquisitions.ScoringFunction(
+            predictive=predictive,
+            acquisition=acquisitions.UCB(1.8),
+            best_label=best_label,
+            trust_region=acquisitions.TrustRegion.from_data(data),
+        )
+        result = _maximize_acquisition(
+            vec_opt, scoring, k_acq, batch_count,
+            kernels.MixedFeatures(data.continuous[:10], data.categorical[:10]),
+        )
+        jax.block_until_ready(result)
+        return result
+
+    one_suggest(0)  # compile
+    times = []
+    for i in range(1, 6):
+        t0 = time.perf_counter()
+        one_suggest(i)
+        times.append((time.perf_counter() - t0) * 1000.0)
+    p50 = float(np.percentile(times, 50))
+
+    target_ms = 1000.0
+    print(
+        json.dumps(
+            {
+                "metric": "gp_ucb_suggest_p50@1000x20d_75k_evals",
+                "value": round(p50, 1),
+                "unit": "ms",
+                "vs_baseline": round(target_ms / p50, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

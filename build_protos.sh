@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Regenerates the protobuf message stubs (messages only; the thin gRPC
+# method stubs are hand-written in vizier_tpu/service/grpc_stubs.py since
+# grpcio-tools is not available in this image).
+set -euo pipefail
+cd "$(dirname "$0")/vizier_tpu/service/protos"
+protoc --python_out=. key_value.proto study.proto vizier_service.proto pythia_service.proto
+echo "Regenerated $(ls *_pb2.py | wc -l) stub modules."

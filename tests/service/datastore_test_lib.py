@@ -1,0 +1,170 @@
+"""Datastore conformance suite: one behavioral contract, every backend.
+
+Parity with the reference's ``datastore_test_lib.py`` pattern: subclasses
+provide ``make_datastore()`` and inherit every test.
+"""
+
+import pytest
+
+from vizier_tpu.service import datastore as datastore_lib
+from vizier_tpu.service import resources
+from vizier_tpu.service.protos import key_value_pb2, study_pb2, vizier_service_pb2
+
+
+def make_study(owner="o", study="s") -> study_pb2.Study:
+    proto = study_pb2.Study(
+        name=resources.StudyResource(owner, study).name, display_name=study
+    )
+    proto.state = study_pb2.Study.ACTIVE
+    p = proto.study_spec.parameters.add()
+    p.name = "x"
+    p.double_range.min_value = 0.0
+    p.double_range.max_value = 1.0
+    m = proto.study_spec.metrics.add()
+    m.name = "obj"
+    m.goal = study_pb2.MetricSpec.MAXIMIZE
+    proto.study_spec.algorithm = "RANDOM_SEARCH"
+    return proto
+
+
+def make_trial(owner="o", study="s", trial_id=1) -> study_pb2.Trial:
+    proto = study_pb2.Trial(
+        name=resources.StudyResource(owner, study).trial_resource(trial_id).name,
+        id=trial_id,
+        state=study_pb2.Trial.ACTIVE,
+    )
+    a = proto.parameters.add()
+    a.name = "x"
+    a.value.double_value = 0.5
+    return proto
+
+
+class DataStoreConformance:
+    """Mixin: subclasses define ``make_datastore``."""
+
+    def make_datastore(self) -> datastore_lib.DataStore:
+        raise NotImplementedError
+
+    @pytest.fixture
+    def ds(self):
+        return self.make_datastore()
+
+    # -- studies -----------------------------------------------------------
+
+    def test_study_crud(self, ds):
+        study = make_study()
+        assert ds.create_study(study) == study.name
+        loaded = ds.load_study(study.name)
+        assert loaded.study_spec.algorithm == "RANDOM_SEARCH"
+        loaded.study_spec.algorithm = "QUASI_RANDOM_SEARCH"
+        ds.update_study(loaded)
+        assert ds.load_study(study.name).study_spec.algorithm == "QUASI_RANDOM_SEARCH"
+        assert len(ds.list_studies("owners/o")) == 1
+        ds.delete_study(study.name)
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.load_study(study.name)
+
+    def test_create_duplicate_study_rejected(self, ds):
+        ds.create_study(make_study())
+        with pytest.raises(datastore_lib.AlreadyExistsError):
+            ds.create_study(make_study())
+
+    def test_load_missing_study(self, ds):
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.load_study("owners/o/studies/none")
+
+    def test_stored_protos_are_isolated(self, ds):
+        study = make_study()
+        ds.create_study(study)
+        study.study_spec.algorithm = "MUTATED"
+        assert ds.load_study(study.name).study_spec.algorithm == "RANDOM_SEARCH"
+        loaded = ds.load_study(study.name)
+        loaded.study_spec.algorithm = "MUTATED2"
+        assert ds.load_study(study.name).study_spec.algorithm == "RANDOM_SEARCH"
+
+    # -- trials ------------------------------------------------------------
+
+    def test_trial_crud(self, ds):
+        ds.create_study(make_study())
+        t = make_trial(trial_id=1)
+        ds.create_trial(t)
+        assert ds.get_trial(t.name).id == 1
+        t.state = study_pb2.Trial.SUCCEEDED
+        ds.update_trial(t)
+        assert ds.get_trial(t.name).state == study_pb2.Trial.SUCCEEDED
+        ds.create_trial(make_trial(trial_id=2))
+        assert [x.id for x in ds.list_trials("owners/o/studies/s")] == [1, 2]
+        assert ds.max_trial_id("owners/o/studies/s") == 2
+        ds.delete_trial(t.name)
+        assert [x.id for x in ds.list_trials("owners/o/studies/s")] == [2]
+
+    def test_trial_requires_study(self, ds):
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.create_trial(make_trial())
+
+    def test_max_trial_id_empty(self, ds):
+        ds.create_study(make_study())
+        assert ds.max_trial_id("owners/o/studies/s") == 0
+
+    # -- suggestion operations --------------------------------------------
+
+    def test_suggestion_operations(self, ds):
+        ds.create_study(make_study())
+        name = resources.SuggestionOperationResource("o", "s", "client0", 1).name
+        op = vizier_service_pb2.Operation(name=name)
+        ds.create_suggestion_operation(op)
+        assert not ds.get_suggestion_operation(name).done
+        op.done = True
+        ds.update_suggestion_operation(op)
+        assert ds.get_suggestion_operation(name).done
+        assert ds.max_suggestion_operation_number("owners/o/studies/s", "client0") == 1
+        assert ds.max_suggestion_operation_number("owners/o/studies/s", "other") == 0
+        unfinished = ds.list_suggestion_operations(
+            "owners/o/studies/s", "client0", lambda o: not o.done
+        )
+        assert unfinished == []
+
+    # -- early stopping ops ------------------------------------------------
+
+    def test_early_stopping_operations(self, ds):
+        ds.create_study(make_study())
+        ds.create_trial(make_trial(trial_id=1))
+        name = resources.EarlyStoppingOperationResource("o", "s", 1).name
+        op = vizier_service_pb2.EarlyStoppingOperation(name=name, should_stop=True)
+        ds.create_early_stopping_operation(op)
+        assert ds.get_early_stopping_operation(name).should_stop
+        op.status = vizier_service_pb2.EarlyStoppingOperation.DONE
+        ds.update_early_stopping_operation(op)
+        assert (
+            ds.get_early_stopping_operation(name).status
+            == vizier_service_pb2.EarlyStoppingOperation.DONE
+        )
+
+    # -- metadata ----------------------------------------------------------
+
+    def test_update_metadata(self, ds):
+        ds.create_study(make_study())
+        ds.create_trial(make_trial(trial_id=1))
+        study_kv = key_value_pb2.KeyValue(key="k", ns=":a", string_value="v")
+        trial_kv = key_value_pb2.KeyValue(key="tk", ns="", double_value=2.5)
+        ds.update_metadata("owners/o/studies/s", [study_kv], [(1, trial_kv)])
+        study = ds.load_study("owners/o/studies/s")
+        assert study.study_spec.metadata[0].string_value == "v"
+        trial = ds.get_trial("owners/o/studies/s/trials/1")
+        assert trial.metadata[0].double_value == 2.5
+        # Same (ns, key) overwrites rather than duplicating.
+        study_kv2 = key_value_pb2.KeyValue(key="k", ns=":a", string_value="v2")
+        ds.update_metadata("owners/o/studies/s", [study_kv2], [])
+        study = ds.load_study("owners/o/studies/s")
+        assert len(study.study_spec.metadata) == 1
+        assert study.study_spec.metadata[0].string_value == "v2"
+
+    def test_delete_study_cascades(self, ds):
+        ds.create_study(make_study())
+        ds.create_trial(make_trial(trial_id=1))
+        name = resources.SuggestionOperationResource("o", "s", "c", 1).name
+        ds.create_suggestion_operation(vizier_service_pb2.Operation(name=name))
+        ds.delete_study("owners/o/studies/s")
+        ds.create_study(make_study())
+        assert ds.list_trials("owners/o/studies/s") == []
+        assert ds.max_suggestion_operation_number("owners/o/studies/s", "c") == 0

@@ -1,0 +1,33 @@
+"""Runs the datastore conformance suite against both backends."""
+
+from vizier_tpu.service import ram_datastore, sql_datastore
+
+from . import datastore_test_lib
+
+
+class TestRAMDataStore(datastore_test_lib.DataStoreConformance):
+    def make_datastore(self):
+        return ram_datastore.NestedDictRAMDataStore()
+
+
+class TestSQLDataStore(datastore_test_lib.DataStoreConformance):
+    def make_datastore(self):
+        return sql_datastore.SQLDataStore("sqlite:///:memory:")
+
+
+class TestSQLFileDataStore(datastore_test_lib.DataStoreConformance):
+    def make_datastore(self):
+        import tempfile
+        import os
+
+        path = os.path.join(tempfile.mkdtemp(), "vizier.db")
+        return sql_datastore.SQLDataStore(f"sqlite:///{path}")
+
+    def test_persistence_across_connections(self, tmp_path):
+        import os
+
+        url = f"sqlite:///{tmp_path}/persist.db"
+        ds1 = sql_datastore.SQLDataStore(url)
+        ds1.create_study(datastore_test_lib.make_study())
+        ds2 = sql_datastore.SQLDataStore(url)
+        assert ds2.load_study("owners/o/studies/s").display_name == "s"

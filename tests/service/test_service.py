@@ -1,0 +1,501 @@
+"""Service + client tests: in-process, gRPC, distributed, multi-client."""
+
+import threading
+
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.service import clients as clients_lib
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import pythia_service, vizier_client, vizier_service
+from vizier_tpu.service.protos import study_pb2, vizier_service_pb2
+from vizier_tpu.service.vizier_server import DefaultVizierServer, DistributedPythiaVizierServer
+
+
+def _config(algorithm="RANDOM_SEARCH"):
+    config = vz.StudyConfig(algorithm=algorithm)
+    root = config.search_space.root
+    root.add_float_param("x", 0.0, 1.0)
+    root.add_categorical_param("c", ["a", "b"])
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+def _make_servicer():
+    servicer = vizier_service.VizierServicer()
+    pythia = pythia_service.PythiaServicer(servicer)
+    servicer.set_pythia(pythia)
+    return servicer
+
+
+class TestProtoConverters:
+    def test_study_config_roundtrip(self):
+        config = _config()
+        config.search_space.root.add_int_param("n", 1, 5)
+        config.search_space.root.add_discrete_param("d", [0.5, 1.5])
+        sel = config.search_space.root.add_categorical_param("model", ["m1", "m2"])
+        sel.select_values(["m2"]).add_float_param("lr", 1e-4, 1e-1, scale_type=vz.ScaleType.LOG)
+        config.metadata.ns("alg")["state"] = b"\x00\x01"
+        config.metric_information.append(
+            vz.MetricInformation(name="safe", goal=vz.ObjectiveMetricGoal.MINIMIZE, safety_threshold=0.7)
+        )
+        proto = pc.study_config_to_proto(config)
+        back = pc.study_config_from_proto(proto)
+        assert back.search_space.parameter_names() == config.search_space.parameter_names()
+        assert back.search_space.get("model").children[0].name == "lr"
+        assert back.metric_information.get("safe").safety_threshold == 0.7
+        assert back.metadata.ns("alg")["state"] == b"\x00\x01"
+        assert back.algorithm == "RANDOM_SEARCH"
+
+    def test_trial_roundtrip(self):
+        t = vz.Trial(id=3, parameters={"x": 0.25, "c": "b", "n": 2})
+        t.metadata.ns("m")["k"] = "v"
+        t.measurements.append(vz.Measurement(metrics={"obj": 0.5}, steps=1))
+        t.complete(vz.Measurement(metrics={"obj": vz.Metric(0.9, std=0.1)}))
+        back = pc.trial_from_proto(pc.trial_to_proto(t))
+        assert back.id == 3
+        assert back.parameters.get_value("x") == 0.25
+        assert back.parameters.get_value("n") == 2
+        assert back.status == vz.TrialStatus.COMPLETED
+        assert back.final_measurement.metrics["obj"].value == 0.9
+        assert back.final_measurement.metrics["obj"].std == 0.1
+        assert len(back.measurements) == 1
+        assert back.metadata.ns("m")["k"] == "v"
+
+    def test_infeasible_trial_roundtrip(self):
+        t = vz.Trial(id=1)
+        t.complete(infeasibility_reason="nan")
+        back = pc.trial_from_proto(pc.trial_to_proto(t))
+        assert back.infeasible
+        assert back.infeasibility_reason == "nan"
+
+
+class TestVizierServicer:
+    def test_suggest_random(self):
+        servicer = _make_servicer()
+        study = pc.study_to_proto(_config(), "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        op = servicer.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent="owners/o/studies/s", suggestion_count=3, client_id="w0"
+            )
+        )
+        assert op.done and not op.error
+        assert len(op.response.trials) == 3
+        assert all(t.state == study_pb2.Trial.ACTIVE for t in op.response.trials)
+
+    def test_active_trial_reuse_per_client(self):
+        """The worker-failover contract: re-request returns the same trials."""
+        servicer = _make_servicer()
+        study = pc.study_to_proto(_config(), "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        request = vizier_service_pb2.SuggestTrialsRequest(
+            parent="owners/o/studies/s", suggestion_count=2, client_id="w0"
+        )
+        first = servicer.SuggestTrials(request)
+        again = servicer.SuggestTrials(request)
+        assert [t.id for t in first.response.trials] == [
+            t.id for t in again.response.trials
+        ]
+        # A different client gets different trials.
+        other = servicer.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent="owners/o/studies/s", suggestion_count=2, client_id="w1"
+            )
+        )
+        assert set(t.id for t in other.response.trials).isdisjoint(
+            t.id for t in first.response.trials
+        )
+
+    def test_pythia_error_captured_in_operation(self):
+        servicer = _make_servicer()
+        config = _config(algorithm="NO_SUCH_ALGORITHM")
+        study = pc.study_to_proto(config, "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        op = servicer.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent="owners/o/studies/s", suggestion_count=1, client_id="w0"
+            )
+        )
+        assert op.done and op.error
+
+    def test_complete_trial_immutability(self):
+        servicer = _make_servicer()
+        study = pc.study_to_proto(_config(), "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        op = servicer.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent="owners/o/studies/s", suggestion_count=1, client_id="w0"
+            )
+        )
+        name = op.response.trials[0].name
+        request = vizier_service_pb2.CompleteTrialRequest(name=name)
+        request.final_measurement.metrics.add().name = "obj"
+        request.final_measurement.metrics[0].value = 1.0
+        servicer.CompleteTrial(request)
+        with pytest.raises(ValueError):
+            servicer.CompleteTrial(request)
+
+    def test_complete_promotes_last_measurement(self):
+        servicer = _make_servicer()
+        study = pc.study_to_proto(_config(), "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        trial = study_pb2.Trial()
+        created = servicer.CreateTrial(
+            vizier_service_pb2.CreateTrialRequest(
+                parent="owners/o/studies/s", trial=trial
+            )
+        )
+        add = vizier_service_pb2.AddTrialMeasurementRequest(trial_name=created.name)
+        add.measurement.metrics.add().name = "obj"
+        add.measurement.metrics[0].value = 0.7
+        servicer.AddTrialMeasurement(add)
+        done = servicer.CompleteTrial(
+            vizier_service_pb2.CompleteTrialRequest(name=created.name)
+        )
+        assert done.state == study_pb2.Trial.SUCCEEDED
+        assert done.final_measurement.metrics[0].value == 0.7
+
+    def test_complete_without_measurement_is_infeasible(self):
+        servicer = _make_servicer()
+        study = pc.study_to_proto(_config(), "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        created = servicer.CreateTrial(
+            vizier_service_pb2.CreateTrialRequest(
+                parent="owners/o/studies/s", trial=study_pb2.Trial()
+            )
+        )
+        done = servicer.CompleteTrial(
+            vizier_service_pb2.CompleteTrialRequest(name=created.name)
+        )
+        assert done.state == study_pb2.Trial.INFEASIBLE
+
+    def test_list_optimal_trials_single_objective(self):
+        servicer = _make_servicer()
+        study = pc.study_to_proto(_config(), "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        for value in (0.2, 0.9, 0.5):
+            created = servicer.CreateTrial(
+                vizier_service_pb2.CreateTrialRequest(
+                    parent="owners/o/studies/s", trial=study_pb2.Trial()
+                )
+            )
+            request = vizier_service_pb2.CompleteTrialRequest(name=created.name)
+            request.final_measurement.metrics.add().name = "obj"
+            request.final_measurement.metrics[0].value = value
+            servicer.CompleteTrial(request)
+        optimal = servicer.ListOptimalTrials(
+            vizier_service_pb2.ListOptimalTrialsRequest(parent="owners/o/studies/s")
+        )
+        assert len(optimal.optimal_trials) == 1
+        assert optimal.optimal_trials[0].final_measurement.metrics[0].value == 0.9
+
+    def test_list_optimal_trials_pareto(self):
+        config = vz.StudyConfig(algorithm="RANDOM_SEARCH")
+        config.search_space.root.add_float_param("x", 0.0, 1.0)
+        config.metric_information.append(
+            vz.MetricInformation(name="m1", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        config.metric_information.append(
+            vz.MetricInformation(name="m2", goal=vz.ObjectiveMetricGoal.MINIMIZE)
+        )
+        servicer = _make_servicer()
+        study = pc.study_to_proto(config, "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        # (m1, m2): (1, 1) and (2, 2) are non-dominated; (0.5, 3) is dominated.
+        points = [(1.0, 1.0), (2.0, 2.0), (0.5, 3.0)]
+        for m1, m2 in points:
+            created = servicer.CreateTrial(
+                vizier_service_pb2.CreateTrialRequest(
+                    parent="owners/o/studies/s", trial=study_pb2.Trial()
+                )
+            )
+            request = vizier_service_pb2.CompleteTrialRequest(name=created.name)
+            a = request.final_measurement.metrics.add()
+            a.name, a.value = "m1", m1
+            b = request.final_measurement.metrics.add()
+            b.name, b.value = "m2", m2
+            servicer.CompleteTrial(request)
+        optimal = servicer.ListOptimalTrials(
+            vizier_service_pb2.ListOptimalTrialsRequest(parent="owners/o/studies/s")
+        )
+        assert len(optimal.optimal_trials) == 2
+
+    def test_early_stopping_no_config_never_stops(self):
+        servicer = _make_servicer()
+        study = pc.study_to_proto(_config(), "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        op = servicer.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent="owners/o/studies/s", suggestion_count=1, client_id="w0"
+            )
+        )
+        response = servicer.CheckTrialEarlyStoppingState(
+            vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(
+                trial_name=op.response.trials[0].name
+            )
+        )
+        assert response.should_stop is False
+
+    def test_early_stopping_flow(self):
+        servicer = _make_servicer()
+        config = _config()
+        config.automated_stopping_config = vz.AutomatedStoppingConfig()
+        study = pc.study_to_proto(config, "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        op = servicer.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent="owners/o/studies/s", suggestion_count=1, client_id="w0"
+            )
+        )
+        name = op.response.trials[0].name
+        response = servicer.CheckTrialEarlyStoppingState(
+            vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(trial_name=name)
+        )
+        # RandomPolicy stops exactly one of the candidate trials; with a
+        # single candidate it must be this one.
+        assert response.should_stop is True
+
+    def test_update_metadata(self):
+        servicer = _make_servicer()
+        study = pc.study_to_proto(_config(), "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        request = vizier_service_pb2.UpdateMetadataRequest(name="owners/o/studies/s")
+        unit = request.deltas.add()
+        unit.trial_id = 0
+        unit.key_value.key = "k"
+        unit.key_value.ns = ":ns"
+        unit.key_value.string_value = "v"
+        response = servicer.UpdateMetadata(request)
+        assert not response.error_details
+        loaded = servicer.GetStudy(
+            vizier_service_pb2.GetStudyRequest(name="owners/o/studies/s")
+        )
+        assert loaded.study_spec.metadata[0].string_value == "v"
+
+
+class TestClientsInProcess:
+    def setup_method(self):
+        # Fresh local servicer per test.
+        vizier_client._local_servicer = None
+
+    def test_full_loop(self):
+        study = clients_lib.Study.from_study_config(
+            _config(), owner="me", study_id="loop"
+        )
+        for _ in range(2):
+            for trial in study.suggest(count=2):
+                trial.add_measurement(vz.Measurement(metrics={"obj": 0.1}, steps=1))
+                trial.complete(
+                    vz.Measurement(metrics={"obj": trial.parameters["x"]})
+                )
+        trials = list(study.trials())
+        assert len(trials) == 4
+        assert all(t.status == vz.TrialStatus.COMPLETED for t in trials)
+        best = list(study.optimal_trials())
+        assert len(best) == 1
+
+    def test_from_resource_name_and_missing(self):
+        study = clients_lib.Study.from_study_config(
+            _config(), owner="me", study_id="named"
+        )
+        again = clients_lib.Study.from_resource_name(study.resource_name)
+        assert again.resource_name == study.resource_name
+        with pytest.raises(clients_lib.client_abc.ResourceNotFoundError):
+            clients_lib.Study.from_resource_name("owners/me/studies/none")
+
+    def test_materialize_study_config(self):
+        study = clients_lib.Study.from_study_config(
+            _config(), owner="me", study_id="mat"
+        )
+        config = study.materialize_study_config()
+        assert config.search_space.parameter_names() == ["x", "c"]
+
+    def test_trial_filter_and_get(self):
+        study = clients_lib.Study.from_study_config(
+            _config(), owner="me", study_id="filt"
+        )
+        (trial,) = study.suggest(count=1)
+        trial.complete(vz.Measurement(metrics={"obj": 1.0}))
+        completed = study.trials(vz.TrialFilter(status=[vz.TrialStatus.COMPLETED]))
+        assert len(list(completed)) == 1
+        with pytest.raises(clients_lib.client_abc.ResourceNotFoundError):
+            study.get_trial(999)
+
+    def test_study_metadata_update(self):
+        study = clients_lib.Study.from_study_config(
+            _config(), owner="me", study_id="md"
+        )
+        md = vz.Metadata()
+        md.ns("user")["note"] = "hello"
+        study.update_metadata(md)
+        config = study.materialize_study_config()
+        assert config.metadata.ns("user")["note"] == "hello"
+
+
+class TestClientsOverGrpc:
+    def test_grpc_end_to_end(self):
+        server = DefaultVizierServer()
+        try:
+            study = clients_lib.Study.from_study_config(
+                _config(), owner="me", study_id="grpc", endpoint=server.endpoint
+            )
+            for trial in study.suggest(count=2):
+                trial.complete(vz.Measurement(metrics={"obj": trial.parameters["x"]}))
+            assert len(list(study.trials())) == 2
+        finally:
+            server.stop(0)
+
+    def test_distributed_pythia_topology(self):
+        server = DistributedPythiaVizierServer()
+        try:
+            study = clients_lib.Study.from_study_config(
+                _config(), owner="me", study_id="dist", endpoint=server.endpoint
+            )
+            suggestions = study.suggest(count=2)
+            assert len(suggestions) == 2
+        finally:
+            server.stop(0)
+
+
+class TestMultiClientConcurrency:
+    def test_parallel_workers(self):
+        """N workers suggest/complete concurrently against one study."""
+        vizier_client._local_servicer = None
+        study = clients_lib.Study.from_study_config(
+            _config(), owner="me", study_id="conc"
+        )
+        errors = []
+
+        def worker(wid: int):
+            try:
+                for _ in range(3):
+                    for trial in study.suggest(count=1, client_id=f"w{wid}"):
+                        trial.complete(vz.Measurement(metrics={"obj": 0.5}))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        trials = list(study.trials())
+        assert len(trials) == 24
+        assert all(t.status == vz.TrialStatus.COMPLETED for t in trials)
+
+
+class TestReviewRegressions:
+    """Regressions from the fifth code review."""
+
+    def test_default_algorithm_resolves(self):
+        from vizier_tpu.service import policy_factory
+        from vizier_tpu.pythia import local_policy_supporters
+
+        config = _config(algorithm="DEFAULT")
+        supporter = local_policy_supporters.InRamPolicySupporter(config)
+        policy = policy_factory.DefaultPolicyFactory()(
+            config.to_problem(), "DEFAULT", supporter, "s"
+        )
+        trials = supporter.SuggestTrials(policy, 1)
+        assert len(trials) == 1
+
+    def test_orphaned_operation_recovered(self):
+        """A persisted not-done op from a crashed server must not wedge."""
+        import tempfile, os
+
+        url = f"sqlite:///{tempfile.mkdtemp()}/wedge.db"
+        servicer1 = vizier_service.VizierServicer(database_url=url)
+        pythia1 = pythia_service.PythiaServicer(servicer1)
+        servicer1.set_pythia(pythia1)
+        study = pc.study_to_proto(_config(), "owners/o/studies/s")
+        servicer1.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        # Simulate a crash: op persisted not-done.
+        from vizier_tpu.service import resources as res
+
+        dead = vizier_service_pb2.Operation(
+            name=res.SuggestionOperationResource("o", "s", "w0", 1).name
+        )
+        servicer1.datastore.create_suggestion_operation(dead)
+        # "Restarted" server: fresh servicer instance on the same DB.
+        servicer2 = vizier_service.VizierServicer(database_url=url)
+        pythia2 = pythia_service.PythiaServicer(servicer2)
+        servicer2.set_pythia(pythia2)
+        op = servicer2.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent="owners/o/studies/s", suggestion_count=1, client_id="w0"
+            )
+        )
+        assert op.done and not op.error
+        assert len(op.response.trials) == 1
+
+    def test_stale_active_early_stopping_op_recycled(self):
+        import datetime as dt
+
+        servicer = vizier_service.VizierServicer(
+            early_stop_recycle_period=dt.timedelta(seconds=0)
+        )
+        pythia = pythia_service.PythiaServicer(servicer)
+        servicer.set_pythia(pythia)
+        config = _config()
+        config.automated_stopping_config = vz.AutomatedStoppingConfig()
+        study = pc.study_to_proto(config, "owners/o/studies/s")
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+        )
+        op = servicer.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent="owners/o/studies/s", suggestion_count=1, client_id="w0"
+            )
+        )
+        name = op.response.trials[0].name
+        # Plant a stale ACTIVE op.
+        from vizier_tpu.service import resources as res
+
+        stale = vizier_service_pb2.EarlyStoppingOperation(
+            name=res.EarlyStoppingOperationResource("o", "s", 1).name,
+            status=vizier_service_pb2.EarlyStoppingOperation.ACTIVE,
+            creation_time_secs=0.0,
+        )
+        servicer.datastore.create_early_stopping_operation(stale)
+        response = servicer.CheckTrialEarlyStoppingState(
+            vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(trial_name=name)
+        )
+        # Recycled and re-queried (RandomPolicy stops the only candidate).
+        assert response.should_stop is True
+
+    def test_materialize_state_reads_service(self):
+        vizier_client._local_servicer = None
+        study = clients_lib.Study.from_study_config(
+            _config(), owner="me", study_id="state"
+        )
+        assert study.materialize_state() == vz.StudyState.ACTIVE
+        study.set_state(vz.StudyState.COMPLETED)
+        assert study.materialize_state() == vz.StudyState.COMPLETED

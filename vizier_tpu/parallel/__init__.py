@@ -1,0 +1,175 @@
+"""Device-mesh sharding: the ICI data plane the reference never had.
+
+The reference is CPU-single-host inside each Pythia call (SURVEY.md §2.10,
+§5.8); here the three embarrassingly-parallel axes of the GP-bandit suggest
+path shard across a ``jax.sharding.Mesh``:
+
+- **restarts** — ARD L-BFGS random restarts (data-parallel over devices);
+- **ensemble** — GP hyperparameter ensemble members;
+- **pools** — independent Eagle pools of the acquisition sweep (each device
+  runs its own ask-evaluate-tell loop; results merge with one final top-k).
+
+All three are batch axes of already-vmapped jitted programs, so sharding is
+pure ``NamedSharding`` annotation — XLA partitions the programs and inserts
+any collectives over ICI. Gradients/Cholesky stay device-local: zero
+communication inside the hot loops, one gather at the end.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from vizier_tpu.designers.gp import acquisitions
+from vizier_tpu.models import gp as gp_lib
+from vizier_tpu.models import kernels
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+from vizier_tpu.optimizers import vectorized as vectorized_lib
+
+Array = jax.Array
+
+DEVICE_AXIS = "devices"
+
+
+def create_mesh(
+    n_devices: Optional[int] = None, axis_name: str = DEVICE_AXIS
+) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` (default: all) devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"Requested {n_devices} devices but only {len(devices)} exist."
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh):
+    """Leading-axis sharding over the device axis."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+# ---------------------------------------------------------------------------
+# Sharded ARD training: restarts across devices.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "optimizer", "num_restarts", "ensemble_size", "mesh"),
+)
+def train_gp_sharded(
+    model: gp_lib.VizierGaussianProcess,
+    optimizer: lbfgs_lib.Optimizer,
+    data: gp_lib.GPData,
+    rng: Array,
+    num_restarts: int,
+    ensemble_size: int,
+    mesh: Mesh,
+) -> gp_lib.GPState:
+    """Multi-restart ARD with the restart axis sharded over the mesh.
+
+    ``num_restarts`` should be a multiple of the mesh size. Data is
+    replicated (it is small); each device runs its restarts locally; the
+    final top-k selection is the only cross-device reduction.
+    """
+    coll = model.param_collection()
+    inits = coll.batch_random_init_unconstrained(rng, num_restarts)
+    inits = jax.lax.with_sharding_constraint(
+        inits, batch_sharded(mesh)
+    )
+    data = jax.lax.with_sharding_constraint(data, replicated(mesh))
+    loss_fn = lambda p: model.neg_log_likelihood(p, data)
+    result = optimizer(loss_fn, inits, best_n=ensemble_size)
+    return jax.vmap(lambda p: model.precompute(p, data))(result.params)
+
+
+# ---------------------------------------------------------------------------
+# Sharded acquisition sweep: independent eagle pools per device.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("vec_opt", "count", "num_pools", "mesh")
+)
+def maximize_acquisition_sharded(
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    scoring: acquisitions.ScoringFunction,
+    rng: Array,
+    count: int,
+    num_pools: int,
+    mesh: Mesh,
+    prior_features: Optional[kernels.MixedFeatures] = None,
+) -> vectorized_lib.VectorizedOptimizerResult:
+    """Runs ``num_pools`` independent vectorized sweeps, pools sharded.
+
+    Each pool consumes ``vec_opt.max_evaluations`` scores; total work is
+    ``num_pools ×`` that, wall-clock ≈ one pool when num_pools == mesh size.
+    The merge is a single global top-k.
+    """
+    keys = jax.random.split(rng, num_pools)
+    keys = jax.lax.with_sharding_constraint(keys, batch_sharded(mesh))
+    scoring = jax.lax.with_sharding_constraint(scoring, replicated(mesh))
+
+    def run_pool(key: Array) -> vectorized_lib.VectorizedOptimizerResult:
+        return vec_opt(scoring.score, key, count=count, prior_features=prior_features)
+
+    results = jax.vmap(run_pool)(keys)  # [pools, count, ...]
+    flat = num_pools * count  # explicit: -1 breaks on zero-width categorical
+    flat_scores = results.scores.reshape(flat)
+    flat_cont = results.features.continuous.reshape(
+        (flat,) + results.features.continuous.shape[2:]
+    )
+    flat_cat = results.features.categorical.reshape(
+        (flat,) + results.features.categorical.shape[2:]
+    )
+    top_scores, idx = jax.lax.top_k(flat_scores, count)
+    return vectorized_lib.VectorizedOptimizerResult(
+        kernels.MixedFeatures(flat_cont[idx], flat_cat[idx]), top_scores
+    )
+
+
+# ---------------------------------------------------------------------------
+# One fused multi-chip "suggest step" (ARD train + acquisition sweep).
+# ---------------------------------------------------------------------------
+
+
+def suggest_step_sharded(
+    model: gp_lib.VizierGaussianProcess,
+    optimizer: lbfgs_lib.Optimizer,
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    data: gp_lib.GPData,
+    rng: Array,
+    *,
+    count: int,
+    num_restarts: int,
+    ensemble_size: int,
+    mesh: Mesh,
+    ucb_coefficient: float = 1.8,
+) -> vectorized_lib.VectorizedOptimizerResult:
+    """Full GP-bandit compute step over the mesh: train → score → sweep."""
+    train_rng, acq_rng = jax.random.split(rng)
+    states = train_gp_sharded(
+        model, optimizer, data, train_rng, num_restarts, ensemble_size, mesh
+    )
+    predictive = gp_lib.EnsemblePredictive(states)
+    best_label = jnp.max(jnp.where(data.row_mask, data.labels, -jnp.inf))
+    scoring = acquisitions.ScoringFunction(
+        predictive=predictive,
+        acquisition=acquisitions.UCB(ucb_coefficient),
+        best_label=best_label,
+        trust_region=acquisitions.TrustRegion.from_data(data),
+    )
+    return maximize_acquisition_sharded(
+        vec_opt, scoring, acq_rng, count, len(mesh.devices.flat), mesh
+    )

@@ -1,0 +1,4 @@
+"""The Vizier study service: datastores, servicers, servers, clients."""
+
+from vizier_tpu.service import clients
+from vizier_tpu.service.vizier_server import DefaultVizierServer, DistributedPythiaVizierServer

@@ -1,0 +1,133 @@
+"""DataStore ABC + custom errors.
+
+Parity with ``/root/reference/vizier/_src/service/datastore.py:34`` (19
+abstract methods over studies/trials/operations/metadata) and
+``custom_errors.py:20-38``. Implementations: ``ram_datastore`` (dict-based)
+and ``sql_datastore`` (stdlib sqlite3; the environment has no SQLAlchemy —
+plain SQL keeps the dependency surface zero and the semantics identical).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Iterable, List, Optional
+
+from vizier_tpu.service.protos import study_pb2, vizier_service_pb2
+
+
+class NotFoundError(KeyError):
+    """Resource does not exist."""
+
+
+class AlreadyExistsError(ValueError):
+    """Resource already exists."""
+
+
+class DataStore(abc.ABC):
+    """Storage interface for the Vizier service."""
+
+    # -- studies -----------------------------------------------------------
+
+    @abc.abstractmethod
+    def create_study(self, study: study_pb2.Study) -> str:
+        """Stores a new study; returns its resource name."""
+
+    @abc.abstractmethod
+    def load_study(self, study_name: str) -> study_pb2.Study:
+        ...
+
+    @abc.abstractmethod
+    def update_study(self, study: study_pb2.Study) -> str:
+        ...
+
+    @abc.abstractmethod
+    def delete_study(self, study_name: str) -> None:
+        """Deletes the study and all its trials/operations."""
+
+    @abc.abstractmethod
+    def list_studies(self, owner_name: str) -> List[study_pb2.Study]:
+        ...
+
+    # -- trials ------------------------------------------------------------
+
+    @abc.abstractmethod
+    def create_trial(self, trial: study_pb2.Trial) -> str:
+        ...
+
+    @abc.abstractmethod
+    def get_trial(self, trial_name: str) -> study_pb2.Trial:
+        ...
+
+    @abc.abstractmethod
+    def update_trial(self, trial: study_pb2.Trial) -> str:
+        ...
+
+    @abc.abstractmethod
+    def delete_trial(self, trial_name: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def list_trials(self, study_name: str) -> List[study_pb2.Trial]:
+        ...
+
+    @abc.abstractmethod
+    def max_trial_id(self, study_name: str) -> int:
+        ...
+
+    # -- suggestion operations --------------------------------------------
+
+    @abc.abstractmethod
+    def create_suggestion_operation(
+        self, operation: vizier_service_pb2.Operation
+    ) -> str:
+        ...
+
+    @abc.abstractmethod
+    def get_suggestion_operation(
+        self, operation_name: str
+    ) -> vizier_service_pb2.Operation:
+        ...
+
+    @abc.abstractmethod
+    def update_suggestion_operation(
+        self, operation: vizier_service_pb2.Operation
+    ) -> str:
+        ...
+
+    @abc.abstractmethod
+    def list_suggestion_operations(
+        self,
+        study_name: str,
+        client_id: str,
+        filter_fn: Optional[Callable[[vizier_service_pb2.Operation], bool]] = None,
+    ) -> List[vizier_service_pb2.Operation]:
+        ...
+
+    @abc.abstractmethod
+    def max_suggestion_operation_number(self, study_name: str, client_id: str) -> int:
+        ...
+
+    # -- early stopping operations ----------------------------------------
+
+    @abc.abstractmethod
+    def create_early_stopping_operation(self, operation) -> str:
+        """operation: an EarlyStoppingOperation record (see ram_datastore)."""
+
+    @abc.abstractmethod
+    def get_early_stopping_operation(self, operation_name: str):
+        ...
+
+    @abc.abstractmethod
+    def update_early_stopping_operation(self, operation) -> str:
+        ...
+
+    # -- metadata ----------------------------------------------------------
+
+    @abc.abstractmethod
+    def update_metadata(
+        self,
+        study_name: str,
+        study_metadata: Iterable,
+        trial_metadata: Iterable,  # iterable of (trial_id, KeyValue)
+    ) -> None:
+        """Merges metadata into the stored study spec and trials."""

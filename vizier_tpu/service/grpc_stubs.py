@@ -1,0 +1,126 @@
+"""Hand-written gRPC stubs and servicer registration.
+
+grpcio-tools (the service-stub generator) is not in this image; messages are
+protoc-generated (``protos/``) and the thin method tables below provide what
+``*_pb2_grpc.py`` would. Parity with the generated-stub layer the reference
+compiles in ``build_protos.sh``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import grpc
+
+from vizier_tpu.service.protos import pythia_service_pb2, study_pb2, vizier_service_pb2
+
+_V = vizier_service_pb2
+_P = pythia_service_pb2
+
+# method name -> (request class, response class)
+VIZIER_METHODS: Dict[str, Tuple[Any, Any]] = {
+    "CreateStudy": (_V.CreateStudyRequest, study_pb2.Study),
+    "GetStudy": (_V.GetStudyRequest, study_pb2.Study),
+    "ListStudies": (_V.ListStudiesRequest, _V.ListStudiesResponse),
+    "DeleteStudy": (_V.DeleteStudyRequest, _V.Empty),
+    "SetStudyState": (_V.SetStudyStateRequest, study_pb2.Study),
+    "SuggestTrials": (_V.SuggestTrialsRequest, _V.Operation),
+    "GetOperation": (_V.GetOperationRequest, _V.Operation),
+    "CreateTrial": (_V.CreateTrialRequest, study_pb2.Trial),
+    "GetTrial": (_V.GetTrialRequest, study_pb2.Trial),
+    "ListTrials": (_V.ListTrialsRequest, _V.ListTrialsResponse),
+    "AddTrialMeasurement": (_V.AddTrialMeasurementRequest, study_pb2.Trial),
+    "CompleteTrial": (_V.CompleteTrialRequest, study_pb2.Trial),
+    "DeleteTrial": (_V.DeleteTrialRequest, _V.Empty),
+    "CheckTrialEarlyStoppingState": (
+        _V.CheckTrialEarlyStoppingStateRequest,
+        _V.CheckTrialEarlyStoppingStateResponse,
+    ),
+    "StopTrial": (_V.StopTrialRequest, study_pb2.Trial),
+    "ListOptimalTrials": (_V.ListOptimalTrialsRequest, _V.ListOptimalTrialsResponse),
+    "UpdateMetadata": (_V.UpdateMetadataRequest, _V.UpdateMetadataResponse),
+}
+
+PYTHIA_METHODS: Dict[str, Tuple[Any, Any]] = {
+    "Suggest": (_P.PythiaSuggestRequest, _P.PythiaSuggestResponse),
+    "EarlyStop": (_P.PythiaEarlyStopRequest, _P.PythiaEarlyStopResponse),
+    "Ping": (_P.PingRequest, _P.PingResponse),
+}
+
+VIZIER_SERVICE_NAME = "vizier_tpu.VizierService"
+PYTHIA_SERVICE_NAME = "vizier_tpu.PythiaService"
+
+
+def _wrap(servicer, method_name: str):
+    fn = getattr(servicer, method_name)
+
+    def handler(request, context):
+        try:
+            return fn(request, context)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+    return handler
+
+
+def _add_servicer(servicer, server, service_name: str, methods: Dict[str, Tuple[Any, Any]]):
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            _wrap(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda msg: msg.SerializeToString(),
+        )
+        for name, (req_cls, _) in methods.items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(service_name, handlers),)
+    )
+
+
+def add_vizier_servicer_to_server(servicer, server) -> None:
+    _add_servicer(servicer, server, VIZIER_SERVICE_NAME, VIZIER_METHODS)
+
+
+def add_pythia_servicer_to_server(servicer, server) -> None:
+    _add_servicer(servicer, server, PYTHIA_SERVICE_NAME, PYTHIA_METHODS)
+
+
+class _Stub:
+    """Callable-per-method stub: ``stub.GetStudy(request) -> Study``."""
+
+    def __init__(self, channel: grpc.Channel, service_name: str, methods):
+        for name, (req_cls, resp_cls) in methods.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{service_name}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+class VizierServiceStub(_Stub):
+    def __init__(self, channel: grpc.Channel):
+        super().__init__(channel, VIZIER_SERVICE_NAME, VIZIER_METHODS)
+
+
+class PythiaServiceStub(_Stub):
+    def __init__(self, channel: grpc.Channel):
+        super().__init__(channel, PYTHIA_SERVICE_NAME, PYTHIA_METHODS)
+
+
+def create_vizier_stub(endpoint: str, timeout: float = 10.0) -> VizierServiceStub:
+    """Creates a stub after waiting for the channel to be ready."""
+    channel = grpc.insecure_channel(endpoint)
+    grpc.channel_ready_future(channel).result(timeout=timeout)
+    return VizierServiceStub(channel)
+
+
+def create_pythia_stub(endpoint: str, timeout: float = 10.0) -> PythiaServiceStub:
+    channel = grpc.insecure_channel(endpoint)
+    grpc.channel_ready_future(channel).result(timeout=timeout)
+    return PythiaServiceStub(channel)

@@ -1,0 +1,98 @@
+"""Default service policy factory: algorithm string → Policy.
+
+Parity with ``/root/reference/vizier/_src/service/policy_factory.py:28-115``
+(lazy imports; DEFAULT resolves to the GP bandit stack).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.pythia import policy as policy_lib
+from vizier_tpu.pythia import policy_supporter as supporter_lib
+
+
+class DefaultPolicyFactory:
+    """Maps well-known algorithm names to policies."""
+
+    def __call__(
+        self,
+        problem_statement: vz.ProblemStatement,
+        algorithm: str,
+        policy_supporter: supporter_lib.PolicySupporter,
+        study_name: str,
+    ) -> policy_lib.Policy:
+        from vizier_tpu.algorithms import designer_policy
+        from vizier_tpu.algorithms import random_policy
+
+        algorithm = (algorithm or "DEFAULT").upper()
+        if algorithm in ("DEFAULT", "GP_UCB_PE", "ALGORITHM_UNSPECIFIED"):
+            try:
+                from vizier_tpu.designers import gp_ucb_pe
+
+                factory = lambda p, **kw: gp_ucb_pe.VizierGPUCBPEBandit(p)
+            except ImportError:  # pragma: no cover - transitional fallback
+                from vizier_tpu.designers import gp_bandit
+
+                factory = lambda p, **kw: gp_bandit.VizierGPBandit(p)
+            return designer_policy.DesignerPolicy(
+                policy_supporter, factory, use_seeding=True
+            )
+        if algorithm in ("GAUSSIAN_PROCESS_BANDIT",):
+            from vizier_tpu.designers import gp_bandit
+
+            return designer_policy.DesignerPolicy(
+                policy_supporter,
+                lambda p, **kw: gp_bandit.VizierGPBandit(p),
+                use_seeding=True,
+            )
+        if algorithm == "RANDOM_SEARCH":
+            return random_policy.RandomPolicy(policy_supporter)
+        if algorithm == "QUASI_RANDOM_SEARCH":
+            from vizier_tpu.designers import quasi_random
+
+            return designer_policy.PartiallySerializableDesignerPolicy(
+                policy_supporter,
+                lambda p, **kw: quasi_random.QuasiRandomDesigner(p.search_space),
+            )
+        if algorithm in ("GRID_SEARCH", "SHUFFLED_GRID_SEARCH"):
+            from vizier_tpu.designers import grid
+
+            shuffle = 0 if algorithm == "SHUFFLED_GRID_SEARCH" else None
+            return designer_policy.PartiallySerializableDesignerPolicy(
+                policy_supporter,
+                lambda p, **kw: grid.GridSearchDesigner(p.search_space, shuffle_seed=shuffle),
+            )
+        if algorithm == "NSGA2":
+            from vizier_tpu.designers.evolution import nsga2
+
+            return designer_policy.DesignerPolicy(
+                policy_supporter, lambda p, **kw: nsga2.NSGA2Designer(p)
+            )
+        if algorithm == "EAGLE_STRATEGY":
+            from vizier_tpu.designers import eagle_strategy
+
+            return designer_policy.DesignerPolicy(
+                policy_supporter,
+                lambda p, **kw: eagle_strategy.EagleStrategyDesigner(p),
+            )
+        if algorithm == "CMA_ES":
+            from vizier_tpu.designers import cmaes
+
+            return designer_policy.DesignerPolicy(
+                policy_supporter, lambda p, **kw: cmaes.CMAESDesigner(p)
+            )
+        if algorithm == "BOCS":
+            from vizier_tpu.designers import bocs
+
+            return designer_policy.DesignerPolicy(
+                policy_supporter, lambda p, **kw: bocs.BOCSDesigner(p)
+            )
+        if algorithm == "HARMONICA":
+            from vizier_tpu.designers import harmonica
+
+            return designer_policy.DesignerPolicy(
+                policy_supporter, lambda p, **kw: harmonica.HarmonicaDesigner(p)
+            )
+        raise ValueError(f"Unknown algorithm: {algorithm!r}")

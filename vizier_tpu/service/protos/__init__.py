@@ -1,0 +1,22 @@
+"""Generated protobuf stubs.
+
+protoc generates flat-module imports (``import study_pb2``), so this package
+puts its directory on ``sys.path`` before importing them — the same
+mechanism the reference uses for its compiled stubs
+(``/root/reference/vizier/__init__.py:18-25``). Regenerate with
+``build_protos.sh`` at the repo root.
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(__file__)
+if _HERE not in sys.path:
+    sys.path.append(_HERE)
+
+import key_value_pb2  # noqa: E402
+import pythia_service_pb2  # noqa: E402
+import study_pb2  # noqa: E402
+import vizier_service_pb2  # noqa: E402
+
+__all__ = ["key_value_pb2", "pythia_service_pb2", "study_pb2", "vizier_service_pb2"]

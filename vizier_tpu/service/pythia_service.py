@@ -1,0 +1,125 @@
+"""PythiaServicer: hosts suggestion policies.
+
+Parity with ``/root/reference/vizier/_src/service/pythia_service.py:36``:
+builds a ``ServicePolicySupporter`` for the study, asks the policy factory
+for the algorithm's policy, converts proto⇄pythia types, and captures policy
+errors into the response. (No forced float64 — our GP stack is f32/TPU-native
+by design, unlike the reference's ``jax_enable_x64`` at ``:50-57``.)
+"""
+
+from __future__ import annotations
+
+import logging
+import traceback
+from typing import Optional
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.pythia import policy as policy_lib
+from vizier_tpu.service import policy_factory as policy_factory_lib
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import service_policy_supporter
+from vizier_tpu.service.protos import pythia_service_pb2
+
+_logger = logging.getLogger(__name__)
+
+
+class PythiaServicer:
+    def __init__(self, vizier_service=None, policy_factory=None):
+        self._vizier = vizier_service
+        self._policy_factory = policy_factory or policy_factory_lib.DefaultPolicyFactory()
+        # Cache for policies that declare should_be_cached.
+        self._policy_cache = {}
+
+    def connect_to_vizier(self, vizier_service) -> None:
+        self._vizier = vizier_service
+
+    def _get_policy(
+        self, study_config: vz.StudyConfig, algorithm: str, study_name: str
+    ) -> policy_lib.Policy:
+        supporter = service_policy_supporter.ServicePolicySupporter(
+            study_name, self._vizier
+        )
+        cached = self._policy_cache.get((study_name, algorithm))
+        if cached is not None:
+            return cached
+        policy = self._policy_factory(
+            study_config.to_problem(), algorithm, supporter, study_name
+        )
+        if policy.should_be_cached:
+            self._policy_cache[(study_name, algorithm)] = policy
+        return policy
+
+    def Suggest(
+        self, request: pythia_service_pb2.PythiaSuggestRequest, context=None
+    ) -> pythia_service_pb2.PythiaSuggestResponse:
+        response = pythia_service_pb2.PythiaSuggestResponse()
+        try:
+            config = pc.study_config_from_proto(request.study_descriptor.config)
+            config.algorithm = request.algorithm or config.algorithm
+            policy = self._get_policy(config, config.algorithm, request.study_name)
+            descriptor = vz.StudyDescriptor(
+                config=config,
+                guid=request.study_descriptor.guid,
+                max_trial_id=int(request.study_descriptor.max_trial_id),
+            )
+            decision = policy.suggest(
+                policy_lib.SuggestRequest(
+                    study_descriptor=descriptor, count=int(request.count)
+                )
+            )
+            for s in decision.suggestions:
+                response.suggestions.add().CopyFrom(pc.trial_suggestion_to_proto(s))
+            self._append_metadata_deltas(response, decision.metadata)
+        except Exception as e:
+            _logger.warning("Pythia Suggest failed: %s", traceback.format_exc())
+            response.error = f"{type(e).__name__}: {e}"
+        return response
+
+    def EarlyStop(
+        self, request: pythia_service_pb2.PythiaEarlyStopRequest, context=None
+    ) -> pythia_service_pb2.PythiaEarlyStopResponse:
+        response = pythia_service_pb2.PythiaEarlyStopResponse()
+        try:
+            config = pc.study_config_from_proto(request.study_descriptor.config)
+            policy = self._get_policy(
+                config, request.algorithm or config.algorithm, request.study_name
+            )
+            descriptor = vz.StudyDescriptor(
+                config=config,
+                guid=request.study_descriptor.guid,
+                max_trial_id=int(request.study_descriptor.max_trial_id),
+            )
+            decisions = policy.early_stop(
+                policy_lib.EarlyStopRequest(
+                    study_descriptor=descriptor,
+                    trial_ids=frozenset(int(i) for i in request.trial_ids),
+                )
+            )
+            for d in decisions.decisions:
+                dp = response.decisions.add()
+                dp.id = d.id
+                dp.should_stop = d.should_stop
+                dp.reason = d.reason
+        except Exception as e:
+            _logger.warning("Pythia EarlyStop failed: %s", traceback.format_exc())
+            response.error = f"{type(e).__name__}: {e}"
+        return response
+
+    def Ping(
+        self, request: pythia_service_pb2.PingRequest, context=None
+    ) -> pythia_service_pb2.PingResponse:
+        return pythia_service_pb2.PingResponse()
+
+    @staticmethod
+    def _append_metadata_deltas(
+        response: pythia_service_pb2.PythiaSuggestResponse, delta: vz.MetadataDelta
+    ) -> None:
+        if delta.on_study.namespaces():
+            dp = response.metadata_deltas.add()
+            dp.trial_id = 0
+            dp.key_values.extend(pc.metadata_to_key_values(delta.on_study))
+        for trial_id, md in delta.on_trials.items():
+            if md.namespaces():
+                dp = response.metadata_deltas.add()
+                dp.trial_id = trial_id
+                dp.key_values.extend(pc.metadata_to_key_values(md))

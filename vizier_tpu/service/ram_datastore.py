@@ -1,0 +1,249 @@
+"""In-RAM datastore: nested dicts, single lock, pass-by-value.
+
+Parity with ``/root/reference/vizier/_src/service/ram_datastore.py:83``.
+Protos are copied on the way in and out so callers can never mutate stored
+state behind the lock.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Dict, Iterable, List, Optional
+
+from vizier_tpu.service import datastore
+from vizier_tpu.service import resources
+from vizier_tpu.service.protos import key_value_pb2, study_pb2, vizier_service_pb2
+
+
+def _copy(proto):
+    out = type(proto)()
+    out.CopyFrom(proto)
+    return out
+
+
+class _StudyNode:
+    def __init__(self, study: study_pb2.Study):
+        self.study = study
+        self.trials: Dict[int, study_pb2.Trial] = {}
+        # client_id -> {operation_number -> Operation}
+        self.suggestion_ops: Dict[str, Dict[int, vizier_service_pb2.Operation]] = (
+            collections.defaultdict(dict)
+        )
+        # trial_id -> EarlyStoppingOperation
+        self.early_stopping_ops: Dict[str, vizier_service_pb2.EarlyStoppingOperation] = {}
+
+
+class NestedDictRAMDataStore(datastore.DataStore):
+    def __init__(self):
+        self._lock = threading.Lock()
+        # owner_id -> study_id -> _StudyNode
+        self._owners: Dict[str, Dict[str, _StudyNode]] = collections.defaultdict(dict)
+
+    # -- internal helpers (caller holds the lock) -------------------------
+
+    def _node(self, study_name: str) -> _StudyNode:
+        r = resources.StudyResource.from_name(study_name)
+        try:
+            return self._owners[r.owner_id][r.study_id]
+        except KeyError:
+            raise datastore.NotFoundError(f"No such study: {study_name}")
+
+    # -- studies -----------------------------------------------------------
+
+    def create_study(self, study: study_pb2.Study) -> str:
+        r = resources.StudyResource.from_name(study.name)
+        with self._lock:
+            if r.study_id in self._owners[r.owner_id]:
+                raise datastore.AlreadyExistsError(f"Study exists: {study.name}")
+            self._owners[r.owner_id][r.study_id] = _StudyNode(_copy(study))
+        return study.name
+
+    def load_study(self, study_name: str) -> study_pb2.Study:
+        with self._lock:
+            return _copy(self._node(study_name).study)
+
+    def update_study(self, study: study_pb2.Study) -> str:
+        with self._lock:
+            node = self._node(study.name)
+            node.study = _copy(study)
+        return study.name
+
+    def delete_study(self, study_name: str) -> None:
+        r = resources.StudyResource.from_name(study_name)
+        with self._lock:
+            if r.study_id not in self._owners.get(r.owner_id, {}):
+                raise datastore.NotFoundError(f"No such study: {study_name}")
+            del self._owners[r.owner_id][r.study_id]
+
+    def list_studies(self, owner_name: str) -> List[study_pb2.Study]:
+        r = resources.OwnerResource.from_name(owner_name)
+        with self._lock:
+            return [_copy(n.study) for n in self._owners.get(r.owner_id, {}).values()]
+
+    # -- trials ------------------------------------------------------------
+
+    def create_trial(self, trial: study_pb2.Trial) -> str:
+        r = resources.TrialResource.from_name(trial.name)
+        with self._lock:
+            node = self._node(r.study_resource.name)
+            if r.trial_id in node.trials:
+                raise datastore.AlreadyExistsError(f"Trial exists: {trial.name}")
+            node.trials[r.trial_id] = _copy(trial)
+        return trial.name
+
+    def get_trial(self, trial_name: str) -> study_pb2.Trial:
+        r = resources.TrialResource.from_name(trial_name)
+        with self._lock:
+            node = self._node(r.study_resource.name)
+            if r.trial_id not in node.trials:
+                raise datastore.NotFoundError(f"No such trial: {trial_name}")
+            return _copy(node.trials[r.trial_id])
+
+    def update_trial(self, trial: study_pb2.Trial) -> str:
+        r = resources.TrialResource.from_name(trial.name)
+        with self._lock:
+            node = self._node(r.study_resource.name)
+            if r.trial_id not in node.trials:
+                raise datastore.NotFoundError(f"No such trial: {trial.name}")
+            node.trials[r.trial_id] = _copy(trial)
+        return trial.name
+
+    def delete_trial(self, trial_name: str) -> None:
+        r = resources.TrialResource.from_name(trial_name)
+        with self._lock:
+            node = self._node(r.study_resource.name)
+            if r.trial_id not in node.trials:
+                raise datastore.NotFoundError(f"No such trial: {trial_name}")
+            del node.trials[r.trial_id]
+
+    def list_trials(self, study_name: str) -> List[study_pb2.Trial]:
+        with self._lock:
+            node = self._node(study_name)
+            return [_copy(t) for _, t in sorted(node.trials.items())]
+
+    def max_trial_id(self, study_name: str) -> int:
+        with self._lock:
+            node = self._node(study_name)
+            return max(node.trials.keys(), default=0)
+
+    # -- suggestion operations --------------------------------------------
+
+    def create_suggestion_operation(
+        self, operation: vizier_service_pb2.Operation
+    ) -> str:
+        r = resources.SuggestionOperationResource.from_name(operation.name)
+        with self._lock:
+            node = self._node(
+                resources.StudyResource(r.owner_id, r.study_id).name
+            )
+            ops = node.suggestion_ops[r.client_id]
+            if r.operation_number in ops:
+                raise datastore.AlreadyExistsError(f"Operation exists: {operation.name}")
+            ops[r.operation_number] = _copy(operation)
+        return operation.name
+
+    def get_suggestion_operation(
+        self, operation_name: str
+    ) -> vizier_service_pb2.Operation:
+        r = resources.SuggestionOperationResource.from_name(operation_name)
+        with self._lock:
+            node = self._node(resources.StudyResource(r.owner_id, r.study_id).name)
+            ops = node.suggestion_ops.get(r.client_id, {})
+            if r.operation_number not in ops:
+                raise datastore.NotFoundError(f"No such operation: {operation_name}")
+            return _copy(ops[r.operation_number])
+
+    def update_suggestion_operation(
+        self, operation: vizier_service_pb2.Operation
+    ) -> str:
+        r = resources.SuggestionOperationResource.from_name(operation.name)
+        with self._lock:
+            node = self._node(resources.StudyResource(r.owner_id, r.study_id).name)
+            ops = node.suggestion_ops.get(r.client_id, {})
+            if r.operation_number not in ops:
+                raise datastore.NotFoundError(f"No such operation: {operation.name}")
+            ops[r.operation_number] = _copy(operation)
+        return operation.name
+
+    def list_suggestion_operations(
+        self,
+        study_name: str,
+        client_id: str,
+        filter_fn: Optional[Callable[[vizier_service_pb2.Operation], bool]] = None,
+    ) -> List[vizier_service_pb2.Operation]:
+        with self._lock:
+            node = self._node(study_name)
+            ops = [
+                _copy(op) for _, op in sorted(node.suggestion_ops.get(client_id, {}).items())
+            ]
+        if filter_fn is not None:
+            ops = [op for op in ops if filter_fn(op)]
+        return ops
+
+    def max_suggestion_operation_number(self, study_name: str, client_id: str) -> int:
+        with self._lock:
+            node = self._node(study_name)
+            return max(node.suggestion_ops.get(client_id, {}).keys(), default=0)
+
+    # -- early stopping operations ----------------------------------------
+
+    def create_early_stopping_operation(
+        self, operation: vizier_service_pb2.EarlyStoppingOperation
+    ) -> str:
+        r = resources.EarlyStoppingOperationResource.from_name(operation.name)
+        with self._lock:
+            node = self._node(resources.StudyResource(r.owner_id, r.study_id).name)
+            node.early_stopping_ops[operation.name] = _copy(operation)
+        return operation.name
+
+    def get_early_stopping_operation(
+        self, operation_name: str
+    ) -> vizier_service_pb2.EarlyStoppingOperation:
+        r = resources.EarlyStoppingOperationResource.from_name(operation_name)
+        with self._lock:
+            node = self._node(resources.StudyResource(r.owner_id, r.study_id).name)
+            if operation_name not in node.early_stopping_ops:
+                raise datastore.NotFoundError(f"No such operation: {operation_name}")
+            return _copy(node.early_stopping_ops[operation_name])
+
+    def update_early_stopping_operation(
+        self, operation: vizier_service_pb2.EarlyStoppingOperation
+    ) -> str:
+        r = resources.EarlyStoppingOperationResource.from_name(operation.name)
+        with self._lock:
+            node = self._node(resources.StudyResource(r.owner_id, r.study_id).name)
+            if operation.name not in node.early_stopping_ops:
+                raise datastore.NotFoundError(f"No such operation: {operation.name}")
+            node.early_stopping_ops[operation.name] = _copy(operation)
+        return operation.name
+
+    # -- metadata ----------------------------------------------------------
+
+    def update_metadata(
+        self,
+        study_name: str,
+        study_metadata: Iterable[key_value_pb2.KeyValue],
+        trial_metadata: Iterable,
+    ) -> None:
+        with self._lock:
+            node = self._node(study_name)
+            _merge_key_values(node.study.study_spec.metadata, study_metadata)
+            r = resources.StudyResource.from_name(study_name)
+            for trial_id, kv in trial_metadata:
+                if trial_id not in node.trials:
+                    raise datastore.NotFoundError(
+                        f"No such trial {trial_id} in {study_name}"
+                    )
+                _merge_key_values(node.trials[trial_id].metadata, [kv])
+
+
+def _merge_key_values(existing_field, new_kvs) -> None:
+    """Merges KeyValues into a repeated field ((ns, key) unique)."""
+    for kv in new_kvs:
+        for old in existing_field:
+            if old.ns == kv.ns and old.key == kv.key:
+                old.CopyFrom(kv)
+                break
+        else:
+            existing_field.add().CopyFrom(kv)

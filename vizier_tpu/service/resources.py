@@ -1,0 +1,131 @@
+"""Resource-name parsing and formatting.
+
+Parity with ``/root/reference/vizier/_src/service/resources.py:38-199``:
+``owners/{owner}``, ``owners/{o}/studies/{s}``, ``.../trials/{id}``,
+``.../earlyStoppingOperations/{op}``, ``.../clients/{c}/operations/{n}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+_SEGMENT = r"[^/]+"
+
+
+@dataclasses.dataclass(frozen=True)
+class OwnerResource:
+    owner_id: str
+
+    @property
+    def name(self) -> str:
+        return f"owners/{self.owner_id}"
+
+    @classmethod
+    def from_name(cls, name: str) -> "OwnerResource":
+        m = re.fullmatch(rf"owners/({_SEGMENT})", name)
+        if not m:
+            raise ValueError(f"Invalid owner resource name: {name!r}")
+        return cls(m.group(1))
+
+
+@dataclasses.dataclass(frozen=True)
+class StudyResource:
+    owner_id: str
+    study_id: str
+
+    @property
+    def name(self) -> str:
+        return f"owners/{self.owner_id}/studies/{self.study_id}"
+
+    @classmethod
+    def from_name(cls, name: str) -> "StudyResource":
+        m = re.fullmatch(rf"owners/({_SEGMENT})/studies/({_SEGMENT})", name)
+        if not m:
+            raise ValueError(f"Invalid study resource name: {name!r}")
+        return cls(m.group(1), m.group(2))
+
+    def trial_resource(self, trial_id: int) -> "TrialResource":
+        return TrialResource(self.owner_id, self.study_id, trial_id)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrialResource:
+    owner_id: str
+    study_id: str
+    trial_id: int
+
+    @property
+    def name(self) -> str:
+        return f"owners/{self.owner_id}/studies/{self.study_id}/trials/{self.trial_id}"
+
+    @classmethod
+    def from_name(cls, name: str) -> "TrialResource":
+        m = re.fullmatch(
+            rf"owners/({_SEGMENT})/studies/({_SEGMENT})/trials/(\d+)", name
+        )
+        if not m:
+            raise ValueError(f"Invalid trial resource name: {name!r}")
+        return cls(m.group(1), m.group(2), int(m.group(3)))
+
+    @property
+    def study_resource(self) -> StudyResource:
+        return StudyResource(self.owner_id, self.study_id)
+
+
+@dataclasses.dataclass(frozen=True)
+class EarlyStoppingOperationResource:
+    owner_id: str
+    study_id: str
+    trial_id: int
+
+    @property
+    def name(self) -> str:
+        return (
+            f"owners/{self.owner_id}/studies/{self.study_id}/trials/"
+            f"{self.trial_id}/earlyStoppingOperations/{self.operation_id}"
+        )
+
+    @property
+    def operation_id(self) -> str:
+        return f"earlystopping-{self.trial_id}"
+
+    @classmethod
+    def from_name(cls, name: str) -> "EarlyStoppingOperationResource":
+        m = re.fullmatch(
+            rf"owners/({_SEGMENT})/studies/({_SEGMENT})/trials/(\d+)/"
+            rf"earlyStoppingOperations/earlystopping-(\d+)",
+            name,
+        )
+        if not m:
+            raise ValueError(f"Invalid early-stopping operation name: {name!r}")
+        return cls(m.group(1), m.group(2), int(m.group(3)))
+
+    @property
+    def trial_resource(self) -> TrialResource:
+        return TrialResource(self.owner_id, self.study_id, self.trial_id)
+
+
+@dataclasses.dataclass(frozen=True)
+class SuggestionOperationResource:
+    owner_id: str
+    study_id: str
+    client_id: str
+    operation_number: int
+
+    @property
+    def name(self) -> str:
+        return (
+            f"owners/{self.owner_id}/studies/{self.study_id}/clients/"
+            f"{self.client_id}/operations/{self.operation_number}"
+        )
+
+    @classmethod
+    def from_name(cls, name: str) -> "SuggestionOperationResource":
+        m = re.fullmatch(
+            rf"owners/({_SEGMENT})/studies/({_SEGMENT})/clients/({_SEGMENT})/operations/(\d+)",
+            name,
+        )
+        if not m:
+            raise ValueError(f"Invalid suggestion operation name: {name!r}")
+        return cls(m.group(1), m.group(2), m.group(3), int(m.group(4)))

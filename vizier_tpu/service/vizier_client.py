@@ -1,0 +1,256 @@
+"""Low-level Vizier client: RPC wrappers + suggestion-operation polling.
+
+Parity with ``/root/reference/vizier/_src/service/vizier_client.py:94,127``
+(polling loop ``:166-179``): the client targets either a remote gRPC
+endpoint or an in-process ``VizierServicer`` through the same interface (the
+reference's in-process/stub Union trick, ``types.py:24-33``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import resources
+from vizier_tpu.service.protos import study_pb2, vizier_service_pb2
+
+NO_ENDPOINT = "NO_ENDPOINT"
+
+
+@dataclasses.dataclass
+class EnvironmentVariables:
+    """Process-global client defaults (reference ``vizier_client.py:46-72``)."""
+
+    server_endpoint: str = NO_ENDPOINT
+    servicer_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    polling_delay_secs: float = 0.1
+    polling_timeout_secs: float = 600.0
+
+
+environment_variables = EnvironmentVariables()
+
+_local_servicer = None
+
+
+def _get_local_servicer():
+    """Lazily creates one in-process service shared by local clients."""
+    global _local_servicer
+    if _local_servicer is None:
+        from vizier_tpu.service import pythia_service, vizier_service
+
+        servicer = vizier_service.VizierServicer(
+            **environment_variables.servicer_kwargs
+        )
+        pythia = pythia_service.PythiaServicer(servicer)
+        servicer.set_pythia(pythia)
+        _local_servicer = servicer
+    return _local_servicer
+
+
+def create_service_stub(endpoint: Optional[str] = None):
+    """Returns a gRPC stub or the in-process servicer (duck-typed alike)."""
+    endpoint = endpoint or environment_variables.server_endpoint
+    if endpoint == NO_ENDPOINT:
+        return _get_local_servicer()
+    from vizier_tpu.service import grpc_stubs
+
+    return grpc_stubs.create_vizier_stub(endpoint)
+
+
+class VizierClient:
+    """Study-scoped RPC wrapper."""
+
+    def __init__(self, service, study_name: str, client_id: str):
+        self._service = service
+        self._study_name = study_name
+        self._client_id = client_id
+
+    @property
+    def study_name(self) -> str:
+        return self._study_name
+
+    @property
+    def client_id(self) -> str:
+        return self._client_id
+
+    # -- factory -----------------------------------------------------------
+
+    @classmethod
+    def create_or_load_study(
+        cls,
+        owner_id: str,
+        study_id: str,
+        study_config: vz.StudyConfig,
+        *,
+        client_id: str = "default_client_id",
+        endpoint: Optional[str] = None,
+    ) -> "VizierClient":
+        service = create_service_stub(endpoint)
+        study_name = resources.StudyResource(owner_id, study_id).name
+        study = pc.study_to_proto(study_config, study_name, display_name=study_id)
+        service.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(
+                parent=resources.OwnerResource(owner_id).name, study=study
+            )
+        )
+        return cls(service, study_name, client_id)
+
+    @classmethod
+    def load_study(
+        cls,
+        study_name: str,
+        *,
+        client_id: str = "default_client_id",
+        endpoint: Optional[str] = None,
+    ) -> "VizierClient":
+        service = create_service_stub(endpoint)
+        service.GetStudy(vizier_service_pb2.GetStudyRequest(name=study_name))
+        return cls(service, study_name, client_id)
+
+    # -- suggestions -------------------------------------------------------
+
+    def get_suggestions(self, suggestion_count: int) -> List[vz.Trial]:
+        """Requests suggestions, polling the long-running operation."""
+        op = self._service.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent=self._study_name,
+                suggestion_count=suggestion_count,
+                client_id=self._client_id,
+            )
+        )
+        deadline = time.time() + environment_variables.polling_timeout_secs
+        while not op.done:
+            if time.time() > deadline:
+                raise TimeoutError(f"Suggestion operation timed out: {op.name}")
+            time.sleep(environment_variables.polling_delay_secs)
+            op = self._service.GetOperation(
+                vizier_service_pb2.GetOperationRequest(name=op.name)
+            )
+        if op.error:
+            raise RuntimeError(f"SuggestTrials failed: {op.error}")
+        return [pc.trial_from_proto(t) for t in op.response.trials]
+
+    # -- trials ------------------------------------------------------------
+
+    def _trial_name(self, trial_id: int) -> str:
+        return resources.StudyResource.from_name(self._study_name).trial_resource(
+            trial_id
+        ).name
+
+    def create_trial(self, trial: vz.Trial) -> vz.Trial:
+        proto = pc.trial_to_proto(trial)
+        out = self._service.CreateTrial(
+            vizier_service_pb2.CreateTrialRequest(parent=self._study_name, trial=proto)
+        )
+        return pc.trial_from_proto(out)
+
+    def get_trial(self, trial_id: int) -> vz.Trial:
+        return pc.trial_from_proto(
+            self._service.GetTrial(
+                vizier_service_pb2.GetTrialRequest(name=self._trial_name(trial_id))
+            )
+        )
+
+    def list_trials(self) -> List[vz.Trial]:
+        response = self._service.ListTrials(
+            vizier_service_pb2.ListTrialsRequest(parent=self._study_name)
+        )
+        return [pc.trial_from_proto(t) for t in response.trials]
+
+    def report_intermediate_objective_value(
+        self, trial_id: int, measurement: vz.Measurement
+    ) -> vz.Trial:
+        out = self._service.AddTrialMeasurement(
+            vizier_service_pb2.AddTrialMeasurementRequest(
+                trial_name=self._trial_name(trial_id),
+                measurement=pc.measurement_to_proto(measurement),
+            )
+        )
+        return pc.trial_from_proto(out)
+
+    def complete_trial(
+        self,
+        trial_id: int,
+        final_measurement: Optional[vz.Measurement] = None,
+        *,
+        infeasibility_reason: Optional[str] = None,
+    ) -> vz.Trial:
+        request = vizier_service_pb2.CompleteTrialRequest(
+            name=self._trial_name(trial_id),
+            trial_infeasible=infeasibility_reason is not None,
+            infeasible_reason=infeasibility_reason or "",
+        )
+        if final_measurement is not None:
+            request.final_measurement.CopyFrom(
+                pc.measurement_to_proto(final_measurement)
+            )
+        return pc.trial_from_proto(self._service.CompleteTrial(request))
+
+    def should_trial_stop(self, trial_id: int) -> bool:
+        response = self._service.CheckTrialEarlyStoppingState(
+            vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(
+                trial_name=self._trial_name(trial_id)
+            )
+        )
+        return response.should_stop
+
+    def stop_trial(self, trial_id: int) -> vz.Trial:
+        return pc.trial_from_proto(
+            self._service.StopTrial(
+                vizier_service_pb2.StopTrialRequest(name=self._trial_name(trial_id))
+            )
+        )
+
+    def delete_trial(self, trial_id: int) -> None:
+        self._service.DeleteTrial(
+            vizier_service_pb2.DeleteTrialRequest(name=self._trial_name(trial_id))
+        )
+
+    # -- study -------------------------------------------------------------
+
+    def get_study_config(self, study_name: Optional[str] = None) -> vz.StudyConfig:
+        study = self._service.GetStudy(
+            vizier_service_pb2.GetStudyRequest(name=study_name or self._study_name)
+        )
+        return pc.study_config_from_proto(study.study_spec)
+
+    def set_study_state(self, state: vz.StudyState, reason: str = "") -> None:
+        state_map = {
+            vz.StudyState.ACTIVE: study_pb2.Study.ACTIVE,
+            vz.StudyState.ABORTED: study_pb2.Study.INACTIVE,
+            vz.StudyState.COMPLETED: study_pb2.Study.COMPLETED,
+        }
+        self._service.SetStudyState(
+            vizier_service_pb2.SetStudyStateRequest(
+                name=self._study_name, state=state_map[state], reason=reason
+            )
+        )
+
+    def delete_study(self) -> None:
+        self._service.DeleteStudy(
+            vizier_service_pb2.DeleteStudyRequest(name=self._study_name)
+        )
+
+    def list_optimal_trials(self) -> List[vz.Trial]:
+        response = self._service.ListOptimalTrials(
+            vizier_service_pb2.ListOptimalTrialsRequest(parent=self._study_name)
+        )
+        return [pc.trial_from_proto(t) for t in response.optimal_trials]
+
+    def update_metadata(self, delta: vz.MetadataDelta) -> None:
+        request = vizier_service_pb2.UpdateMetadataRequest(name=self._study_name)
+        for kv in pc.metadata_to_key_values(delta.on_study):
+            unit = request.deltas.add()
+            unit.trial_id = 0
+            unit.key_value.CopyFrom(kv)
+        for trial_id, md in delta.on_trials.items():
+            for kv in pc.metadata_to_key_values(md):
+                unit = request.deltas.add()
+                unit.trial_id = trial_id
+                unit.key_value.CopyFrom(kv)
+        response = self._service.UpdateMetadata(request)
+        if response.error_details:
+            raise KeyError(response.error_details)

@@ -1,0 +1,121 @@
+"""Server bootstrap: single-process and split-Pythia topologies.
+
+Parity with ``/root/reference/vizier/_src/service/vizier_server.py:42,101``.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+
+def _pick_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class DefaultVizierServer:
+    """Vizier + Pythia servicers in one process behind one gRPC server."""
+
+    def __init__(
+        self,
+        host: str = "localhost",
+        database_url: Optional[str] = None,
+        policy_factory=None,
+        port: Optional[int] = None,
+    ):
+        from vizier_tpu.service import grpc_stubs
+        from vizier_tpu.service import pythia_service
+        from vizier_tpu.service import vizier_service
+
+        self._port = port or _pick_port()
+        self._servicer = vizier_service.VizierServicer(database_url=database_url)
+        self._pythia_servicer = pythia_service.PythiaServicer(
+            self._servicer, policy_factory
+        )
+        self._servicer.set_pythia(self._pythia_servicer)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=30))
+        grpc_stubs.add_vizier_servicer_to_server(self._servicer, self._server)
+        grpc_stubs.add_pythia_servicer_to_server(self._pythia_servicer, self._server)
+        self._endpoint = f"{host}:{self._port}"
+        self._server.add_insecure_port(self._endpoint)
+        self._server.start()
+
+    @property
+    def endpoint(self) -> str:
+        return self._endpoint
+
+    @property
+    def servicer(self):
+        """The in-process servicer (for no-network clients)."""
+        return self._servicer
+
+    def stop(self, grace: Optional[float] = None) -> None:
+        self._server.stop(grace)
+
+    def __del__(self):
+        try:
+            self._server.stop(None)
+        except Exception:
+            pass
+
+
+class DistributedPythiaVizierServer:
+    """Separate gRPC servers for Vizier and Pythia, cross-connected.
+
+    Pythia runs max_workers=1 — one policy computation at a time, matching
+    the reference topology (one accelerator-bound computation per host).
+    """
+
+    def __init__(
+        self,
+        host: str = "localhost",
+        database_url: Optional[str] = None,
+        policy_factory=None,
+    ):
+        from vizier_tpu.service import grpc_stubs
+        from vizier_tpu.service import pythia_service
+        from vizier_tpu.service import vizier_service
+
+        # Vizier server.
+        self._servicer = vizier_service.VizierServicer(database_url=database_url)
+        self._vizier_server = grpc.server(futures.ThreadPoolExecutor(max_workers=30))
+        grpc_stubs.add_vizier_servicer_to_server(self._servicer, self._vizier_server)
+        self._vizier_endpoint = f"{host}:{_pick_port()}"
+        self._vizier_server.add_insecure_port(self._vizier_endpoint)
+        self._vizier_server.start()
+
+        # Pythia server (reads trials back through the Vizier stub).
+        vizier_stub = grpc_stubs.create_vizier_stub(self._vizier_endpoint)
+        self._pythia_servicer = pythia_service.PythiaServicer(
+            vizier_stub, policy_factory
+        )
+        self._pythia_server = grpc.server(futures.ThreadPoolExecutor(max_workers=1))
+        grpc_stubs.add_pythia_servicer_to_server(
+            self._pythia_servicer, self._pythia_server
+        )
+        self._pythia_endpoint = f"{host}:{_pick_port()}"
+        self._pythia_server.add_insecure_port(self._pythia_endpoint)
+        self._pythia_server.start()
+
+        # Vizier dispatches suggestion work to Pythia over gRPC.
+        self._servicer.set_pythia(grpc_stubs.create_pythia_stub(self._pythia_endpoint))
+
+    @property
+    def endpoint(self) -> str:
+        return self._vizier_endpoint
+
+    @property
+    def pythia_endpoint(self) -> str:
+        return self._pythia_endpoint
+
+    def stop(self, grace: Optional[float] = None) -> None:
+        self._pythia_server.stop(grace)
+        self._vizier_server.stop(grace)

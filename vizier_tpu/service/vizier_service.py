@@ -1,0 +1,486 @@
+"""VizierServicer: study/trial lifecycle + Pythia dispatch.
+
+Parity with ``/root/reference/vizier/_src/service/vizier_service.py:64``
+(init ``:73``, ``SuggestTrials`` ``:245``, ``CompleteTrial`` ``:568``,
+``CheckTrialEarlyStoppingState`` ``:631``, ``ListOptimalTrials`` ``:861``,
+``UpdateMetadata`` ``:931``), re-implemented against our own wire schema.
+The multi-worker behavioral contract is preserved exactly:
+
+- per-(owner/study/operation) locks; datastore does its own locking;
+- ``SuggestTrials`` first returns the client's existing ACTIVE trials, then
+  drains the REQUESTED pool, then dispatches to Pythia — so a crashed
+  worker that re-requests gets its old trials back;
+- suggestion operations are deduplicated per client (an unfinished op for
+  the same client is returned as-is);
+- Pythia failures are captured into the operation's ``error`` field;
+- completed trials and completed studies are immutable;
+- early-stopping ops are recycled after ``early_stop_recycle_period``.
+"""
+
+from __future__ import annotations
+
+import collections
+import datetime
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.service import datastore as datastore_lib
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import ram_datastore
+from vizier_tpu.service import resources
+from vizier_tpu.service import sql_datastore
+from vizier_tpu.service.protos import study_pb2, vizier_service_pb2
+
+
+class VizierServicer:
+    """The study service; callable in-process or wrapped by gRPC."""
+
+    def __init__(
+        self,
+        *,
+        database_url: Optional[str] = None,
+        early_stop_recycle_period: datetime.timedelta = datetime.timedelta(seconds=60),
+    ):
+        if database_url is None:
+            self.datastore: datastore_lib.DataStore = ram_datastore.NestedDictRAMDataStore()
+        else:
+            self.datastore = sql_datastore.SQLDataStore(database_url)
+        self._early_stop_recycle_period = early_stop_recycle_period
+        self._study_locks: Dict[str, threading.Lock] = collections.defaultdict(
+            threading.Lock
+        )
+        self._policy_factory = None  # set via set_policy_factory / pythia servicer
+        self._pythia = None  # object with Suggest/EarlyStop proto methods
+        # Ops created by THIS process; a persisted not-done op absent from
+        # here was orphaned by a crash and must not wedge its client.
+        self._inflight_ops: set = set()
+
+    def set_pythia(self, pythia) -> None:
+        """Connects a Pythia endpoint (in-process servicer or gRPC stub)."""
+        self._pythia = pythia
+
+    # -- studies -----------------------------------------------------------
+
+    def CreateStudy(
+        self, request: vizier_service_pb2.CreateStudyRequest, context=None
+    ) -> study_pb2.Study:
+        owner = resources.OwnerResource.from_name(request.parent)
+        study = request.study
+        if not study.name:
+            study_id = study.display_name or f"study-{int(time.time() * 1e6)}"
+            study.name = f"{owner.name}/studies/{study_id}"
+        try:
+            self.datastore.create_study(study)
+        except datastore_lib.AlreadyExistsError:
+            # create_or_load semantics: return the existing study.
+            return self.datastore.load_study(study.name)
+        return self.datastore.load_study(study.name)
+
+    def GetStudy(
+        self, request: vizier_service_pb2.GetStudyRequest, context=None
+    ) -> study_pb2.Study:
+        return self.datastore.load_study(request.name)
+
+    def ListStudies(
+        self, request: vizier_service_pb2.ListStudiesRequest, context=None
+    ) -> vizier_service_pb2.ListStudiesResponse:
+        return vizier_service_pb2.ListStudiesResponse(
+            studies=self.datastore.list_studies(request.parent)
+        )
+
+    def DeleteStudy(
+        self, request: vizier_service_pb2.DeleteStudyRequest, context=None
+    ) -> vizier_service_pb2.Empty:
+        self.datastore.delete_study(request.name)
+        return vizier_service_pb2.Empty()
+
+    def SetStudyState(
+        self, request: vizier_service_pb2.SetStudyStateRequest, context=None
+    ) -> study_pb2.Study:
+        study = self.datastore.load_study(request.name)
+        study.state = request.state
+        study.state_reason = request.reason
+        self.datastore.update_study(study)
+        return study
+
+    # -- suggestions -------------------------------------------------------
+
+    def SuggestTrials(
+        self, request: vizier_service_pb2.SuggestTrialsRequest, context=None
+    ) -> vizier_service_pb2.Operation:
+        study_name = request.parent
+        client_id = request.client_id or "default_client_id"
+        with self._study_locks[study_name]:
+            study = self.datastore.load_study(study_name)
+            if study.state != study_pb2.Study.ACTIVE:
+                raise ValueError(f"Study {study_name} is not ACTIVE.")
+
+            # Op dedup: an unfinished op for this client is returned as-is —
+            # unless it was orphaned by a server crash (persisted not-done
+            # but not in flight here), in which case it is failed and retried.
+            unfinished = self.datastore.list_suggestion_operations(
+                study_name, client_id, lambda op: not op.done
+            )
+            for op in unfinished:
+                if op.name in self._inflight_ops:
+                    return op
+                op.done = True
+                op.error = "Orphaned by server restart; retry."
+                self.datastore.update_suggestion_operation(op)
+
+            op_number = self.datastore.max_suggestion_operation_number(
+                study_name, client_id
+            ) + 1
+            sr = resources.StudyResource.from_name(study_name)
+            op = vizier_service_pb2.Operation(
+                name=resources.SuggestionOperationResource(
+                    sr.owner_id, sr.study_id, client_id, op_number
+                ).name
+            )
+            self.datastore.create_suggestion_operation(op)
+            self._inflight_ops.add(op.name)
+
+            try:
+                trials = self._suggest_locked(study, study_name, client_id, request)
+                op.response.trials.extend(trials)
+            except Exception as e:  # captured into the long-running op
+                op.error = f"{type(e).__name__}: {e}"
+            finally:
+                op.done = True
+                self.datastore.update_suggestion_operation(op)
+                self._inflight_ops.discard(op.name)
+            return op
+
+    def _suggest_locked(
+        self,
+        study: study_pb2.Study,
+        study_name: str,
+        client_id: str,
+        request: vizier_service_pb2.SuggestTrialsRequest,
+    ) -> List[study_pb2.Trial]:
+        count = request.suggestion_count or 1
+        all_trials = self.datastore.list_trials(study_name)
+
+        # 1. Reuse this client's ACTIVE trials.
+        active_for_client = [
+            t
+            for t in all_trials
+            if t.state == study_pb2.Trial.ACTIVE and t.assigned_worker == client_id
+        ]
+        if active_for_client:
+            return active_for_client[:count]
+
+        out: List[study_pb2.Trial] = []
+        sr = resources.StudyResource.from_name(study_name)
+
+        # 2. Drain the REQUESTED pool.
+        for t in all_trials:
+            if len(out) >= count:
+                break
+            if t.state == study_pb2.Trial.REQUESTED:
+                t.state = study_pb2.Trial.ACTIVE
+                t.assigned_worker = client_id
+                self.datastore.update_trial(t)
+                out.append(t)
+        if len(out) >= count:
+            return out
+
+        # 3. Ask Pythia for the remainder.
+        if self._pythia is None:
+            raise RuntimeError("No Pythia endpoint connected to the Vizier service.")
+        from vizier_tpu.service.protos import pythia_service_pb2
+
+        max_id = self.datastore.max_trial_id(study_name)
+        preq = pythia_service_pb2.PythiaSuggestRequest(
+            count=count - len(out),
+            algorithm=study.study_spec.algorithm,
+            study_name=study_name,
+        )
+        preq.study_descriptor.config.CopyFrom(study.study_spec)
+        preq.study_descriptor.guid = study_name
+        preq.study_descriptor.max_trial_id = max_id
+        presp = self._pythia.Suggest(preq)
+        if presp.error:
+            raise RuntimeError(f"Pythia error: {presp.error}")
+
+        # Materialize suggestions as trials: the first `remaining` become
+        # ACTIVE for this client; extras (policy over-produced) stay REQUESTED.
+        remaining = count - len(out)
+        next_id = self.datastore.max_trial_id(study_name)
+        for i, suggestion in enumerate(presp.suggestions):
+            next_id += 1
+            t = study_pb2.Trial()
+            t.CopyFrom(suggestion)
+            t.id = next_id
+            t.name = sr.trial_resource(next_id).name
+            t.creation_time_secs = time.time()
+            if i < remaining:
+                t.state = study_pb2.Trial.ACTIVE
+                t.assigned_worker = client_id
+            else:
+                t.state = study_pb2.Trial.REQUESTED
+            self.datastore.create_trial(t)
+            if i < remaining:
+                out.append(t)
+
+        # Persist policy metadata deltas AFTER trial creation so deltas
+        # addressed to the new suggestions' ids resolve; a bad delta must
+        # not lose the suggestion batch.
+        study_kvs, trial_kvs = [], []
+        for delta in presp.metadata_deltas:
+            for kv in delta.key_values:
+                if delta.trial_id == 0:
+                    study_kvs.append(kv)
+                else:
+                    trial_kvs.append((int(delta.trial_id), kv))
+        if study_kvs or trial_kvs:
+            try:
+                self.datastore.update_metadata(study_name, study_kvs, trial_kvs)
+            except datastore_lib.NotFoundError as e:
+                _logger.warning("Dropping policy metadata delta: %s", e)
+        return out
+
+    def GetOperation(
+        self, request: vizier_service_pb2.GetOperationRequest, context=None
+    ) -> vizier_service_pb2.Operation:
+        return self.datastore.get_suggestion_operation(request.name)
+
+    # -- trials ------------------------------------------------------------
+
+    def CreateTrial(
+        self, request: vizier_service_pb2.CreateTrialRequest, context=None
+    ) -> study_pb2.Trial:
+        study_name = request.parent
+        with self._study_locks[study_name]:
+            sr = resources.StudyResource.from_name(study_name)
+            trial = request.trial
+            trial.id = self.datastore.max_trial_id(study_name) + 1
+            trial.name = sr.trial_resource(trial.id).name
+            if trial.state == study_pb2.Trial.STATE_UNSPECIFIED:
+                trial.state = study_pb2.Trial.ACTIVE
+            trial.creation_time_secs = time.time()
+            self.datastore.create_trial(trial)
+            return trial
+
+    def GetTrial(
+        self, request: vizier_service_pb2.GetTrialRequest, context=None
+    ) -> study_pb2.Trial:
+        return self.datastore.get_trial(request.name)
+
+    def ListTrials(
+        self, request: vizier_service_pb2.ListTrialsRequest, context=None
+    ) -> vizier_service_pb2.ListTrialsResponse:
+        return vizier_service_pb2.ListTrialsResponse(
+            trials=self.datastore.list_trials(request.parent)
+        )
+
+    def AddTrialMeasurement(
+        self, request: vizier_service_pb2.AddTrialMeasurementRequest, context=None
+    ) -> study_pb2.Trial:
+        trial = self.datastore.get_trial(request.trial_name)
+        if trial.state in (study_pb2.Trial.SUCCEEDED, study_pb2.Trial.INFEASIBLE):
+            raise ValueError(f"Trial {request.trial_name} is already completed.")
+        trial.measurements.add().CopyFrom(request.measurement)
+        self.datastore.update_trial(trial)
+        return trial
+
+    def CompleteTrial(
+        self, request: vizier_service_pb2.CompleteTrialRequest, context=None
+    ) -> study_pb2.Trial:
+        trial = self.datastore.get_trial(request.name)
+        study_name = resources.TrialResource.from_name(request.name).study_resource.name
+        study = self.datastore.load_study(study_name)
+        if study.state == study_pb2.Study.COMPLETED:
+            raise ValueError(f"Study {study_name} is completed; trials are immutable.")
+        if trial.state in (study_pb2.Trial.SUCCEEDED, study_pb2.Trial.INFEASIBLE):
+            raise ValueError(f"Trial {request.name} is already completed.")
+
+        if request.HasField("final_measurement"):
+            trial.final_measurement.CopyFrom(request.final_measurement)
+            trial.state = study_pb2.Trial.SUCCEEDED
+        elif trial.measurements:
+            trial.final_measurement.CopyFrom(trial.measurements[-1])
+            trial.state = study_pb2.Trial.SUCCEEDED
+        else:
+            trial.state = study_pb2.Trial.INFEASIBLE
+            trial.infeasibility_reason = (
+                request.infeasible_reason or "Completed without any measurement."
+            )
+        if request.trial_infeasible:
+            trial.state = study_pb2.Trial.INFEASIBLE
+            trial.infeasibility_reason = request.infeasible_reason or "infeasible"
+        trial.completion_time_secs = time.time()
+        self.datastore.update_trial(trial)
+        return trial
+
+    def DeleteTrial(
+        self, request: vizier_service_pb2.DeleteTrialRequest, context=None
+    ) -> vizier_service_pb2.Empty:
+        self.datastore.delete_trial(request.name)
+        return vizier_service_pb2.Empty()
+
+    def StopTrial(
+        self, request: vizier_service_pb2.StopTrialRequest, context=None
+    ) -> study_pb2.Trial:
+        trial = self.datastore.get_trial(request.name)
+        if trial.state in (study_pb2.Trial.ACTIVE, study_pb2.Trial.REQUESTED):
+            trial.state = study_pb2.Trial.STOPPING
+            self.datastore.update_trial(trial)
+        return trial
+
+    # -- early stopping ----------------------------------------------------
+
+    def CheckTrialEarlyStoppingState(
+        self,
+        request: vizier_service_pb2.CheckTrialEarlyStoppingStateRequest,
+        context=None,
+    ) -> vizier_service_pb2.CheckTrialEarlyStoppingStateResponse:
+        tr = resources.TrialResource.from_name(request.trial_name)
+        study_name = tr.study_resource.name
+        with self._study_locks[study_name]:
+            op_resource = resources.EarlyStoppingOperationResource(
+                tr.owner_id, tr.study_id, tr.trial_id
+            )
+            now = time.time()
+            period = self._early_stop_recycle_period.total_seconds()
+            try:
+                op = self.datastore.get_early_stopping_operation(op_resource.name)
+                if op.status == vizier_service_pb2.EarlyStoppingOperation.DONE:
+                    expired = now - op.completion_time_secs > period
+                else:
+                    # A stale ACTIVE op (Pythia crashed mid-computation) must
+                    # also be recycled, or should_stop pins to False forever.
+                    expired = now - op.creation_time_secs > period
+                if not expired:
+                    return vizier_service_pb2.CheckTrialEarlyStoppingStateResponse(
+                        should_stop=op.should_stop
+                    )
+            except datastore_lib.NotFoundError:
+                pass
+
+            op = vizier_service_pb2.EarlyStoppingOperation(
+                name=op_resource.name,
+                status=vizier_service_pb2.EarlyStoppingOperation.ACTIVE,
+                creation_time_secs=now,
+            )
+            self.datastore.create_early_stopping_operation(op)
+
+            study = self.datastore.load_study(study_name)
+            if not study.study_spec.HasField("early_stopping"):
+                # Without a stopping config, nothing ever stops early.
+                op.status = vizier_service_pb2.EarlyStoppingOperation.DONE
+                op.should_stop = False
+                op.completion_time_secs = time.time()
+                self.datastore.update_early_stopping_operation(op)
+                return vizier_service_pb2.CheckTrialEarlyStoppingStateResponse(
+                    should_stop=False
+                )
+            if self._pythia is None:
+                raise RuntimeError("No Pythia endpoint connected.")
+            from vizier_tpu.service.protos import pythia_service_pb2
+
+            algorithm = study.study_spec.algorithm
+            preq = pythia_service_pb2.PythiaEarlyStopRequest(
+                trial_ids=[tr.trial_id],
+                algorithm=algorithm,
+                study_name=study_name,
+            )
+            preq.study_descriptor.config.CopyFrom(study.study_spec)
+            preq.study_descriptor.guid = study_name
+            preq.study_descriptor.max_trial_id = self.datastore.max_trial_id(study_name)
+            presp = self._pythia.EarlyStop(preq)
+            if presp.error:
+                raise RuntimeError(f"Pythia error: {presp.error}")
+
+            # Fan decisions out into per-trial ops (batch-aware policies may
+            # return decisions for other trials too).
+            should_stop = False
+            for decision in presp.decisions:
+                d_resource = resources.EarlyStoppingOperationResource(
+                    tr.owner_id, tr.study_id, int(decision.id)
+                )
+                d_op = vizier_service_pb2.EarlyStoppingOperation(
+                    name=d_resource.name,
+                    status=vizier_service_pb2.EarlyStoppingOperation.DONE,
+                    should_stop=decision.should_stop,
+                    creation_time_secs=now,
+                    completion_time_secs=time.time(),
+                )
+                self.datastore.create_early_stopping_operation(d_op)
+                if int(decision.id) == tr.trial_id:
+                    should_stop = decision.should_stop
+            return vizier_service_pb2.CheckTrialEarlyStoppingStateResponse(
+                should_stop=should_stop
+            )
+
+    # -- optimal trials ----------------------------------------------------
+
+    def ListOptimalTrials(
+        self, request: vizier_service_pb2.ListOptimalTrialsRequest, context=None
+    ) -> vizier_service_pb2.ListOptimalTrialsResponse:
+        study = self.datastore.load_study(request.parent)
+        trials = [
+            t
+            for t in self.datastore.list_trials(request.parent)
+            if t.state == study_pb2.Trial.SUCCEEDED and t.HasField("final_measurement")
+        ]
+        response = vizier_service_pb2.ListOptimalTrialsResponse()
+        if not trials:
+            return response
+
+        metric_specs = list(study.study_spec.metrics)
+        objective_specs = [m for m in metric_specs if not m.HasField("safety_config")]
+        if not objective_specs:
+            return response
+
+        # Matrix of objective values, sign-flipped so bigger is better.
+        values = np.full((len(trials), len(objective_specs)), -np.inf)
+        for i, t in enumerate(trials):
+            by_name = {m.name: m.value for m in t.final_measurement.metrics}
+            for j, spec in enumerate(objective_specs):
+                if spec.name in by_name:
+                    v = by_name[spec.name]
+                    values[i, j] = -v if spec.goal == study_pb2.MetricSpec.MINIMIZE else v
+
+        if values.shape[1] == 1:
+            best = np.nanargmax(values[:, 0])
+            response.optimal_trials.add().CopyFrom(trials[int(best)])
+            return response
+
+        # Pareto frontier via a pairwise domination matrix.
+        dominated = np.zeros(len(trials), dtype=bool)
+        for i in range(len(trials)):
+            if dominated[i]:
+                continue
+            geq = np.all(values >= values[i], axis=1)
+            gt = np.any(values > values[i], axis=1)
+            if np.any(geq & gt):
+                dominated[i] = True
+        for i, t in enumerate(trials):
+            if not dominated[i]:
+                response.optimal_trials.add().CopyFrom(t)
+        return response
+
+    # -- metadata ----------------------------------------------------------
+
+    def UpdateMetadata(
+        self, request: vizier_service_pb2.UpdateMetadataRequest, context=None
+    ) -> vizier_service_pb2.UpdateMetadataResponse:
+        study_kvs, trial_kvs = [], []
+        for delta in request.deltas:
+            if delta.trial_id == 0:
+                study_kvs.append(delta.key_value)
+            else:
+                trial_kvs.append((int(delta.trial_id), delta.key_value))
+        try:
+            self.datastore.update_metadata(request.name, study_kvs, trial_kvs)
+        except datastore_lib.NotFoundError as e:
+            return vizier_service_pb2.UpdateMetadataResponse(error_details=str(e))
+        return vizier_service_pb2.UpdateMetadataResponse()
