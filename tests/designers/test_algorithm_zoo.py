@@ -1,0 +1,497 @@
+"""Tests for the algorithm zoo: NSGA-II, CMA-ES, eagle, BOCS, harmonica,
+scalarizing, ensemble, scheduled, meta-learning, safety wrapper, pareto ops."""
+
+import jax
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.benchmarks import (
+    BenchmarkRunner,
+    BenchmarkState,
+    GenerateAndEvaluate,
+    NumpyExperimenter,
+    bbob_problem,
+)
+from vizier_tpu.benchmarks.experimenters.synthetic import bbob, multiobjective
+from vizier_tpu.ops import pareto as pareto_ops
+from vizier_tpu.pyvizier import multimetric
+from vizier_tpu.testing import test_runners
+
+
+def _mixed_problem():
+    p = vz.ProblemStatement()
+    p.search_space.root.add_float_param("x", 0.0, 1.0)
+    p.search_space.root.add_categorical_param("c", ["a", "b", "z"])
+    p.metric_information.append(
+        vz.MetricInformation(name="objective", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return p
+
+
+def _binary_problem(dim=4):
+    p = vz.ProblemStatement()
+    for i in range(dim):
+        p.search_space.root.add_bool_param(f"b{i}")
+    p.metric_information.append(
+        vz.MetricInformation(name="objective", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return p
+
+
+class TestParetoOps:
+    def test_frontier_and_rank(self):
+        pts = np.array(
+            [[1.0, 1.0], [2.0, 0.5], [0.5, 2.0], [0.4, 0.4], [2.0, 2.0]],
+            dtype=np.float32,
+        )
+        frontier = np.asarray(pareto_ops.is_frontier(pts))
+        assert frontier.tolist() == [False, False, False, False, True]
+        rank = np.asarray(pareto_ops.pareto_rank(pts))
+        assert rank[4] == 0 and rank[3] == 4
+
+    def test_layers(self):
+        pts = np.array([[2.0, 2.0], [1.0, 1.0], [0.5, 0.5]], dtype=np.float32)
+        layers = np.asarray(pareto_ops.nondomination_layers(pts))
+        assert layers.tolist() == [0, 1, 2]
+
+    def test_hypervolume_exact_square(self):
+        # Frontier {(1, 2), (2, 1)} vs origin: HV = 1*2 + 1*1 = 3.
+        pts = np.array([[1.0, 2.0], [2.0, 1.0]], dtype=np.float32)
+        hv = float(
+            pareto_ops.hypervolume(pts, rng=jax.random.PRNGKey(0), num_vectors=20000)
+        )
+        assert hv == pytest.approx(3.0, rel=0.05)
+
+    def test_multimetric_wrappers(self):
+        algo = multimetric.ParetoOptimalAlgorithm()
+        pts = np.array([[1.0, 0.0], [0.0, 1.0], [-1.0, -1.0]])
+        assert algo.is_pareto_optimal(pts).tolist() == [True, True, False]
+        frontier = multimetric.ParetoFrontier(
+            np.array([[1.0, 1.0]]), origin=np.zeros(2), num_vectors=20000
+        )
+        assert frontier.hypervolume() == pytest.approx(1.0, rel=0.05)
+
+    def test_safety_checker(self):
+        metrics = vz.MetricsConfig(
+            [
+                vz.MetricInformation(name="obj"),
+                vz.MetricInformation(name="safe", safety_threshold=0.5),
+            ]
+        )
+        checker = multimetric.SafetyChecker(metrics)
+        ok = vz.Trial(id=1)
+        ok.complete(vz.Measurement(metrics={"obj": 1.0, "safe": 0.9}))
+        bad = vz.Trial(id=2)
+        bad.complete(vz.Measurement(metrics={"obj": 1.0, "safe": 0.1}))
+        assert checker.is_safe(ok) and not checker.is_safe(bad)
+
+
+class TestNSGA2:
+    def test_smoke_mixed(self):
+        from vizier_tpu.designers.evolution import NSGA2Designer
+
+        problem = _mixed_problem()
+        designer = NSGA2Designer(problem, population_size=10, seed=1)
+        trials = test_runners.RandomMetricsRunner(
+            problem, iters=4, batch_size=5
+        ).run_designer(designer)
+        assert len(trials) == 20
+
+    def test_multiobjective_improves_hypervolume(self):
+        from vizier_tpu.designers.evolution import NSGA2Designer
+
+        exp = multiobjective.MultiObjectiveExperimenter.zdt("zdt1", dimension=6)
+        problem = exp.problem_statement()
+        designer = NSGA2Designer(problem, population_size=20, seed=0)
+        tid = 0
+        points = []
+        for _ in range(10):
+            batch = [s.to_trial(tid + i + 1) for i, s in enumerate(designer.suggest(10))]
+            tid += len(batch)
+            exp.evaluate(batch)
+            designer.update(core_lib.CompletedTrials(batch))
+            points.append(
+                np.array(
+                    [
+                        [m.value for m in t.final_measurement.metrics.values()]
+                        for t in batch
+                    ]
+                )
+            )
+        # MINIMIZE both: early generations should dominate... late ones better.
+        early = points[0].min(axis=0)
+        late = points[-1].min(axis=0)
+        assert late[1] <= early[1] + 0.2  # f2 improves (or stays comparable)
+
+    def test_serialization(self):
+        from vizier_tpu.designers.evolution import NSGA2Designer
+
+        problem = _mixed_problem()
+        d1 = NSGA2Designer(problem, population_size=5, seed=1)
+        test_runners.RandomMetricsRunner(problem, iters=2, batch_size=5).run_designer(d1)
+        d2 = NSGA2Designer(problem, population_size=5, seed=1)
+        d2.load(d1.dump())
+        assert len(d2._population) == len(d1._population)
+
+
+class TestCMAES:
+    def test_converges_on_sphere(self):
+        from vizier_tpu.designers.cmaes import CMAESDesigner
+
+        problem = bbob_problem(3)
+        exp = NumpyExperimenter(bbob.Sphere, problem)
+        state = BenchmarkState.from_designer_factory(
+            exp, lambda p, **kw: CMAESDesigner(p, seed=0)
+        )
+        BenchmarkRunner([GenerateAndEvaluate(8)], num_repeats=25).run(state)
+        trials = state.algorithm.supporter.GetTrials(
+            status_matches=vz.TrialStatus.COMPLETED
+        )
+        best = min(t.final_measurement.metrics["bbob_eval"].value for t in trials)
+        assert best < 1.0  # random baseline is ~5+ on 3D [-5,5]^3 sphere
+
+    def test_rejects_categorical(self):
+        from vizier_tpu.designers.cmaes import CMAESDesigner
+
+        with pytest.raises(ValueError):
+            CMAESDesigner(_mixed_problem())
+
+
+class TestEagleDesigner:
+    def test_smoke_and_improvement(self):
+        from vizier_tpu.designers.eagle_strategy import EagleStrategyDesigner
+
+        problem = bbob_problem(2)
+        exp = NumpyExperimenter(bbob.Sphere, problem)
+        state = BenchmarkState.from_designer_factory(
+            exp, lambda p, **kw: EagleStrategyDesigner(p, seed=0)
+        )
+        BenchmarkRunner([GenerateAndEvaluate(6)], num_repeats=25).run(state)
+        trials = state.algorithm.supporter.GetTrials(
+            status_matches=vz.TrialStatus.COMPLETED
+        )
+        values = [t.final_measurement.metrics["bbob_eval"].value for t in trials]
+        assert min(values) < np.median(values[:12])  # improves over early random
+
+    def test_serialization_roundtrip(self):
+        from vizier_tpu.designers.eagle_strategy import EagleStrategyDesigner
+
+        problem = _mixed_problem()
+        d1 = EagleStrategyDesigner(problem, seed=3)
+        test_runners.RandomMetricsRunner(problem, iters=3, batch_size=4).run_designer(d1)
+        d2 = EagleStrategyDesigner(problem, seed=3)
+        d2.load(d1.dump())
+        np.testing.assert_array_equal(d2._rewards, d1._rewards)
+
+
+class TestBOCSAndHarmonica:
+    def _quadratic_binary(self, trials):
+        # Optimum at all-True.
+        for t in trials:
+            bits = [1.0 if t.parameters.get_value(f"b{i}") == "True" else 0.0 for i in range(4)]
+            t.complete(
+                vz.Measurement(metrics={"objective": sum(bits) + bits[0] * bits[1]})
+            )
+
+    @pytest.mark.parametrize("designer_name", ["bocs", "harmonica"])
+    def test_finds_good_bits(self, designer_name):
+        if designer_name == "bocs":
+            from vizier_tpu.designers.bocs import BOCSDesigner as D
+        else:
+            from vizier_tpu.designers.harmonica import HarmonicaDesigner as D
+        problem = _binary_problem(4)
+        designer = D(problem, seed=0)
+        tid = 0
+        best = -np.inf
+        for _ in range(12):
+            batch = [s.to_trial(tid + i + 1) for i, s in enumerate(designer.suggest(4))]
+            tid += len(batch)
+            self._quadratic_binary(batch)
+            designer.update(core_lib.CompletedTrials(batch))
+            best = max(
+                best,
+                max(t.final_measurement.metrics["objective"].value for t in batch),
+            )
+        assert best >= 4.0  # found at least 4/5 of max (5.0)
+
+    def test_bocs_rejects_nonbinary(self):
+        from vizier_tpu.designers.bocs import BOCSDesigner
+
+        with pytest.raises(ValueError):
+            BOCSDesigner(_mixed_problem())
+
+
+class TestScalarizingDesigner:
+    def test_multiobjective_to_single(self):
+        from vizier_tpu.designers.scalarizing_designer import ScalarizingDesigner
+        from vizier_tpu.designers import scalarization
+
+        exp = multiobjective.MultiObjectiveExperimenter.zdt("zdt1", dimension=3)
+        problem = exp.problem_statement()
+        designer = ScalarizingDesigner(
+            problem,
+            scalarization=scalarization.LinearScalarization(weights=(0.5, 0.5)),
+            designer_factory=lambda p, **kw: __import__(
+                "vizier_tpu.designers.random", fromlist=["RandomDesigner"]
+            ).RandomDesigner(p.search_space, seed=0),
+        )
+        tid = 0
+        for _ in range(3):
+            batch = [s.to_trial(tid + i + 1) for i, s in enumerate(designer.suggest(3))]
+            tid += len(batch)
+            exp.evaluate(batch)
+            designer.update(core_lib.CompletedTrials(batch))
+        assert tid == 9
+
+
+class TestEnsembleDesigner:
+    def test_routes_and_learns(self):
+        from vizier_tpu.designers.ensemble import (
+            EnsembleDesigner,
+            EXP3IXEnsembleDesign,
+            UCBEnsembleDesign,
+            RandomEnsembleDesign,
+        )
+        from vizier_tpu.designers import RandomDesigner, QuasiRandomDesigner
+
+        problem = _mixed_problem()
+        designer = EnsembleDesigner(
+            problem,
+            designers={
+                "random": RandomDesigner(problem.search_space, seed=0),
+                "quasi": QuasiRandomDesigner(problem.search_space, seed=0),
+            },
+            design=EXP3IXEnsembleDesign(2),
+            seed=0,
+        )
+        trials = test_runners.RandomMetricsRunner(
+            problem, iters=6, batch_size=2
+        ).run_designer(designer)
+        assert len(trials) == 12
+        experts = {t.metadata.ns("ensemble").get("expert") for t in trials}
+        assert experts <= {"random", "quasi"}
+
+    def test_designs_select_valid_arms(self):
+        from vizier_tpu.designers import ensemble
+
+        rng = np.random.default_rng(0)
+        for design in (
+            ensemble.RandomEnsembleDesign(3),
+            ensemble.EXP3UniformEnsembleDesign(3),
+            ensemble.EXP3IXEnsembleDesign(3),
+            ensemble.UCBEnsembleDesign(3),
+        ):
+            for _ in range(10):
+                arm = design.select(rng)
+                assert 0 <= arm < 3
+                design.observe(arm, rng.uniform())
+            probs = design.probabilities
+            assert probs.shape == (3,)
+            assert probs.sum() == pytest.approx(1.0, abs=1e-6)
+
+
+class TestScheduledDesigner:
+    def test_schedule_values_change(self):
+        from vizier_tpu.designers.scheduled_designer import (
+            ExponentialSchedule,
+            LinearSchedule,
+            ScheduledDesigner,
+        )
+        from vizier_tpu.designers import RandomDesigner
+
+        sched = ExponentialSchedule(2.5, 0.8)
+        assert sched(0.0) == pytest.approx(2.5)
+        assert sched(1.0) == pytest.approx(0.8)
+        assert 0.8 < sched(0.5) < 2.5
+        lin = LinearSchedule(0.0, 10.0)
+        assert lin(0.3) == pytest.approx(3.0)
+
+        built = []
+
+        def factory(problem, scale):
+            built.append(scale)
+            return RandomDesigner(problem.search_space, seed=0)
+
+        problem = _mixed_problem()
+        designer = ScheduledDesigner(
+            problem,
+            designer_factory=factory,
+            scheduled_params={"scale": LinearSchedule(1.0, 0.0)},
+            expected_total_num_trials=4,
+        )
+        test_runners.RandomMetricsRunner(problem, iters=4, batch_size=1).run_designer(
+            designer
+        )
+        assert len(built) >= 2  # rebuilt as the schedule advanced
+        assert built[0] == pytest.approx(1.0)
+
+
+class TestMetaLearning:
+    def test_meta_rounds(self):
+        from vizier_tpu.designers.meta_learning import (
+            MetaLearningConfig,
+            MetaLearningDesigner,
+        )
+        from vizier_tpu.designers import RandomDesigner
+
+        problem = _mixed_problem()
+        tuning_space = vz.SearchSpace()
+        tuning_space.root.add_float_param("dummy", 0.0, 1.0)
+        builds = []
+
+        def inner_factory(p, dummy):
+            builds.append(dummy)
+            return RandomDesigner(p.search_space, seed=0)
+
+        designer = MetaLearningDesigner(
+            problem,
+            tuning_space=tuning_space,
+            inner_factory=inner_factory,
+            config=MetaLearningConfig(tuning_interval=4),
+            seed=0,
+        )
+        test_runners.RandomMetricsRunner(problem, iters=10, batch_size=1).run_designer(
+            designer
+        )
+        assert len(builds) >= 2  # at least two meta rounds happened
+
+
+class TestUnsafeAsInfeasible:
+    def test_unsafe_becomes_infeasible(self):
+        from vizier_tpu.designers.unsafe_as_infeasible_designer import (
+            UnsafeAsInfeasibleDesigner,
+        )
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 1.0)
+        problem.metric_information.append(vz.MetricInformation(name="obj"))
+        problem.metric_information.append(
+            vz.MetricInformation(name="safe", safety_threshold=0.5)
+        )
+        seen = []
+
+        class Recorder(core_lib.Designer):
+            def update(self, completed, all_active=core_lib.ActiveTrials()):
+                seen.extend(completed.trials)
+
+            def suggest(self, count=None):
+                return [vz.TrialSuggestion(parameters={"x": 0.5})]
+
+        designer = UnsafeAsInfeasibleDesigner(
+            problem, designer_factory=lambda p, **kw: Recorder()
+        )
+        safe = vz.Trial(id=1, parameters={"x": 0.1})
+        safe.complete(vz.Measurement(metrics={"obj": 1.0, "safe": 0.9}))
+        unsafe = vz.Trial(id=2, parameters={"x": 0.9})
+        unsafe.complete(vz.Measurement(metrics={"obj": 2.0, "safe": 0.1}))
+        designer.update(core_lib.CompletedTrials([safe, unsafe]))
+        assert not seen[0].infeasible
+        assert seen[1].infeasible
+
+
+class TestServiceIntegration:
+    @pytest.mark.parametrize(
+        "algorithm", ["NSGA2", "EAGLE_STRATEGY", "QUASI_RANDOM_SEARCH"]
+    )
+    def test_algorithms_through_service(self, algorithm):
+        from vizier_tpu.service import clients as clients_lib
+        from vizier_tpu.service import vizier_client
+
+        vizier_client._local_servicer = None
+        config = vz.StudyConfig(algorithm=algorithm)
+        config.search_space.root.add_float_param("x", 0.0, 1.0)
+        config.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        study = clients_lib.Study.from_study_config(
+            config, owner="me", study_id=f"zoo-{algorithm}"
+        )
+        for _ in range(2):
+            for trial in study.suggest(count=2):
+                trial.complete(vz.Measurement(metrics={"obj": trial.parameters["x"]}))
+        assert len(list(study.trials())) == 4
+
+
+class TestReviewRegressions:
+    """Regressions from the sixth code review."""
+
+    def test_meta_first_round_reward_neutral(self):
+        from vizier_tpu.designers.meta_learning import (
+            MetaLearningConfig,
+            MetaLearningDesigner,
+        )
+        from vizier_tpu.designers import RandomDesigner
+
+        problem = _mixed_problem()
+        tuning_space = vz.SearchSpace()
+        tuning_space.root.add_float_param("dummy", 0.0, 1.0)
+        rewards = []
+
+        class MetaRecorder(core_lib.Designer):
+            def __init__(self, space):
+                self._inner = RandomDesigner(space, seed=0)
+
+            def update(self, completed, all_active=core_lib.ActiveTrials()):
+                for t in completed.trials:
+                    rewards.append(t.final_measurement.metrics["meta_reward"].value)
+
+            def suggest(self, count=None):
+                return self._inner.suggest(count)
+
+        designer = MetaLearningDesigner(
+            problem,
+            tuning_space=tuning_space,
+            inner_factory=lambda p, dummy: RandomDesigner(p.search_space, seed=0),
+            meta_factory=lambda p, **kw: MetaRecorder(p.search_space),
+            config=MetaLearningConfig(tuning_interval=3),
+            seed=0,
+        )
+        test_runners.RandomMetricsRunner(problem, iters=8, batch_size=1).run_designer(
+            designer
+        )
+        assert rewards, "meta designer never scored a round"
+        assert all(abs(r) < 100 for r in rewards), rewards
+
+    def test_gp_ucb_pe_trust_region_flag(self):
+        import jax
+        from vizier_tpu.designers.gp_ucb_pe import VizierGPUCBPEBandit
+        from vizier_tpu.optimizers.lbfgs import AdamOptimizer
+
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", 0.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="objective", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        d = VizierGPUCBPEBandit(
+            p,
+            use_trust_region=False,
+            max_acquisition_evaluations=300,
+            ard_restarts=2,
+            ard_optimizer=AdamOptimizer(maxiter=20),
+        )
+        trials = test_runners.RandomMetricsRunner(p, iters=3, batch_size=2).run_designer(d)
+        assert len(trials) == 6
+
+    def test_scheduled_designer_does_not_rebuild_every_call(self):
+        from vizier_tpu.designers.scheduled_designer import LinearSchedule, ScheduledDesigner
+        from vizier_tpu.designers import RandomDesigner
+
+        builds = []
+
+        def factory(problem, scale):
+            builds.append(scale)
+            return RandomDesigner(problem.search_space, seed=0)
+
+        problem = _mixed_problem()
+        designer = ScheduledDesigner(
+            problem,
+            designer_factory=factory,
+            scheduled_params={"scale": LinearSchedule(1.0, 0.99)},
+            expected_total_num_trials=1000,
+        )
+        test_runners.RandomMetricsRunner(problem, iters=10, batch_size=1).run_designer(
+            designer
+        )
+        assert len(builds) == 1  # tiny schedule drift must not rebuild

@@ -3,3 +3,37 @@
 from vizier_tpu.designers.grid import GridSearchDesigner
 from vizier_tpu.designers.quasi_random import HaltonSequence, QuasiRandomDesigner
 from vizier_tpu.designers.random import RandomDesigner
+
+__all__ = [
+    "GridSearchDesigner",
+    "HaltonSequence",
+    "QuasiRandomDesigner",
+    "RandomDesigner",
+]
+
+
+def __getattr__(name):
+    # Heavy (jax-importing) designers load lazily.
+    lazy = {
+        "VizierGPBandit": ("vizier_tpu.designers.gp_bandit", "VizierGPBandit"),
+        "VizierGPUCBPEBandit": ("vizier_tpu.designers.gp_ucb_pe", "VizierGPUCBPEBandit"),
+        "NSGA2Designer": ("vizier_tpu.designers.evolution", "NSGA2Designer"),
+        "CMAESDesigner": ("vizier_tpu.designers.cmaes", "CMAESDesigner"),
+        "EagleStrategyDesigner": ("vizier_tpu.designers.eagle_strategy", "EagleStrategyDesigner"),
+        "BOCSDesigner": ("vizier_tpu.designers.bocs", "BOCSDesigner"),
+        "HarmonicaDesigner": ("vizier_tpu.designers.harmonica", "HarmonicaDesigner"),
+        "ScalarizingDesigner": ("vizier_tpu.designers.scalarizing_designer", "ScalarizingDesigner"),
+        "EnsembleDesigner": ("vizier_tpu.designers.ensemble", "EnsembleDesigner"),
+        "ScheduledDesigner": ("vizier_tpu.designers.scheduled_designer", "ScheduledDesigner"),
+        "MetaLearningDesigner": ("vizier_tpu.designers.meta_learning", "MetaLearningDesigner"),
+        "UnsafeAsInfeasibleDesigner": (
+            "vizier_tpu.designers.unsafe_as_infeasible_designer",
+            "UnsafeAsInfeasibleDesigner",
+        ),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(name)

@@ -1,0 +1,175 @@
+"""EagleStrategyDesigner: ask/tell firefly algorithm as a Designer.
+
+Parity with
+``/root/reference/vizier/_src/algorithms/designers/eagle_strategy/eagle_strategy.py:95``:
+a pool of fireflies explores the scaled feature space; each suggestion is a
+perturbed move of one fly (tagged in metadata), and ``update`` feeds the
+objective back to that fly — improving moves are adopted, failing flies lose
+perturbation and are eventually re-seeded. State is partially serializable.
+
+Shares the firefly force model with the vectorized acquisition optimizer
+(``vizier_tpu.optimizers.eagle``) but lives at the trial level: evaluations
+here are real (expensive) trials, not acquisition scores.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.converters import core as converters
+from vizier_tpu.optimizers import eagle as eagle_lib
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import common
+from vizier_tpu.pyvizier import trial as trial_
+from vizier_tpu.utils import json_utils, serializable
+
+_NS = "eagle"
+
+
+@dataclasses.dataclass
+class EagleStrategyDesigner(core_lib.PartiallySerializableDesigner):
+    problem: base_study_config.ProblemStatement
+    config: eagle_lib.EagleStrategyConfig = dataclasses.field(
+        default_factory=lambda: eagle_lib.EagleStrategyConfig(pool_size=12)
+    )
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        self._converter = converters.TrialToModelInputConverter.from_problem(
+            self.problem
+        )
+        self._enc = self._converter.encoder
+        self._rng = np.random.default_rng(self.seed)
+        p = self.config.pool_size
+        self._features = self._rng.uniform(size=(p, self._enc.num_continuous))
+        self._categorical = np.stack(
+            [
+                self._rng.integers(0, max(s, 1), size=p)
+                for s in (self._enc.category_sizes or [1])
+            ],
+            axis=1,
+        )[:, : self._enc.num_categorical].astype(np.int32)
+        if self._enc.num_categorical == 0:
+            self._categorical = np.zeros((p, 0), dtype=np.int32)
+        self._rewards = np.full(p, -np.inf)
+        self._perturbations = np.full(p, self.config.perturbation)
+        self._next_fly = 0
+
+    # -- ask ---------------------------------------------------------------
+
+    def _propose_move(self, fly: int) -> tuple:
+        cfg = self.config
+        x = self._features[fly]
+        pull = np.zeros_like(x)
+        if np.isfinite(self._rewards[fly]):
+            for other in range(cfg.pool_size):
+                if other == fly or not np.isfinite(self._rewards[other]):
+                    continue
+                diff = self._features[other] - x
+                scale = np.exp(-np.sum(diff**2) / (2 * cfg.visibility**2 + 1e-12))
+                if self._rewards[other] > self._rewards[fly]:
+                    pull += cfg.gravity * scale * diff
+                else:
+                    pull -= cfg.negative_gravity * scale * diff
+            pull /= max(cfg.pool_size - 1, 1)
+        new_x = np.clip(
+            x + pull + self._perturbations[fly] * self._rng.standard_normal(x.shape),
+            0.0,
+            1.0,
+        )
+        cat = self._categorical[fly].copy()
+        for j, size in enumerate(self._enc.category_sizes):
+            if self._rng.uniform() < min(
+                self._perturbations[fly] * cfg.categorical_perturbation_factor, 1.0
+            ):
+                cat[j] = self._rng.integers(0, size)
+        return new_x, cat
+
+    def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
+        count = count or 1
+        out = []
+        for _ in range(count):
+            fly = self._next_fly % self.config.pool_size
+            self._next_fly += 1
+            new_x, cat = self._propose_move(fly)
+            params = self._converter.to_parameters(
+                new_x[None, :], cat[None, :]
+            )[0]
+            s = trial_.TrialSuggestion(parameters=params)
+            s.metadata.ns(_NS)["fly"] = str(fly)
+            out.append(s)
+        return out
+
+    # -- tell --------------------------------------------------------------
+
+    def update(
+        self,
+        completed: core_lib.CompletedTrials,
+        all_active: core_lib.ActiveTrials = core_lib.ActiveTrials(),
+    ) -> None:
+        del all_active
+        cfg = self.config
+        for t in completed.trials:
+            labels = self._converter.metrics.encode([t])[0]
+            reward = labels[0] if np.isfinite(labels[0]) else -np.inf
+            fly_raw = t.metadata.ns(_NS).get("fly")
+            if fly_raw is None:
+                # Foreign trial (e.g. prior data): adopt into the weakest fly.
+                fly = int(np.argmin(self._rewards))
+            else:
+                fly = int(fly_raw) % cfg.pool_size
+            cont, cat = self._enc.encode([t])
+            if reward > self._rewards[fly]:
+                self._features[fly] = cont[0]
+                if self._enc.num_categorical:
+                    self._categorical[fly] = cat[0]
+                self._rewards[fly] = reward
+                self._perturbations[fly] = cfg.perturbation
+            else:
+                self._perturbations[fly] *= cfg.penalize_factor
+                if self._perturbations[fly] < cfg.perturbation_lower_bound:
+                    best = int(np.argmax(self._rewards))
+                    if fly != best:
+                        self._features[fly] = self._rng.uniform(
+                            size=self._enc.num_continuous
+                        )
+                        if self._enc.num_categorical:
+                            self._categorical[fly] = [
+                                self._rng.integers(0, s)
+                                for s in self._enc.category_sizes
+                            ]
+                        self._rewards[fly] = -np.inf
+                        self._perturbations[fly] = cfg.perturbation
+
+    # -- PartiallySerializable --------------------------------------------
+
+    def dump(self) -> common.Metadata:
+        md = common.Metadata()
+        md["eagle"] = json_utils.dumps(
+            {
+                "features": self._features,
+                "categorical": self._categorical,
+                "rewards": self._rewards,
+                "perturbations": self._perturbations,
+                "next_fly": self._next_fly,
+            }
+        )
+        return md
+
+    def load(self, metadata: common.Metadata) -> None:
+        raw = metadata.get("eagle")
+        if raw is None:
+            raise serializable.DecodeError("Missing 'eagle' state.")
+        try:
+            state = json_utils.loads(raw)
+            self._features = np.asarray(state["features"], dtype=np.float64)
+            self._categorical = np.asarray(state["categorical"], dtype=np.int32)
+            self._rewards = np.asarray(state["rewards"], dtype=np.float64)
+            self._perturbations = np.asarray(state["perturbations"], dtype=np.float64)
+            self._next_fly = int(state["next_fly"])
+        except (KeyError, ValueError, TypeError) as e:
+            raise serializable.DecodeError(f"Bad eagle state: {e}")

@@ -1,0 +1,208 @@
+"""Evolution scaffolding + NSGA-II.
+
+Parity with ``/root/reference/vizier/_src/algorithms/evolution/``
+(``templates.py`` ask/tell scaffolding + ``numpy_populations.py`` population
+containers + ``nsga2.py:244``): a canonical evolution designer drives
+(population → selection → offspring) generations from completed trials; the
+NSGA-II ranking (nondomination layers + crowding distance) runs on the XLA
+ops in ``vizier_tpu.ops.pareto``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.converters import core as converters
+from vizier_tpu.ops import pareto as pareto_ops
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+
+@dataclasses.dataclass
+class Population:
+    """Genomes in model space ([N, Dc] floats in [0,1] + [N, Ds] ints)."""
+
+    continuous: np.ndarray
+    categorical: np.ndarray
+    objectives: np.ndarray  # [N, M] all-MAXIMIZE; NaN = unevaluated
+
+    def __len__(self) -> int:
+        return self.continuous.shape[0]
+
+    @classmethod
+    def concat(cls, pops: Sequence["Population"]) -> "Population":
+        return cls(
+            continuous=np.concatenate([p.continuous for p in pops]),
+            categorical=np.concatenate([p.categorical for p in pops]),
+            objectives=np.concatenate([p.objectives for p in pops]),
+        )
+
+    def take(self, idx: np.ndarray) -> "Population":
+        return Population(
+            continuous=self.continuous[idx],
+            categorical=self.categorical[idx],
+            objectives=self.objectives[idx],
+        )
+
+
+def nsga2_survival(population: Population, target_size: int) -> Population:
+    """NSGA-II elitist survival: layer rank, then crowding distance."""
+    points = np.asarray(population.objectives, dtype=np.float32)
+    finite = np.all(np.isfinite(points), axis=1)
+    points = np.where(finite[:, None], points, -1e30)
+    layers = np.asarray(pareto_ops.nondomination_layers(points))
+    crowding = np.asarray(
+        pareto_ops.crowding_distance(points, layers)
+    )
+    # Sort: lower layer first; within layer, higher crowding first.
+    order = np.lexsort((-crowding, layers))
+    return population.take(order[:target_size])
+
+
+@dataclasses.dataclass
+class UniformMutation:
+    """Gaussian perturbation of continuous genes + categorical resampling."""
+
+    scale: float = 0.1
+    categorical_mutate_prob: float = 0.1
+
+    def __call__(
+        self,
+        parents: Population,
+        category_sizes: Sequence[int],
+        rng: np.random.Generator,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        n, dc = parents.continuous.shape
+        cont = parents.continuous + rng.normal(0.0, self.scale, size=(n, dc))
+        cont = np.clip(cont, 0.0, 1.0)
+        cat = parents.categorical.copy()
+        for j, size in enumerate(category_sizes):
+            mutate = rng.uniform(size=n) < self.categorical_mutate_prob
+            cat[mutate, j] = rng.integers(0, size, size=int(mutate.sum()))
+        return cont, cat
+
+
+def sbx_crossover(
+    a: np.ndarray, b: np.ndarray, rng: np.random.Generator, eta: float = 15.0
+) -> np.ndarray:
+    """Simulated binary crossover for continuous genes (one child per pair)."""
+    u = rng.uniform(size=a.shape)
+    beta = np.where(
+        u <= 0.5,
+        (2.0 * u) ** (1.0 / (eta + 1.0)),
+        (1.0 / (2.0 * (1.0 - u))) ** (1.0 / (eta + 1.0)),
+    )
+    child = 0.5 * ((1 + beta) * a + (1 - beta) * b)
+    return np.clip(child, 0.0, 1.0)
+
+
+@dataclasses.dataclass
+class NSGA2Designer(core_lib.PartiallySerializableDesigner):
+    """NSGA-II over flat search spaces; single- or multi-objective."""
+
+    problem: base_study_config.ProblemStatement
+    population_size: int = 50
+    mutation: UniformMutation = dataclasses.field(default_factory=UniformMutation)
+    eta: float = 15.0
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        self._converter = converters.TrialToModelInputConverter.from_problem(
+            self.problem
+        )
+        self._enc = self._converter.encoder
+        self._rng = np.random.default_rng(self.seed)
+        m = self._converter.metrics.num_metrics
+        self._population = Population(
+            continuous=np.zeros((0, self._enc.num_continuous)),
+            categorical=np.zeros((0, self._enc.num_categorical), dtype=np.int32),
+            objectives=np.zeros((0, m)),
+        )
+
+    def update(
+        self,
+        completed: core_lib.CompletedTrials,
+        all_active: core_lib.ActiveTrials = core_lib.ActiveTrials(),
+    ) -> None:
+        del all_active
+        trials = list(completed.trials)
+        if not trials:
+            return
+        cont, cat = self._enc.encode(trials)
+        objectives = self._converter.metrics.encode(trials)  # all-MAXIMIZE
+        newcomers = Population(cont, cat.astype(np.int32), objectives)
+        merged = Population.concat([self._population, newcomers])
+        self._population = nsga2_survival(merged, self.population_size)
+
+    def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
+        count = count or 1
+        out: List[trial_.TrialSuggestion] = []
+        pop = self._population
+        evaluated = len(pop) > 0 and np.isfinite(pop.objectives).any()
+        for _ in range(count):
+            if not evaluated or len(pop) < 2:
+                cont = self._rng.uniform(size=(1, self._enc.num_continuous))
+                cat = np.asarray(
+                    [
+                        [self._rng.integers(0, s) for s in self._enc.category_sizes]
+                    ],
+                    dtype=np.int32,
+                ).reshape(1, self._enc.num_categorical)
+            else:
+                # Binary tournament on (layer, crowding) implicit in survival
+                # order: earlier rows are better.
+                i = min(self._rng.integers(0, len(pop)), self._rng.integers(0, len(pop)))
+                j = min(self._rng.integers(0, len(pop)), self._rng.integers(0, len(pop)))
+                child_cont = sbx_crossover(
+                    pop.continuous[i : i + 1], pop.continuous[j : j + 1], self._rng, self.eta
+                )
+                pick = self._rng.uniform(size=(1, self._enc.num_categorical)) < 0.5
+                child_cat = np.where(
+                    pick, pop.categorical[i : i + 1], pop.categorical[j : j + 1]
+                )
+                parents = Population(
+                    child_cont,
+                    child_cat.astype(np.int32),
+                    np.full((1, pop.objectives.shape[1]), np.nan),
+                )
+                cont, cat = self.mutation(parents, self._enc.category_sizes, self._rng)
+            params = self._converter.to_parameters(cont, cat)[0]
+            out.append(trial_.TrialSuggestion(parameters=params))
+        return out
+
+    # -- PartiallySerializable --------------------------------------------
+
+    def dump(self):
+        from vizier_tpu.pyvizier import common
+        from vizier_tpu.utils import json_utils
+
+        md = common.Metadata()
+        md["population"] = json_utils.dumps(
+            {
+                "continuous": self._population.continuous,
+                "categorical": self._population.categorical,
+                "objectives": self._population.objectives,
+            }
+        )
+        return md
+
+    def load(self, metadata) -> None:
+        from vizier_tpu.utils import json_utils, serializable
+
+        raw = metadata.get("population")
+        if raw is None:
+            raise serializable.DecodeError("Missing 'population'.")
+        try:
+            state = json_utils.loads(raw)
+            self._population = Population(
+                continuous=np.asarray(state["continuous"], dtype=np.float64),
+                categorical=np.asarray(state["categorical"], dtype=np.int32),
+                objectives=np.asarray(state["objectives"], dtype=np.float64),
+            )
+        except (KeyError, ValueError, TypeError) as e:
+            raise serializable.DecodeError(f"Bad population state: {e}")

@@ -144,13 +144,28 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         self._rng, out = jax.random.split(self._rng)
         return out
 
-    def _warped_model_data(self) -> types.ModelData:
-        """Encode + warp labels + pad. Labels leave here all-MAXIMIZE ~N(0,1)."""
+    def _warped_model_data(self, extra_rows: int = 0) -> types.ModelData:
+        """Encode + warp labels + pad. Labels leave here all-MAXIMIZE ~N(0,1).
+
+        ``extra_rows`` reserves additional padded capacity (e.g. for batch
+        fantasy conditioning in GP-UCB-PE).
+        """
         conv = self._converter
+        n = len(self._trials)
         raw_labels = conv.metrics.encode(self._trials)  # [N, M], NaN infeasible
         warped = self._warper(raw_labels[:, self.metric_index])
-        n_pad = conv.padding.pad_trials(len(self._trials))
-        features = conv.to_features(self._trials)
+        n_pad = conv.padding.pad_trials(n + extra_rows)
+        cont, cat = conv.encoder.encode(self._trials)
+        dc_pad = conv.padding.pad_features(conv.encoder.num_continuous)
+        ds_pad = conv.padding.pad_features(conv.encoder.num_categorical)
+        features = types.ContinuousAndCategorical(
+            continuous=types.PaddedArray.from_array(
+                cont.astype(np.float32), (n_pad, dc_pad)
+            ),
+            categorical=types.PaddedArray.from_array(
+                cat.astype(np.int32), (n_pad, ds_pad), fill_value=0
+            ),
+        )
         labels = types.PaddedArray.from_array(
             warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
         )

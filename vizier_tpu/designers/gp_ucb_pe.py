@@ -1,0 +1,186 @@
+"""VizierGPUCBPEBandit: the DEFAULT algorithm (GP-UCB with Pure Exploration).
+
+Parity with ``/root/reference/vizier/_src/algorithms/designers/gp_ucb_pe.py:609``
+(the service default, ``policy_factory.py:40-47``; algorithm from Contal et
+al., "Parallel Gaussian Process Optimization with UCB and Pure Exploration"):
+the first suggestion of a batch maximizes UCB; the rest maximize posterior
+stddev (pure exploration) restricted to the *relevant region*
+``{x : UCB(x) >= max LCB}``, with the GP fantasy-conditioned on each picked
+point (label = posterior mean) so PE picks don't collapse onto each other.
+
+TPU-first: the WHOLE batch loop — per-pick Cholesky re-conditioning, region
+penalty, and the eagle acquisition sweep — is one jitted ``fori_loop``;
+fantasy points are written into spare padded rows of the same ``GPData`` (no
+reshapes, no retraces across batch sizes within a padding bucket).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vizier_tpu import types
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.designers import gp_bandit
+from vizier_tpu.designers.gp import acquisitions
+from vizier_tpu.models import gp as gp_lib
+from vizier_tpu.models import kernels
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+from vizier_tpu.optimizers import vectorized as vectorized_lib
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+Array = jax.Array
+
+
+def _append_fantasy(
+    data: gp_lib.GPData, x: kernels.MixedFeatures, label: Array
+) -> gp_lib.GPData:
+    """Writes (x, label) into the first padded row (no-op if at capacity)."""
+    idx = jnp.sum(data.row_mask.astype(jnp.int32))  # first free slot
+    return gp_lib.GPData(
+        continuous=data.continuous.at[idx].set(x.continuous[0]),
+        categorical=data.categorical.at[idx].set(x.categorical[0]),
+        labels=data.labels.at[idx].set(label),
+        row_mask=data.row_mask.at[idx].set(True),
+        cont_dim_mask=data.cont_dim_mask,
+        cat_dim_mask=data.cat_dim_mask,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "model",
+        "vec_opt",
+        "count",
+        "ucb_coefficient",
+        "explore_coefficient",
+        "use_trust_region",
+    ),
+)
+def _suggest_batch(
+    model: gp_lib.VizierGaussianProcess,
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    ens_params: gp_lib.Params,  # unconstrained, leading ensemble axis
+    data: gp_lib.GPData,
+    rng: Array,
+    count: int,
+    ucb_coefficient: float,
+    explore_coefficient: float,
+    use_trust_region: bool = True,
+) -> vectorized_lib.VectorizedOptimizerResult:
+    """UCB pick then PE picks with fantasy conditioning; all on device."""
+    dc = data.continuous.shape[-1]
+    ds = data.categorical.shape[-1]
+
+    def pick(b, carry):
+        data, out_cont, out_cat, out_scores, rng = carry
+        rng, opt_rng = jax.random.split(rng)
+        states = jax.vmap(lambda p: model.precompute(p, data))(ens_params)
+        predictive = gp_lib.EnsemblePredictive(states)
+        trust = acquisitions.TrustRegion.from_data(data) if use_trust_region else None
+
+        # Relevant-region threshold: max LCB over observed points.
+        obs = kernels.MixedFeatures(data.continuous, data.categorical)
+        obs_mean, obs_std = predictive.predict(obs)
+        lcb_obs = obs_mean - ucb_coefficient * obs_std
+        y_star = jnp.max(jnp.where(data.row_mask, lcb_obs, -jnp.inf))
+
+        def score_fn(query: kernels.MixedFeatures) -> Array:
+            mean, stddev = predictive.predict(query)
+            ucb = mean + ucb_coefficient * stddev
+            # b == 0: UCB. b > 0: PE (stddev) penalized outside the region
+            # where UCB >= y_star.
+            pe = explore_coefficient * stddev - 10.0 * jnp.maximum(y_star - ucb, 0.0)
+            value = jnp.where(b == 0, ucb, pe)
+            if trust is not None:
+                value = value - trust.penalty(query)
+            return value
+
+        result = vec_opt(score_fn, opt_rng, count=1)
+        x = kernels.MixedFeatures(
+            result.features.continuous[:1], result.features.categorical[:1]
+        )
+        mean, _ = predictive.predict(x)
+        data = _append_fantasy(data, x, mean[0])
+        out_cont = out_cont.at[b].set(x.continuous[0])
+        out_cat = out_cat.at[b].set(x.categorical[0])
+        out_scores = out_scores.at[b].set(result.scores[0])
+        return data, out_cont, out_cat, out_scores, rng
+
+    init = (
+        data,
+        jnp.zeros((count, dc), data.continuous.dtype),
+        jnp.zeros((count, ds), data.categorical.dtype),
+        jnp.zeros((count,), jnp.float32),
+        rng,
+    )
+    _, out_cont, out_cat, out_scores, _ = jax.lax.fori_loop(0, count, pick, init)
+    return vectorized_lib.VectorizedOptimizerResult(
+        kernels.MixedFeatures(out_cont, out_cat), out_scores
+    )
+
+
+@dataclasses.dataclass
+class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
+    """GP-UCB-PE batch designer (service DEFAULT)."""
+
+    explore_coefficient: float = 1.0
+
+    def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
+        count = count or 1
+        n = len(self._trials)
+        if n < self.num_seed_trials:
+            return self._seed_suggestions(count)
+
+        # Reserve padded capacity for the batch's fantasy rows.
+        conv = self._converter
+        data = gp_lib.GPData.from_model_data(
+            self._warped_model_data(extra_rows=count)
+        )
+
+        coll = self._model.param_collection()
+        inits = coll.batch_random_init_unconstrained(self._next_rng(), self.ard_restarts)
+        loss_fn = lambda p: self._model.neg_log_likelihood(p, data)
+        result = self._ard(loss_fn, inits, best_n=max(self.ensemble_size, 1))
+        self._last_predictive = gp_lib.EnsemblePredictive(
+            jax.vmap(lambda p: self._model.precompute(p, data))(result.params)
+        )
+
+        batch = _suggest_batch(
+            self._model,
+            self._vec_opt,
+            result.params,
+            data,
+            self._next_rng(),
+            count,
+            self.ucb_coefficient,
+            self.explore_coefficient,
+            self.use_trust_region,
+        )
+        cont_rows = np.asarray(batch.features.continuous)
+        cat_rows = np.asarray(batch.features.categorical)
+        scores = np.asarray(batch.scores)
+        suggestions = []
+        for i in range(count):
+            params = conv.to_parameters(
+                cont_rows[i : i + 1, : conv.encoder.num_continuous],
+                cat_rows[i : i + 1, : conv.encoder.num_categorical],
+            )[0]
+            s = trial_.TrialSuggestion(parameters=params)
+            s.metadata.ns("gp_ucb_pe")["acquisition"] = float(scores[i])
+            s.metadata.ns("gp_ucb_pe")["kind"] = "ucb" if i == 0 else "pe"
+            suggestions.append(s)
+        return suggestions
+
+
+def default_factory(
+    problem: base_study_config.ProblemStatement, seed: Optional[int] = None, **kwargs
+) -> VizierGPUCBPEBandit:
+    return VizierGPUCBPEBandit(problem, rng_seed=seed or 0, **kwargs)

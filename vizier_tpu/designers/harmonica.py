@@ -1,0 +1,111 @@
+"""Harmonica: boolean Fourier-basis regression designer.
+
+Parity with ``/root/reference/vizier/_src/algorithms/designers/harmonica.py:237``
+(Hazan et al. 2017): fit a sparse low-degree Fourier expansion over {-1,+1}
+features, fix the most influential variables to their best polarity, sample
+the rest uniformly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.converters import core as converters
+from vizier_tpu.designers.bocs import _binary_dim
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+
+@dataclasses.dataclass
+class HarmonicaDesigner(core_lib.Designer):
+    problem: base_study_config.ProblemStatement
+    degree: int = 2
+    num_top_monomials: int = 5
+    ridge: float = 1e-2
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        self._dim = _binary_dim(self.problem.search_space)
+        self._converter = converters.TrialToModelInputConverter.from_problem(
+            self.problem
+        )
+        self._rng = np.random.default_rng(self.seed)
+        self._monomials: List[Tuple[int, ...]] = []
+        for deg in range(1, self.degree + 1):
+            self._monomials.extend(itertools.combinations(range(self._dim), deg))
+        self._x: List[np.ndarray] = []
+        self._y: List[float] = []
+
+    def _signs(self, bits: np.ndarray) -> np.ndarray:
+        return 2.0 * np.atleast_2d(bits) - 1.0  # {0,1} -> {-1,+1}
+
+    def _phi(self, bits: np.ndarray) -> np.ndarray:
+        s = self._signs(bits)
+        cols = [np.prod(s[:, list(mono)], axis=1) for mono in self._monomials]
+        return np.stack(cols, axis=1) if cols else np.zeros((s.shape[0], 0))
+
+    def update(
+        self,
+        completed: core_lib.CompletedTrials,
+        all_active: core_lib.ActiveTrials = core_lib.ActiveTrials(),
+    ) -> None:
+        del all_active
+        trials = list(completed.trials)
+        if not trials:
+            return
+        _, cat = self._converter.encoder.encode(trials)
+        labels = self._converter.metrics.encode(trials)[:, 0]
+        for row, y in zip(cat, labels):
+            if np.isfinite(y):
+                self._x.append(row.astype(np.float64))
+                self._y.append(float(y))
+
+    def _fit_and_fix(self) -> Dict[int, int]:
+        """Fits the Fourier model; returns {variable: fixed bit} decisions."""
+        phi = self._phi(np.stack(self._x))
+        y = np.asarray(self._y)
+        y = y - y.mean()
+        d = phi.shape[1]
+        coef = np.linalg.solve(phi.T @ phi + self.ridge * np.eye(d), phi.T @ y)
+        top = np.argsort(-np.abs(coef))[: self.num_top_monomials]
+        # Influence of each variable: sum |coef| of monomials containing it.
+        influence = np.zeros(self._dim)
+        for idx in top:
+            for var in self._monomials[idx]:
+                influence[var] += abs(coef[idx])
+        fixed_vars = [int(v) for v in np.argsort(-influence) if influence[v] > 0][:3]
+        if not fixed_vars:
+            return {}
+        # Choose polarities greedily: evaluate the restricted surrogate on
+        # all assignments of the fixed vars with the rest at random.
+        best_assign, best_val = None, -np.inf
+        probes = self._rng.integers(0, 2, size=(64, self._dim)).astype(np.float64)
+        for assign in itertools.product([0.0, 1.0], repeat=len(fixed_vars)):
+            probes_a = probes.copy()
+            for var, bit in zip(fixed_vars, assign):
+                probes_a[:, var] = bit
+            val = float(np.mean(self._phi(probes_a) @ coef))
+            if val > best_val:
+                best_assign, best_val = assign, val
+        return {var: int(bit) for var, bit in zip(fixed_vars, best_assign)}
+
+    def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
+        count = count or 1
+        fixed: Dict[int, int] = {}
+        if len(self._x) >= max(8, self._dim):
+            fixed = self._fit_and_fix()
+        out = []
+        for _ in range(count):
+            bits = self._rng.integers(0, 2, size=self._dim)
+            for var, bit in fixed.items():
+                bits[var] = bit
+            params = self._converter.to_parameters(
+                np.zeros((1, 0)), np.asarray(bits, dtype=np.int32)[None, :]
+            )[0]
+            out.append(trial_.TrialSuggestion(parameters=params))
+        return out

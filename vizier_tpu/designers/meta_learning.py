@@ -1,0 +1,126 @@
+"""Meta-learning designer: tunes a designer's own hyperparameters online.
+
+Parity with
+``/root/reference/vizier/_src/algorithms/designers/meta_learning/meta_learning.py:259``:
+an outer (meta) designer proposes hyperparameter configs for the inner
+designer factory; each config is scored by the objective progress achieved
+during its tenure, and the meta designer is updated with those scores.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.converters import core as converters
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+META_METRIC = "meta_reward"
+
+
+@dataclasses.dataclass
+class MetaLearningConfig:
+    tuning_interval: int = 20  # trials per meta round
+    num_seed_rounds: int = 1
+
+
+@dataclasses.dataclass
+class MetaLearningDesigner(core_lib.Designer):
+    """Outer loop tuning inner-designer hyperparameters.
+
+    Args:
+      problem: the user problem.
+      tuning_space: search space over the inner designer's hyperparameters.
+      inner_factory: (problem, **hyperparams) -> Designer.
+      meta_factory: factory for the meta problem (defaults to random search).
+    """
+
+    problem: base_study_config.ProblemStatement
+    tuning_space: base_study_config.pc.SearchSpace = None  # type: ignore[assignment]
+    inner_factory: Callable[..., core_lib.Designer] = None  # type: ignore[assignment]
+    meta_factory: Optional[core_lib.DesignerFactory] = None
+    config: MetaLearningConfig = dataclasses.field(default_factory=MetaLearningConfig)
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.tuning_space is None or self.inner_factory is None:
+            raise ValueError("tuning_space and inner_factory are required.")
+        meta_problem = base_study_config.ProblemStatement(
+            search_space=self.tuning_space,
+            metric_information=base_study_config.MetricsConfig(
+                [
+                    base_study_config.MetricInformation(
+                        name=META_METRIC,
+                        goal=base_study_config.ObjectiveMetricGoal.MAXIMIZE,
+                    )
+                ]
+            ),
+        )
+        if self.meta_factory is None:
+            from vizier_tpu.designers import random as random_designer
+
+            self.meta_factory = lambda p, **kw: random_designer.RandomDesigner(
+                p.search_space, seed=self.seed
+            )
+        self._meta = self.meta_factory(meta_problem)
+        self._metrics = converters.MetricsEncoder(self.problem.metric_information)
+        self._current_hparams: Optional[trial_.TrialSuggestion] = None
+        self._inner: Optional[core_lib.Designer] = None
+        self._round_trials = 0
+        self._round_best = -np.inf
+        self._prev_best = -np.inf
+        self._meta_trial_id = 0
+        self._all_completed: List[trial_.Trial] = []
+
+    def _start_round(self) -> None:
+        (suggestion,) = self._meta.suggest(1)
+        self._current_hparams = suggestion
+        hparams = {k: v.value for k, v in suggestion.parameters.items()}
+        self._inner = self.inner_factory(self.problem, **hparams)
+        if self._all_completed:
+            self._inner.update(
+                core_lib.CompletedTrials(self._all_completed), core_lib.ActiveTrials()
+            )
+        self._prev_best = max(self._prev_best, self._round_best)
+        self._round_trials = 0
+        self._round_best = -np.inf
+
+    def _finish_round(self) -> None:
+        """Scores the finished config by its improvement over the incumbent."""
+        if np.isfinite(self._prev_best) and np.isfinite(self._round_best):
+            reward = float(self._round_best - self._prev_best)
+        elif np.isfinite(self._round_best):
+            # First round: no incumbent to improve over — neutral reward.
+            reward = 0.0
+        else:
+            reward = 0.0
+        self._meta_trial_id += 1
+        t = self._current_hparams.to_trial(self._meta_trial_id)
+        t.complete(trial_.Measurement(metrics={META_METRIC: reward}))
+        self._meta.update(core_lib.CompletedTrials([t]), core_lib.ActiveTrials())
+
+    def update(
+        self,
+        completed: core_lib.CompletedTrials,
+        all_active: core_lib.ActiveTrials = core_lib.ActiveTrials(),
+    ) -> None:
+        self._all_completed.extend(completed.trials)
+        for t in completed.trials:
+            label = self._metrics.encode([t])[0, 0]
+            if np.isfinite(label):
+                self._round_best = max(self._round_best, float(label))
+        self._round_trials += len(completed.trials)
+        if self._inner is not None:
+            self._inner.update(completed, all_active)
+
+    def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
+        if self._inner is None:
+            self._start_round()
+        elif self._round_trials >= self.config.tuning_interval:
+            self._finish_round()
+            self._start_round()
+        return list(self._inner.suggest(count))

@@ -1,0 +1,84 @@
+"""Multi-objective scalarizers.
+
+Parity with ``/root/reference/vizier/_src/algorithms/designers/scalarization.py:135``:
+linear, Chebyshev (augmented), and hypervolume scalarizations mapping
+[..., M] objective vectors to scalars (all-MAXIMIZE convention), as jax
+functions usable inside acquisition graphs.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class Scalarization(abc.ABC):
+    """Maps [..., M] objectives to [...] scalars (bigger = better)."""
+
+    @abc.abstractmethod
+    def __call__(self, objectives: Array) -> Array:
+        ...
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearScalarization(Scalarization):
+    weights: tuple
+
+    def __call__(self, objectives: Array) -> Array:
+        w = jnp.asarray(self.weights, dtype=objectives.dtype)
+        return jnp.sum(objectives * w, axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChebyshevScalarization(Scalarization):
+    """Augmented Chebyshev: min_j w_j (f_j - ref_j) + rho * sum_j w_j f_j."""
+
+    weights: tuple
+    reference_point: Optional[tuple] = None
+    rho: float = 0.05
+
+    def __call__(self, objectives: Array) -> Array:
+        w = jnp.asarray(self.weights, dtype=objectives.dtype)
+        ref = (
+            jnp.asarray(self.reference_point, dtype=objectives.dtype)
+            if self.reference_point is not None
+            else jnp.zeros_like(w)
+        )
+        shifted = objectives - ref
+        return jnp.min(w * shifted, axis=-1) + self.rho * jnp.sum(w * shifted, axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class HyperVolumeScalarization(Scalarization):
+    """Random-direction HV scalarization: min_j ((f_j - ref_j)_+ / w_j)^M.
+
+    Averaging this over random positive directions w estimates hypervolume
+    (the scalarization used by the reference's multi-objective GP bandit,
+    ``acquisitions.py:571``).
+    """
+
+    weights: tuple
+    reference_point: Optional[tuple] = None
+
+    def __call__(self, objectives: Array) -> Array:
+        w = jnp.asarray(self.weights, dtype=objectives.dtype)
+        ref = (
+            jnp.asarray(self.reference_point, dtype=objectives.dtype)
+            if self.reference_point is not None
+            else jnp.zeros_like(w)
+        )
+        m = objectives.shape[-1]
+        ratios = jnp.maximum(objectives - ref, 0.0) / jnp.maximum(w, 1e-12)
+        return jnp.min(ratios, axis=-1) ** m
+
+
+def random_hv_directions(rng: Array, num: int, num_objectives: int) -> Array:
+    """[num, M] positive unit directions for HV scalarization ensembles."""
+    v = jnp.abs(jax.random.normal(rng, (num, num_objectives)))
+    return v / jnp.linalg.norm(v, axis=-1, keepdims=True)

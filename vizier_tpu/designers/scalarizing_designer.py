@@ -1,0 +1,84 @@
+"""ScalarizingDesigner: multi-objective → single-objective reduction.
+
+Parity with
+``/root/reference/vizier/_src/algorithms/designers/scalarizing_designer.py:138``:
+wraps any single-objective designer factory; completed trials get a
+synthetic scalarized metric and the inner designer optimizes that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.converters import core as converters
+from vizier_tpu.designers import scalarization as scalarization_lib
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+SCALARIZED_METRIC = "scalarized"
+
+
+@dataclasses.dataclass
+class ScalarizingDesigner(core_lib.Designer):
+    problem: base_study_config.ProblemStatement
+    scalarization: scalarization_lib.Scalarization = None  # type: ignore[assignment]
+    designer_factory: Optional[core_lib.DesignerFactory] = None
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        metrics = [
+            m for m in self.problem.metric_information if not m.is_safety_metric
+        ]
+        self._num_objectives = len(metrics)
+        if self.scalarization is None:
+            self.scalarization = scalarization_lib.ChebyshevScalarization(
+                weights=tuple([1.0 / self._num_objectives] * self._num_objectives)
+            )
+        self._metrics_encoder = converters.MetricsEncoder(
+            base_study_config.MetricsConfig(metrics)
+        )
+        inner_problem = base_study_config.ProblemStatement(
+            search_space=self.problem.search_space,
+            metric_information=base_study_config.MetricsConfig(
+                [
+                    base_study_config.MetricInformation(
+                        name=SCALARIZED_METRIC,
+                        goal=base_study_config.ObjectiveMetricGoal.MAXIMIZE,
+                    )
+                ]
+            ),
+        )
+        if self.designer_factory is None:
+            from vizier_tpu.designers import gp_bandit
+
+            self.designer_factory = lambda p, **kw: gp_bandit.VizierGPBandit(
+                p, rng_seed=self.seed or 0
+            )
+        self._inner = self.designer_factory(inner_problem)
+
+    def update(
+        self,
+        completed: core_lib.CompletedTrials,
+        all_active: core_lib.ActiveTrials = core_lib.ActiveTrials(),
+    ) -> None:
+        rewritten = []
+        for t in completed.trials:
+            objectives = self._metrics_encoder.encode([t])[0]  # all-MAXIMIZE
+            clone = trial_.Trial(id=t.id, parameters=t.parameters, metadata=t.metadata)
+            if np.all(np.isfinite(objectives)):
+                value = float(self.scalarization(jnp.asarray(objectives)))
+                clone.complete(
+                    trial_.Measurement(metrics={SCALARIZED_METRIC: value})
+                )
+            else:
+                clone.complete(infeasibility_reason=t.infeasibility_reason or "NaN")
+            rewritten.append(clone)
+        self._inner.update(core_lib.CompletedTrials(rewritten), all_active)
+
+    def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
+        return list(self._inner.suggest(count))

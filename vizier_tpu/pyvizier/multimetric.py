@@ -1,0 +1,98 @@
+"""Multimetric utilities: Pareto optimality, hypervolume, safety checking.
+
+Parity with ``/root/reference/vizier/_src/pyvizier/multimetric/``
+(``pareto_optimal.py:24,87``, ``hypervolume.py:68``, ``safety.py:24``) —
+thin numpy-facing wrappers over the XLA ops in ``vizier_tpu.ops.pareto``
+(the TPU build runs the algorithms on device instead of the reference's
+O(n²) numpy loops).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from vizier_tpu.ops import pareto as pareto_ops
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+
+class ParetoOptimalAlgorithm:
+    """Frontier membership / Pareto rank over [N, M] MAXIMIZE matrices."""
+
+    def is_pareto_optimal(self, points: np.ndarray) -> np.ndarray:
+        points = np.asarray(points, dtype=np.float32)
+        if points.size == 0:
+            return np.zeros((0,), dtype=bool)
+        return np.asarray(pareto_ops.is_frontier(points))
+
+    def pareto_rank(self, points: np.ndarray) -> np.ndarray:
+        points = np.asarray(points, dtype=np.float32)
+        if points.size == 0:
+            return np.zeros((0,), dtype=np.int32)
+        return np.asarray(pareto_ops.pareto_rank(points))
+
+
+# Reference exposes a naive and a fast variant; both map to the XLA op here.
+FastParetoOptimalAlgorithm = ParetoOptimalAlgorithm
+NaiveParetoOptimalAlgorithm = ParetoOptimalAlgorithm
+
+
+class ParetoFrontier:
+    """Hypervolume of a frontier w.r.t. an origin (random-direction MC)."""
+
+    def __init__(
+        self,
+        points: np.ndarray,
+        origin: Optional[np.ndarray] = None,
+        *,
+        num_vectors: int = 10_000,
+        seed: int = 0,
+    ):
+        self._points = np.asarray(points, dtype=np.float32)
+        self._origin = (
+            np.asarray(origin, dtype=np.float32)
+            if origin is not None
+            else np.zeros(self._points.shape[-1], dtype=np.float32)
+        )
+        self._num_vectors = num_vectors
+        self._rng = jax.random.PRNGKey(seed)
+
+    def hypervolume(self, is_cumulative: bool = False) -> np.ndarray:
+        shifted = np.maximum(self._points - self._origin[None, :], 0.0)
+        cum = pareto_ops.cum_hypervolume_origin(
+            shifted.astype(np.float32), self._rng, num_vectors=self._num_vectors
+        )
+        return np.asarray(cum) if is_cumulative else float(np.asarray(cum)[-1])
+
+
+class SafetyChecker:
+    """Filters trials violating safety-metric thresholds."""
+
+    def __init__(self, metrics: base_study_config.MetricsConfig):
+        self._safety = [m for m in metrics if m.is_safety_metric]
+
+    def warp_unsafe_trials(
+        self, trials: Sequence[trial_.Trial]
+    ) -> Sequence[trial_.Trial]:
+        """Marks unsafe completed trials infeasible (in place); returns them."""
+        for t in trials:
+            if not self.is_safe(t):
+                t.infeasibility_reason = t.infeasibility_reason or "Safety violation."
+        return trials
+
+    def is_safe(self, trial: trial_.Trial) -> bool:
+        if trial.final_measurement is None:
+            return True
+        for info in self._safety:
+            metric = trial.final_measurement.metrics.get(info.name)
+            if metric is None:
+                continue
+            threshold = info.safety_threshold or 0.0
+            if info.goal.is_maximize and metric.value < threshold:
+                return False
+            if info.goal.is_minimize and metric.value > threshold:
+                return False
+        return True

@@ -65,15 +65,15 @@ class DefaultPolicyFactory:
                 lambda p, **kw: grid.GridSearchDesigner(p.search_space, shuffle_seed=shuffle),
             )
         if algorithm == "NSGA2":
-            from vizier_tpu.designers.evolution import nsga2
+            from vizier_tpu.designers import evolution
 
-            return designer_policy.DesignerPolicy(
-                policy_supporter, lambda p, **kw: nsga2.NSGA2Designer(p)
+            return designer_policy.PartiallySerializableDesignerPolicy(
+                policy_supporter, lambda p, **kw: evolution.NSGA2Designer(p)
             )
         if algorithm == "EAGLE_STRATEGY":
             from vizier_tpu.designers import eagle_strategy
 
-            return designer_policy.DesignerPolicy(
+            return designer_policy.PartiallySerializableDesignerPolicy(
                 policy_supporter,
                 lambda p, **kw: eagle_strategy.EagleStrategyDesigner(p),
             )
