@@ -1,0 +1,296 @@
+"""Tests for stacked-residual transfer learning, multi-objective GP, profiler."""
+
+import datetime
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu import types
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.models import gp as gp_lib
+from vizier_tpu.models import kernels, stacked_residual
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+from vizier_tpu.utils import profiler
+
+
+def _data(xs, ys, n_pad=None):
+    xs = np.asarray(xs, np.float32).reshape(-1, 1)
+    ys = np.asarray(ys, np.float32)
+    n_pad = n_pad or len(xs)
+    features = types.ContinuousAndCategorical(
+        continuous=types.PaddedArray.from_array(xs, (n_pad, 1)),
+        categorical=types.PaddedArray.from_array(
+            np.zeros((len(xs), 0), np.int32), (n_pad, 0), fill_value=0
+        ),
+    )
+    labels = types.PaddedArray.from_array(
+        ys[:, None], (n_pad, 1), fill_value=np.nan
+    )
+    return gp_lib.GPData.from_model_data(types.ModelData(features, labels))
+
+
+class TestStackedResidualGP:
+    def test_prior_informs_sparse_current_data(self):
+        model = gp_lib.VizierGaussianProcess(num_continuous=1, num_categorical=0)
+        f = lambda x: np.sin(6 * x)
+        prior_x = np.linspace(0, 1, 20)
+        prior = _data(prior_x, f(prior_x))
+        current_x = np.array([0.1, 0.9])
+        current = _data(current_x, f(current_x))
+        stack = stacked_residual.train_stacked_residual_gp(
+            model,
+            lbfgs_lib.AdamOptimizer(maxiter=60),
+            [prior, current],
+            jax.random.PRNGKey(0),
+            num_restarts=4,
+        )
+        query_x = np.linspace(0.2, 0.8, 7).astype(np.float32)
+        query = kernels.MixedFeatures(
+            jnp.asarray(query_x[:, None]), jnp.zeros((7, 0), jnp.int32)
+        )
+        mean, stddev = stack.predict(query)
+        # With only 2 current points, accuracy must come from the prior.
+        np.testing.assert_allclose(np.asarray(mean), f(query_x), atol=0.35)
+        assert (np.asarray(stddev) > 0).all()
+
+    def test_single_level_equals_plain_gp(self):
+        model = gp_lib.VizierGaussianProcess(num_continuous=1, num_categorical=0)
+        data = _data(np.linspace(0, 1, 8), np.linspace(-1, 1, 8))
+        stack = stacked_residual.train_stacked_residual_gp(
+            model,
+            lbfgs_lib.AdamOptimizer(maxiter=30),
+            [data],
+            jax.random.PRNGKey(0),
+            num_restarts=2,
+        )
+        assert len(stack.levels) == 1
+        q = kernels.MixedFeatures(
+            jnp.asarray([[0.5]], jnp.float32), jnp.zeros((1, 0), jnp.int32)
+        )
+        mean, stddev = stack.predict(q)
+        assert mean.shape == (1,) and stddev.shape == (1,)
+
+
+class TestMultiObjectiveGPBandit:
+    def test_hv_scalarized_suggest(self):
+        from vizier_tpu.designers.gp_bandit import VizierGPBandit
+
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", 0.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="f1", goal=vz.ObjectiveMetricGoal.MINIMIZE)
+        )
+        p.metric_information.append(
+            vz.MetricInformation(name="f2", goal=vz.ObjectiveMetricGoal.MINIMIZE)
+        )
+        d = VizierGPBandit(
+            p,
+            max_acquisition_evaluations=300,
+            ard_restarts=2,
+            num_seed_trials=3,
+            ard_optimizer=lbfgs_lib.AdamOptimizer(maxiter=20),
+        )
+        trials = []
+        for i, x in enumerate(np.linspace(0, 1, 5)):
+            t = vz.Trial(id=i + 1, parameters={"x": float(x)})
+            t.complete(vz.Measurement(metrics={"f1": x**2, "f2": (x - 1) ** 2}))
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        suggestions = d.suggest(2)
+        assert len(suggestions) == 2
+        assert (
+            suggestions[0].metadata.ns("gp_bandit")["acquisition_kind"]
+            == "hv_scalarized_ucb"
+        )
+
+    def test_set_priors_transfer(self):
+        from vizier_tpu.designers.gp_bandit import VizierGPBandit
+
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", -1.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        f = lambda x: -((x - 0.3) ** 2)
+        prior = []
+        rng = np.random.default_rng(0)
+        for i in range(12):
+            x = float(rng.uniform(-1, 1))
+            t = vz.Trial(id=i + 1, parameters={"x": x})
+            t.complete(vz.Measurement(metrics={"obj": f(x)}))
+            prior.append(t)
+        d = VizierGPBandit(
+            p,
+            max_acquisition_evaluations=300,
+            ard_restarts=2,
+            num_seed_trials=2,
+            ard_optimizer=lbfgs_lib.AdamOptimizer(maxiter=30),
+        )
+        d.set_priors([prior])
+        current = []
+        for i, x in enumerate([-0.7, 0.6]):
+            t = vz.Trial(id=i + 1, parameters={"x": x})
+            t.complete(vz.Measurement(metrics={"obj": f(x)}))
+            current.append(t)
+        d.update(core_lib.CompletedTrials(current))
+        suggestions = d.suggest(2)
+        kinds = {s.metadata.ns("gp_bandit")["acquisition_kind"] for s in suggestions}
+        assert kinds == {"ucb+priors"}
+
+
+class TestEarlyStoppingPolicy:
+    def _study_config(self):
+        config = vz.StudyConfig()
+        config.search_space.root.add_float_param("x", 0.0, 1.0)
+        config.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        return config
+
+    def test_median_rule(self):
+        from vizier_tpu.algorithms.early_stopping import MedianEarlyStopPolicy
+        from vizier_tpu.pythia import local_policy_supporters
+        from vizier_tpu.pythia import policy as policy_lib
+
+        config = self._study_config()
+        supporter = local_policy_supporters.InRamPolicySupporter(config)
+
+        def add_curve(values):
+            t = vz.Trial(parameters={"x": 0.5})
+            for step, v in enumerate(values, start=1):
+                t.measurements.append(
+                    vz.Measurement(metrics={"obj": v}, steps=step)
+                )
+            supporter.AddTrials([t])
+            return supporter.trials[-1].id
+
+        for _ in range(3):
+            add_curve([0.5, 0.7, 0.9])
+        laggard = add_curve([0.05, 0.06])
+        healthy = add_curve([0.8, 0.95])
+        policy = MedianEarlyStopPolicy(supporter, min_num_trials=3)
+        decisions = policy.early_stop(
+            policy_lib.EarlyStopRequest(
+                study_descriptor=supporter.study_descriptor(),
+                trial_ids=frozenset([laggard, healthy]),
+            )
+        )
+        by_id = {d.id: d.should_stop for d in decisions.decisions}
+        assert by_id[laggard] is True
+        assert by_id[healthy] is False
+
+    def test_too_few_trials_no_stop(self):
+        from vizier_tpu.algorithms.early_stopping import MedianEarlyStopPolicy
+        from vizier_tpu.pythia import local_policy_supporters
+        from vizier_tpu.pythia import policy as policy_lib
+
+        supporter = local_policy_supporters.InRamPolicySupporter(self._study_config())
+        t = vz.Trial(parameters={"x": 0.5})
+        t.measurements.append(vz.Measurement(metrics={"obj": 0.1}, steps=1))
+        supporter.AddTrials([t])
+        policy = MedianEarlyStopPolicy(supporter, min_num_trials=5)
+        decisions = policy.early_stop(
+            policy_lib.EarlyStopRequest(
+                study_descriptor=supporter.study_descriptor(),
+                trial_ids=frozenset([1]),
+            )
+        )
+        assert decisions.decisions[0].should_stop is False
+
+
+class TestProfiler:
+    def test_timeit_and_nested_scopes(self):
+        with profiler.collect_events() as events:
+            with profiler.timeit("outer"):
+                with profiler.timeit("inner"):
+                    pass
+        latencies = profiler.get_latencies_dict(events)
+        assert "outer" in latencies
+        assert "outer::inner" in latencies
+        assert latencies["outer"][0] >= latencies["outer::inner"][0]
+
+    def test_record_runtime_decorator(self):
+        @profiler.record_runtime(name="myfn", block_until_ready=True)
+        def fn(x):
+            import jax.numpy as jnp
+
+            return jnp.asarray(x) * 2
+
+        with profiler.collect_events() as events:
+            fn(3.0)
+        assert "myfn" in profiler.get_latencies_dict(events)
+
+    def test_record_tracing_counts(self):
+        @profiler.record_tracing(name="traced")
+        def body(x):
+            return x + 1
+
+        fn = jax.jit(body)
+        with profiler.collect_events() as events:
+            fn(jnp.zeros(3))
+            fn(jnp.ones(3))  # cache hit: no retrace
+            fn(jnp.zeros(4))  # new shape: retrace
+        counts = profiler.get_tracing_counts(events)
+        assert counts.get("traced") == 2
+
+    def test_disabled_outside_collect(self):
+        with profiler.timeit("ignored"):
+            pass
+        with profiler.collect_events() as events:
+            pass
+        assert events == []
+
+
+class TestReviewRegressions:
+    """Regressions from the seventh code review."""
+
+    def test_gp_ucb_pe_routes_multiobjective(self):
+        from vizier_tpu.designers.gp_ucb_pe import VizierGPUCBPEBandit
+
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", 0.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="f1", goal=vz.ObjectiveMetricGoal.MINIMIZE)
+        )
+        p.metric_information.append(
+            vz.MetricInformation(name="f2", goal=vz.ObjectiveMetricGoal.MINIMIZE)
+        )
+        d = VizierGPUCBPEBandit(
+            p,
+            max_acquisition_evaluations=300,
+            ard_restarts=2,
+            num_seed_trials=3,
+            ard_optimizer=lbfgs_lib.AdamOptimizer(maxiter=20),
+        )
+        trials = []
+        for i, x in enumerate(np.linspace(0, 1, 5)):
+            t = vz.Trial(id=i + 1, parameters={"x": float(x)})
+            t.complete(vz.Measurement(metrics={"f1": x**2, "f2": (x - 1) ** 2}))
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        (s, _) = d.suggest(2)
+        assert s.metadata.ns("gp_bandit")["acquisition_kind"] == "hv_scalarized_ucb"
+
+    def test_safety_warp_clears_measurement(self):
+        from vizier_tpu.pyvizier import multimetric
+
+        metrics = vz.MetricsConfig(
+            [
+                vz.MetricInformation(name="obj"),
+                vz.MetricInformation(name="safe", safety_threshold=0.5),
+            ]
+        )
+        checker = multimetric.SafetyChecker(metrics)
+        t = vz.Trial(id=1)
+        t.complete(vz.Measurement(metrics={"obj": 99.0, "safe": 0.0}))
+        checker.warp_unsafe_trials([t])
+        assert t.infeasible
+        assert t.final_measurement is None
+        # Label encoders now see NaN for it.
+        from vizier_tpu.converters import core as conv
+
+        enc = conv.MetricsEncoder(metrics)
+        assert np.isnan(enc.encode([t])).all()

@@ -258,25 +258,43 @@ class TestVizierServicer:
         assert response.should_stop is False
 
     def test_early_stopping_flow(self):
+        """Median rule: a clearly-lagging curve gets stopped."""
         servicer = _make_servicer()
         config = _config()
-        config.automated_stopping_config = vz.AutomatedStoppingConfig()
+        config.automated_stopping_config = vz.AutomatedStoppingConfig(min_num_trials=3)
         study = pc.study_to_proto(config, "owners/o/studies/s")
         servicer.CreateStudy(
             vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
         )
-        op = servicer.SuggestTrials(
-            vizier_service_pb2.SuggestTrialsRequest(
-                parent="owners/o/studies/s", suggestion_count=1, client_id="w0"
+
+        def make_trial_with_curve(values):
+            created = servicer.CreateTrial(
+                vizier_service_pb2.CreateTrialRequest(
+                    parent="owners/o/studies/s", trial=study_pb2.Trial()
+                )
             )
-        )
-        name = op.response.trials[0].name
-        response = servicer.CheckTrialEarlyStoppingState(
-            vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(trial_name=name)
-        )
-        # RandomPolicy stops exactly one of the candidate trials; with a
-        # single candidate it must be this one.
-        assert response.should_stop is True
+            for step, v in enumerate(values, start=1):
+                add = vizier_service_pb2.AddTrialMeasurementRequest(
+                    trial_name=created.name
+                )
+                m = add.measurement
+                m.steps = step
+                metric = m.metrics.add()
+                metric.name, metric.value = "obj", v
+                servicer.AddTrialMeasurement(add)
+            return created.name
+
+        # Three healthy curves, one lagging curve (MAXIMIZE).
+        for _ in range(3):
+            make_trial_with_curve([0.5, 0.7, 0.9])
+        laggard = make_trial_with_curve([0.1, 0.1])
+        healthy = make_trial_with_curve([0.6, 0.8])
+        assert servicer.CheckTrialEarlyStoppingState(
+            vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(trial_name=laggard)
+        ).should_stop
+        assert not servicer.CheckTrialEarlyStoppingState(
+            vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(trial_name=healthy)
+        ).should_stop
 
     def test_update_metadata(self):
         servicer = _make_servicer()
@@ -465,30 +483,46 @@ class TestReviewRegressions:
         pythia = pythia_service.PythiaServicer(servicer)
         servicer.set_pythia(pythia)
         config = _config()
-        config.automated_stopping_config = vz.AutomatedStoppingConfig()
+        config.automated_stopping_config = vz.AutomatedStoppingConfig(min_num_trials=3)
         study = pc.study_to_proto(config, "owners/o/studies/s")
         servicer.CreateStudy(
             vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
         )
-        op = servicer.SuggestTrials(
-            vizier_service_pb2.SuggestTrialsRequest(
-                parent="owners/o/studies/s", suggestion_count=1, client_id="w0"
+
+        def add_curve(values):
+            created = servicer.CreateTrial(
+                vizier_service_pb2.CreateTrialRequest(
+                    parent="owners/o/studies/s", trial=study_pb2.Trial()
+                )
             )
-        )
-        name = op.response.trials[0].name
-        # Plant a stale ACTIVE op.
+            for step, v in enumerate(values, start=1):
+                add = vizier_service_pb2.AddTrialMeasurementRequest(
+                    trial_name=created.name
+                )
+                add.measurement.steps = step
+                metric = add.measurement.metrics.add()
+                metric.name, metric.value = "obj", v
+                servicer.AddTrialMeasurement(add)
+            return created
+
+        for _ in range(3):
+            add_curve([0.5, 0.7, 0.9])
+        laggard = add_curve([0.05, 0.06])
+        # Plant a stale ACTIVE op pinned to should_stop=False.
         from vizier_tpu.service import resources as res
 
         stale = vizier_service_pb2.EarlyStoppingOperation(
-            name=res.EarlyStoppingOperationResource("o", "s", 1).name,
+            name=res.EarlyStoppingOperationResource("o", "s", laggard.id).name,
             status=vizier_service_pb2.EarlyStoppingOperation.ACTIVE,
             creation_time_secs=0.0,
         )
         servicer.datastore.create_early_stopping_operation(stale)
         response = servicer.CheckTrialEarlyStoppingState(
-            vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(trial_name=name)
+            vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(
+                trial_name=laggard.name
+            )
         )
-        # Recycled and re-queried (RandomPolicy stops the only candidate).
+        # Recycled and re-queried: the laggard should now stop.
         assert response.should_stop is True
 
     def test_materialize_state_reads_service(self):

@@ -1,0 +1,131 @@
+"""Curve-based automated early stopping.
+
+The reference routes early stopping through its policies plus a
+``DefaultEarlyStoppingSpec`` (``oss/automated_stopping.py:46``, servicer flow
+``vizier_service.py:631``); here the median-curve rule is a first-class
+policy: a trial should stop when its objective at its latest reported
+step/time is below the median of other trials' objectives at a comparable
+point, once ``min_num_trials`` trials carry measurements.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.pythia import policy as policy_lib
+from vizier_tpu.pythia import policy_supporter as supporter_lib
+
+
+def _latest_value(
+    trial: vz.Trial, metric: str, use_steps: bool
+) -> Optional[Tuple[float, float]]:
+    """(position, value) of the trial's latest intermediate measurement."""
+    best = None
+    for m in trial.measurements:
+        if metric not in m.metrics:
+            continue
+        pos = m.steps if use_steps else m.elapsed_secs
+        if best is None or pos >= best[0]:
+            best = (pos, m.metrics[metric].value)
+    if best is None and trial.final_measurement and metric in trial.final_measurement.metrics:
+        fm = trial.final_measurement
+        pos = fm.steps if use_steps else fm.elapsed_secs
+        best = (pos, fm.metrics[metric].value)
+    return best
+
+
+def _value_at(
+    trial: vz.Trial, metric: str, position: float, use_steps: bool
+) -> Optional[float]:
+    """The trial's objective at the last measurement with pos <= position."""
+    value = None
+    for m in trial.measurements:
+        if metric not in m.metrics:
+            continue
+        pos = m.steps if use_steps else m.elapsed_secs
+        if pos <= position:
+            value = m.metrics[metric].value
+    return value
+
+
+@dataclasses.dataclass
+class MedianEarlyStopPolicy(policy_lib.Policy):
+    """Median rule over intermediate measurement curves."""
+
+    supporter: supporter_lib.PolicySupporter
+    use_steps: bool = True
+    min_num_trials: int = 5
+
+    def suggest(self, request: policy_lib.SuggestRequest) -> policy_lib.SuggestDecision:
+        raise NotImplementedError("MedianEarlyStopPolicy only early-stops.")
+
+    def early_stop(
+        self, request: policy_lib.EarlyStopRequest
+    ) -> policy_lib.EarlyStopDecisions:
+        config = request.study_config
+        problem = config.to_problem()
+        metric_info = None
+        for m in problem.metric_information:
+            if not m.is_safety_metric:
+                metric_info = m
+                break
+        if metric_info is None:
+            return policy_lib.EarlyStopDecisions()
+        metric = metric_info.name
+        sign = 1.0 if metric_info.goal.is_maximize else -1.0
+
+        all_trials = self.supporter.GetTrials()
+        with_curves = [t for t in all_trials if t.measurements]
+        decisions = []
+        for tid in sorted(request.trial_ids):
+            trial = next((t for t in all_trials if t.id == tid), None)
+            if trial is None:
+                continue
+            if len(with_curves) < self.min_num_trials:
+                decisions.append(
+                    policy_lib.EarlyStopDecision(
+                        id=tid, should_stop=False,
+                        reason=f"Fewer than {self.min_num_trials} trials with curves.",
+                    )
+                )
+                continue
+            latest = _latest_value(trial, metric, self.use_steps)
+            if latest is None:
+                decisions.append(
+                    policy_lib.EarlyStopDecision(
+                        id=tid, should_stop=False, reason="No measurements yet."
+                    )
+                )
+                continue
+            position, value = latest
+            others = [
+                v
+                for t in with_curves
+                if t.id != tid
+                and (v := _value_at(t, metric, position, self.use_steps)) is not None
+            ]
+            if len(others) < self.min_num_trials - 1:
+                decisions.append(
+                    policy_lib.EarlyStopDecision(
+                        id=tid, should_stop=False,
+                        reason="Not enough comparable curves.",
+                    )
+                )
+                continue
+            median = float(np.median(np.asarray(others)))
+            should = sign * value < sign * median
+            decisions.append(
+                policy_lib.EarlyStopDecision(
+                    id=tid,
+                    should_stop=should,
+                    reason=(
+                        f"value {value:.4g} vs median {median:.4g} at "
+                        f"{'step' if self.use_steps else 'secs'} {position:g}"
+                    ),
+                )
+            )
+        return policy_lib.EarlyStopDecisions(decisions=decisions)

@@ -105,8 +105,9 @@ class CMAESDesigner(core_lib.Designer):
         elite = xs[order[: self._mu]]
 
         old_mean = s.mean.copy()
+        sigma_old = s.sigma  # sampling-time sigma: scales y_w AND artmp below
         s.mean = self._weights @ elite
-        y_w = (s.mean - old_mean) / s.sigma
+        y_w = (s.mean - old_mean) / sigma_old
 
         # Step-size path (CSA).
         cov_inv_sqrt = self._cov_inv_sqrt(s.cov)
@@ -128,7 +129,7 @@ class CMAESDesigner(core_lib.Designer):
         s.p_c = (1 - self._c_c) * s.p_c + h_sigma * np.sqrt(
             self._c_c * (2 - self._c_c) * self._mu_eff
         ) * y_w
-        artmp = (elite - old_mean) / s.sigma
+        artmp = (elite - old_mean) / sigma_old
         rank_mu = sum(
             w * np.outer(a, a) for w, a in zip(self._weights, artmp)
         )

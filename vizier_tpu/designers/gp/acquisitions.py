@@ -215,3 +215,33 @@ class ScoringFunction:
         if self.trust_region is not None:
             values = values - self.trust_region.penalty(query)
         return values
+
+
+@flax.struct.dataclass
+class HVScalarizedScoring:
+    """Multi-objective scoring: random-direction HV scalarization of UCB.
+
+    Parity with the reference's multi-objective GP bandit path
+    (``gp_bandit.py:213-242`` + ``create_hv_scalarization``,
+    ``acquisitions.py:571``): per-metric UCB vectors are scalarized along K
+    random positive directions and averaged — maximizing the expected
+    hypervolume improvement direction-by-direction.
+    """
+
+    metric_states: gp_lib.GPState  # leading axis M (one GP per objective)
+    directions: Array  # [K, M] positive unit vectors
+    reference_point: Array  # [M]
+    ucb_coefficient: float = flax.struct.field(pytree_node=False, default=1.8)
+    trust_region: Optional[TrustRegion] = None
+
+    def score(self, query: kernels.MixedFeatures) -> Array:
+        means, stddevs = jax.vmap(lambda s: s.predict(query))(self.metric_states)
+        ucb = means + self.ucb_coefficient * stddevs  # [M, Q]
+        m = ucb.shape[0]
+        shifted = jnp.maximum(ucb - self.reference_point[:, None], 0.0)  # [M, Q]
+        # ratios[k, m, q] then min over m, ^M, mean over k.
+        ratios = shifted[None, :, :] / jnp.maximum(self.directions[:, :, None], 1e-12)
+        values = jnp.mean(jnp.min(ratios, axis=1) ** m, axis=0)  # [Q]
+        if self.trust_region is not None:
+            values = values - self.trust_region.penalty(query)
+        return values

@@ -75,6 +75,30 @@ def _maximize_acquisition(
     return vec_opt(scoring.score, rng, count=count, prior_features=prior_features)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("model", "optimizer", "num_restarts")
+)
+def _train_gp_per_metric(
+    model: gp_lib.VizierGaussianProcess,
+    optimizer: lbfgs_lib.Optimizer,
+    batched_data: gp_lib.GPData,  # leading axis M on labels/masks/features
+    rng: Array,
+    num_restarts: int,
+) -> gp_lib.GPState:
+    """One independently-trained GP per objective metric (vmapped)."""
+    coll = model.param_collection()
+
+    def train_one(data: gp_lib.GPData, key: Array) -> gp_lib.GPState:
+        inits = coll.batch_random_init_unconstrained(key, num_restarts)
+        loss_fn = lambda p: model.neg_log_likelihood(p, data)
+        result = optimizer(loss_fn, inits)
+        return model.precompute(result.params, data)
+
+    m = batched_data.labels.shape[0]
+    keys = jax.random.split(rng, m)
+    return jax.vmap(train_one)(batched_data, keys)
+
+
 @dataclasses.dataclass
 class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     """GP-UCB/EI designer over flat (non-conditional) search spaces."""
@@ -144,38 +168,63 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         self._rng, out = jax.random.split(self._rng)
         return out
 
-    def _warped_model_data(self, extra_rows: int = 0) -> types.ModelData:
-        """Encode + warp labels + pad. Labels leave here all-MAXIMIZE ~N(0,1).
+    def _padded_features(
+        self, trials: Sequence[trial_.Trial], extra_rows: int = 0
+    ) -> tuple:
+        """(ModelInput, n_pad): the ONE encode+pad implementation.
 
         ``extra_rows`` reserves additional padded capacity (e.g. for batch
         fantasy conditioning in GP-UCB-PE).
         """
         conv = self._converter
-        n = len(self._trials)
-        raw_labels = conv.metrics.encode(self._trials)  # [N, M], NaN infeasible
-        warped = self._warper(raw_labels[:, self.metric_index])
-        n_pad = conv.padding.pad_trials(n + extra_rows)
-        cont, cat = conv.encoder.encode(self._trials)
-        dc_pad = conv.padding.pad_features(conv.encoder.num_continuous)
-        ds_pad = conv.padding.pad_features(conv.encoder.num_categorical)
+        n_pad = conv.padding.pad_trials(len(trials) + extra_rows)
+        cont, cat = conv.encoder.encode(trials)
         features = types.ContinuousAndCategorical(
             continuous=types.PaddedArray.from_array(
-                cont.astype(np.float32), (n_pad, dc_pad)
+                cont.astype(np.float32),
+                (n_pad, conv.padding.pad_features(conv.encoder.num_continuous)),
             ),
             categorical=types.PaddedArray.from_array(
-                cat.astype(np.int32), (n_pad, ds_pad), fill_value=0
+                cat.astype(np.int32),
+                (n_pad, conv.padding.pad_features(conv.encoder.num_categorical)),
+                fill_value=0,
             ),
         )
+        return features, n_pad
+
+    def _warped_model_data(self, extra_rows: int = 0) -> types.ModelData:
+        """Encode + warp labels + pad. Labels leave here all-MAXIMIZE ~N(0,1)."""
+        conv = self._converter
+        raw_labels = conv.metrics.encode(self._trials)  # [N, M], NaN infeasible
+        warped = self._warper(raw_labels[:, self.metric_index])
+        features, n_pad = self._padded_features(self._trials, extra_rows)
         labels = types.PaddedArray.from_array(
             warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
         )
         return types.ModelData(features=features, labels=labels)
+
+    def set_priors(self, prior_trials: Sequence[Sequence[trial_.Trial]]) -> None:
+        """Registers prior-study trials for stacked-residual transfer learning.
+
+        Parity with ``gp_bandit.py:289`` (``set_priors``): each sequence is
+        one prior study (oldest first); priors must share the search space.
+        """
+        self._priors = [list(p) for p in prior_trials]
+
+    def _num_objectives(self) -> int:
+        return sum(
+            1 for m in self.problem.metric_information if not m.is_safety_metric
+        )
 
     def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
         count = count or 1
         n = len(self._trials)
         if n < self.num_seed_trials:
             return self._seed_suggestions(count)
+        if self._num_objectives() > 1:
+            return self._suggest_multiobjective(count)
+        if getattr(self, "_priors", None):
+            return self._suggest_with_priors(count)
 
         data = gp_lib.GPData.from_model_data(self._warped_model_data())
         states = _train_gp(
@@ -204,6 +253,11 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         result = _maximize_acquisition(
             self._vec_opt, scoring, self._next_rng(), count, prior
         )
+        return self._decode_result(result, count, kind=self.acquisition)
+
+    def _decode_result(
+        self, result: vectorized_lib.VectorizedOptimizerResult, count: int, *, kind: str
+    ) -> List[trial_.TrialSuggestion]:
         cont = np.asarray(result.features.continuous)[:count]
         cat = np.asarray(result.features.categorical)[:count]
         scores = np.asarray(result.scores)[:count]
@@ -215,9 +269,105 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             )[0]
             s = trial_.TrialSuggestion(parameters=params)
             s.metadata.ns("gp_bandit")["acquisition"] = float(score)
-            s.metadata.ns("gp_bandit")["acquisition_kind"] = self.acquisition
+            s.metadata.ns("gp_bandit")["acquisition_kind"] = kind
             suggestions.append(s)
         return suggestions
+
+    # -- transfer learning -------------------------------------------------
+
+    def _data_for_trials(self, trials: Sequence[trial_.Trial]) -> gp_lib.GPData:
+        """Encodes an arbitrary trial set with this designer's converter."""
+        conv = self._converter
+        raw = conv.metrics.encode(trials)
+        warped = self._warper(raw[:, self.metric_index])
+        features, n_pad = self._padded_features(trials)
+        labels = types.PaddedArray.from_array(
+            warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
+        )
+        return gp_lib.GPData.from_model_data(types.ModelData(features, labels))
+
+    def _suggest_with_priors(self, count: int) -> List[trial_.TrialSuggestion]:
+        from vizier_tpu.models import stacked_residual
+
+        datasets = [self._data_for_trials(p) for p in self._priors]
+        data = gp_lib.GPData.from_model_data(self._warped_model_data())
+        datasets.append(data)
+        stack = stacked_residual.train_stacked_residual_gp(
+            self._model,
+            self._ard,
+            datasets,
+            self._next_rng(),
+            num_restarts=self.ard_restarts,
+        )
+        self._last_predictive = stack  # duck-typed .predict
+        best_label = jnp.max(jnp.where(data.row_mask, data.labels, -jnp.inf))
+        scoring = acquisitions.ScoringFunction(
+            predictive=stack,
+            acquisition=self._make_acquisition(),
+            best_label=best_label,
+            trust_region=(
+                acquisitions.TrustRegion.from_data(data)
+                if self.use_trust_region
+                else None
+            ),
+        )
+        result = _maximize_acquisition(
+            self._vec_opt, scoring, self._next_rng(), count, self._prior_features(data)
+        )
+        return self._decode_result(result, count, kind=f"{self.acquisition}+priors")
+
+    # -- multi-objective ---------------------------------------------------
+
+    def _suggest_multiobjective(self, count: int) -> List[trial_.TrialSuggestion]:
+        """Random-hypervolume scalarized UCB over per-metric GPs."""
+        conv = self._converter
+        trials = self._trials
+        raw = conv.metrics.encode(trials)  # [N, M] all-MAXIMIZE
+        objective_idx = [
+            j
+            for j, m in enumerate(self.problem.metric_information)
+            if not m.is_safety_metric
+        ]
+        features, n_pad = self._padded_features(trials)
+        datas = []
+        refs = []
+        for j in objective_idx:
+            warped = self._warper(raw[:, j])
+            labels = types.PaddedArray.from_array(
+                warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
+            )
+            datas.append(
+                gp_lib.GPData.from_model_data(types.ModelData(features, labels))
+            )
+            refs.append(float(np.min(warped)) - 0.1)
+        batched = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *datas)
+        states = _train_gp_per_metric(
+            self._model, self._ard, batched, self._next_rng(), self.ard_restarts
+        )
+        m = len(objective_idx)
+        directions = jnp.abs(
+            jax.random.normal(self._next_rng(), (64, m), dtype=jnp.float32)
+        )
+        directions = directions / jnp.linalg.norm(directions, axis=-1, keepdims=True)
+        scoring = acquisitions.HVScalarizedScoring(
+            metric_states=states,
+            directions=directions,
+            reference_point=jnp.asarray(refs, jnp.float32),
+            ucb_coefficient=self.ucb_coefficient,
+            trust_region=(
+                acquisitions.TrustRegion.from_data(datas[0])
+                if self.use_trust_region
+                else None
+            ),
+        )
+        result = _maximize_acquisition(
+            self._vec_opt,
+            scoring,
+            self._next_rng(),
+            count,
+            self._prior_features(datas[0]),
+        )
+        return self._decode_result(result, count, kind="hv_scalarized_ucb")
 
     # -- pieces ------------------------------------------------------------
 

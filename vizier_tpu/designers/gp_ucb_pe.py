@@ -138,6 +138,12 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         n = len(self._trials)
         if n < self.num_seed_trials:
             return self._seed_suggestions(count)
+        # Multi-objective and transfer-learning studies route through the
+        # parent's dedicated paths (UCB-PE batching is single-objective).
+        if self._num_objectives() > 1:
+            return self._suggest_multiobjective(count)
+        if getattr(self, "_priors", None):
+            return self._suggest_with_priors(count)
 
         # Reserve padded capacity for the batch's fantasy rows.
         conv = self._converter

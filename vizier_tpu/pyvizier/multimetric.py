@@ -77,10 +77,16 @@ class SafetyChecker:
     def warp_unsafe_trials(
         self, trials: Sequence[trial_.Trial]
     ) -> Sequence[trial_.Trial]:
-        """Marks unsafe completed trials infeasible (in place); returns them."""
+        """Marks unsafe completed trials infeasible (in place); returns them.
+
+        The final measurement is cleared — label encoders treat a trial with
+        a measurement as feasible data, so the objective of an unsafe trial
+        must not leak into model training.
+        """
         for t in trials:
             if not self.is_safe(t):
                 t.infeasibility_reason = t.infeasibility_reason or "Safety violation."
+                t.final_measurement = None
         return trials
 
     def is_safe(self, trial: trial_.Trial) -> bool:

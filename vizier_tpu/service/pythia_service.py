@@ -81,9 +81,22 @@ class PythiaServicer:
         response = pythia_service_pb2.PythiaEarlyStopResponse()
         try:
             config = pc.study_config_from_proto(request.study_descriptor.config)
-            policy = self._get_policy(
-                config, request.algorithm or config.algorithm, request.study_name
-            )
+            if config.automated_stopping_config is not None:
+                # Studies with a stopping spec use the median curve rule;
+                # otherwise the algorithm's own policy decides.
+                from vizier_tpu.algorithms import early_stopping
+
+                policy = early_stopping.MedianEarlyStopPolicy(
+                    supporter=service_policy_supporter.ServicePolicySupporter(
+                        request.study_name, self._vizier
+                    ),
+                    use_steps=config.automated_stopping_config.use_steps,
+                    min_num_trials=config.automated_stopping_config.min_num_trials,
+                )
+            else:
+                policy = self._get_policy(
+                    config, request.algorithm or config.algorithm, request.study_name
+                )
             descriptor = vz.StudyDescriptor(
                 config=config,
                 guid=request.study_descriptor.guid,
