@@ -288,8 +288,9 @@ class TestReviewRegressions:
         t.complete(vz.Measurement(metrics={"obj": 99.0, "safe": 0.0}))
         checker.warp_unsafe_trials([t])
         assert t.infeasible
-        assert t.final_measurement is None
-        # Label encoders now see NaN for it.
+        # Measurement data is preserved for analyzers/safety checks...
+        assert t.final_measurement is not None
+        # ...but label encoders see NaN for it.
         from vizier_tpu.converters import core as conv
 
         enc = conv.MetricsEncoder(metrics)

@@ -1,0 +1,229 @@
+"""Tests for testing libs, acquisition optimizers, analyzers, integrations."""
+
+import jax
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.benchmarks import NumpyExperimenter, bbob_problem
+from vizier_tpu.benchmarks.analyzers import convergence_curve as cc
+from vizier_tpu.benchmarks.analyzers.state_analyzer import BenchmarkStateAnalyzer
+from vizier_tpu.benchmarks.experimenters.experimenter_factory import (
+    SingleObjectiveExperimenterFactory,
+)
+from vizier_tpu.benchmarks.experimenters.synthetic import bbob
+from vizier_tpu.designers import GridSearchDesigner, RandomDesigner
+from vizier_tpu.testing import comparator_runner, failing, simplekd_runner
+
+
+class TestComparatorRunner:
+    def test_grid_beats_random_on_1d(self):
+        exp = NumpyExperimenter(bbob.Sphere, bbob_problem(1))
+        tester = comparator_runner.EfficiencyComparisonTester(
+            num_trials=20, num_repeats=2, margin=0.0
+        )
+        score = tester.assert_better_efficiency(
+            exp,
+            candidate_factory=lambda p, **kw: GridSearchDesigner(
+                p.search_space, double_grid_resolution=21
+            ),
+            baseline_factory=lambda p, **kw: RandomDesigner(
+                p.search_space, seed=kw.get("seed", 0)
+            ),
+        )
+        assert np.isfinite(score)
+
+    def test_simple_regret_failure_raises(self):
+        exp = NumpyExperimenter(bbob.Sphere, bbob_problem(2))
+
+        class AwfulDesigner(core_lib.Designer):
+            def update(self, completed, all_active=core_lib.ActiveTrials()):
+                pass
+
+            def suggest(self, count=None):
+                # Always the worst corner.
+                return [
+                    vz.TrialSuggestion(parameters={"x0": 5.0, "x1": 5.0})
+                    for _ in range(count or 1)
+                ]
+
+        tester = comparator_runner.SimpleRegretComparisonTester(
+            num_trials=10, num_repeats=2
+        )
+        with pytest.raises(comparator_runner.FailedComparisonTestError):
+            tester.assert_better_simple_regret(
+                exp,
+                candidate_factory=lambda p, **kw: AwfulDesigner(),
+                baseline_factory=lambda p, **kw: RandomDesigner(
+                    p.search_space, seed=kw.get("seed", 0)
+                ),
+            )
+
+
+class TestSimpleKDRunner:
+    def test_random_converges_loosely(self):
+        tester = simplekd_runner.SimpleKDConvergenceTester(
+            num_trials=80, batch_size=8, max_abs_error=1.5
+        )
+        best = tester.assert_converges(
+            lambda p, **kw: RandomDesigner(p.search_space, seed=kw.get("seed", 0))
+        )
+        assert best <= 0.0
+
+    def test_failing_designer_raises(self):
+        with pytest.raises(failing.FailedSuggestError):
+            simplekd_runner.SimpleKDConvergenceTester(num_trials=5).assert_converges(
+                lambda p, **kw: failing.FailingDesigner()
+            )
+
+
+class TestFailingDesigners:
+    def test_alternate_fails_odd_calls(self):
+        space = vz.SearchSpace()
+        space.root.add_float_param("x", 0, 1)
+        inner = RandomDesigner(space, seed=0)
+        d = failing.AlternateFailingDesigner(inner)
+        with pytest.raises(failing.FailedSuggestError):
+            d.suggest(1)
+        assert len(d.suggest(1)) == 1
+
+
+class TestLBFGSBOptimizer:
+    def test_maximizes_smooth_acquisition(self):
+        import jax.numpy as jnp
+
+        from vizier_tpu.optimizers.lbfgsb_optimizer import LBFGSBOptimizer
+
+        def score(feats):
+            return -jnp.sum((feats.continuous - 0.7) ** 2, axis=-1)
+
+        result = LBFGSBOptimizer(num_restarts=8, maxiter=40)(
+            score, jax.random.PRNGKey(0), num_continuous=3, count=2
+        )
+        best = np.asarray(result.features.continuous[0])
+        np.testing.assert_allclose(best, 0.7, atol=0.02)
+
+    def test_designer_as_optimizer(self):
+        from vizier_tpu.optimizers.lbfgsb_optimizer import DesignerAsOptimizer
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 1.0)
+        problem.metric_information.append(
+            vz.MetricInformation(name="acquisition", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        opt = DesignerAsOptimizer(
+            designer_factory=lambda p: RandomDesigner(p.search_space, seed=0),
+            num_rounds=5,
+            batch_size=8,
+        )
+        best = opt.optimize(
+            lambda suggs: [-(s.parameters.get_value("x") - 0.4) ** 2 for s in suggs],
+            problem,
+            count=1,
+        )
+        assert abs(best[0].parameters.get_value("x") - 0.4) < 0.2
+
+
+class TestAnalyzers:
+    def test_hypervolume_curve_monotone(self):
+        metrics = [
+            vz.MetricInformation(name="f1", goal=vz.ObjectiveMetricGoal.MINIMIZE),
+            vz.MetricInformation(name="f2", goal=vz.ObjectiveMetricGoal.MINIMIZE),
+        ]
+        trials = []
+        rng = np.random.default_rng(0)
+        for i in range(20):
+            t = vz.Trial(id=i + 1, parameters={"x": 0.5})
+            f1, f2 = rng.uniform(size=2)
+            t.complete(vz.Measurement(metrics={"f1": f1, "f2": f2}))
+            trials.append(t)
+        curve = cc.HypervolumeCurveConverter(metrics, seed=1).convert(trials)
+        assert curve.ys.shape == (1, 20)
+        assert (np.diff(curve.ys[0]) >= -1e-6).all()  # cumulative HV grows
+
+    def test_state_analyzer_records(self):
+        from vizier_tpu.benchmarks import BenchmarkRunner, BenchmarkState, GenerateAndEvaluate
+
+        exp = NumpyExperimenter(bbob.Sphere, bbob_problem(2))
+        state = BenchmarkState.from_designer_factory(
+            exp, lambda p, **kw: RandomDesigner(p.search_space, seed=0)
+        )
+        BenchmarkRunner([GenerateAndEvaluate(5)], num_repeats=2).run(state)
+        records = BenchmarkStateAnalyzer.to_records([state], algorithm_names=["random"])
+        assert records[0]["algorithm"] == "random"
+        assert records[0]["num_trials"] == 10
+        df = BenchmarkStateAnalyzer.to_dataframe([state])
+        assert len(df) == 1
+
+    def test_percentage_better(self):
+        xs = np.arange(1, 6)
+        a = cc.ConvergenceCurve(xs=xs, ys=np.array([[1, 2, 3, 4, 5.0]]),
+                                trend=cc.ConvergenceCurve.YTrend.INCREASING)
+        b = cc.ConvergenceCurve(xs=xs, ys=np.array([[2, 3, 4, 5, 6.0]]),
+                                trend=cc.ConvergenceCurve.YTrend.INCREASING)
+        assert cc.PercentageBetterComparator(a).score(b) == 1.0
+
+
+class TestExperimenterFactory:
+    def test_builds_wrapped(self):
+        factory = SingleObjectiveExperimenterFactory(
+            name="Rastrigin", dim=3, shift=np.array([1.0, 0.5, -1.0]), noise_std=0.1
+        )
+        exp = factory()
+        t = vz.Trial(id=1, parameters={"x0": 1.0, "x1": 0.5, "x2": -1.0})
+        exp.evaluate([t])
+        # At the shifted optimum: value = 0 + noise.
+        assert abs(t.final_measurement.metrics["bbob_eval"].value) < 1.0
+        assert "Rastrigin_3d" in factory.description
+
+    def test_unknown_name(self):
+        with pytest.raises(ValueError):
+            SingleObjectiveExperimenterFactory(name="NotAFunction")()
+
+
+class TestIntegrations:
+    def test_raytune_converter_dict_language(self):
+        from vizier_tpu.raytune.vizier_search import SearchSpaceConverter
+
+        space = SearchSpaceConverter.to_vizier(
+            {
+                "lr": {"type": "loguniform", "min": 1e-4, "max": 1e-1},
+                "units": {"type": "randint", "min": 32, "max": 512},
+                "act": {"type": "choice", "values": ["relu", "tanh"]},
+                "drop": {"type": "uniform", "min": 0.0, "max": 0.5},
+            }
+        )
+        assert space.parameter_names() == ["lr", "units", "act", "drop"]
+        assert space.get("lr").scale_type == vz.ScaleType.LOG
+
+    def test_raytune_searcher_requires_ray(self):
+        from vizier_tpu.raytune import vizier_search
+
+        if not vizier_search._RAY_AVAILABLE:
+            with pytest.raises(ImportError):
+                vizier_search.VizierSearch({"x": {"type": "uniform", "min": 0, "max": 1}}, metric="m")
+
+    def test_pyglove_dna_converter(self):
+        from vizier_tpu.pyglove.backend import DNATrialConverter
+
+        decisions = {"layer": 3, "act": "relu", "widths": [64, 128]}
+        s = DNATrialConverter.to_suggestion(decisions)
+        t = s.to_trial(1)
+        assert DNATrialConverter.to_decisions(t) == decisions
+
+    def test_pyglove_backend_requires_pyglove(self):
+        from vizier_tpu.pyglove import backend
+
+        if not backend.PYGLOVE_AVAILABLE:
+            with pytest.raises(ImportError):
+                backend.VizierBackend("s")
+
+
+class TestReviewRegressions:
+    """Regressions from the eighth code review."""
+
+    def test_hypervolume_curve_empty_trials(self):
+        metrics = [vz.MetricInformation(name="f1"), vz.MetricInformation(name="f2")]
+        curve = cc.HypervolumeCurveConverter(metrics).convert([])
+        assert curve.ys.shape[-1] == 0

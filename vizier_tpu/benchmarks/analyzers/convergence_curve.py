@@ -168,3 +168,82 @@ class SimpleRegretComparator:
         if self.goal.is_maximize:
             return float(self.optimum - best)
         return float(best - self.optimum)
+
+
+class HypervolumeCurveConverter:
+    """Trials → cumulative-hypervolume curve (multi-objective progress).
+
+    Parity with the reference ``HypervolumeCurveConverter``
+    (``convergence_curve.py:714``), computed by the XLA random-direction
+    hypervolume op.
+    """
+
+    def __init__(
+        self,
+        metric_informations: Sequence[base_study_config.MetricInformation],
+        *,
+        reference_point: Optional[np.ndarray] = None,
+        num_vectors: int = 2000,
+        seed: int = 0,
+    ):
+        self._metrics = list(metric_informations)
+        self._reference = reference_point
+        self._num_vectors = num_vectors
+        self._seed = seed
+
+    def convert(self, trials: Sequence[trial_.Trial]) -> ConvergenceCurve:
+        import jax
+
+        from vizier_tpu.ops import pareto as pareto_ops
+
+        if not trials:
+            return ConvergenceCurve(
+                xs=np.zeros((0,)),
+                ys=np.zeros((1, 0)),
+                trend=ConvergenceCurve.YTrend.INCREASING,
+            )
+        rows = []
+        for t in trials:
+            row = []
+            for info in self._metrics:
+                if t.final_measurement and info.name in t.final_measurement.metrics:
+                    v = t.final_measurement.metrics[info.name].value
+                    row.append(-v if info.goal.is_minimize else v)
+                else:
+                    row.append(-np.inf)
+            rows.append(row)
+        points = np.asarray(rows, dtype=np.float32)
+        if self._reference is None:
+            finite = points[np.all(np.isfinite(points), axis=1)]
+            ref = (
+                finite.min(axis=0) - 1e-6
+                if len(finite)
+                else np.zeros(points.shape[1], np.float32)
+            )
+        else:
+            ref = np.asarray(self._reference, np.float32)
+        shifted = np.maximum(np.nan_to_num(points - ref[None, :], neginf=0.0), 0.0)
+        cum = pareto_ops.cum_hypervolume_origin(
+            shifted, jax.random.PRNGKey(self._seed), num_vectors=self._num_vectors
+        )
+        ys = np.asarray(cum, dtype=np.float64)
+        return ConvergenceCurve(
+            xs=np.arange(1, len(trials) + 1),
+            ys=ys[None, :],
+            trend=ConvergenceCurve.YTrend.INCREASING,
+        )
+
+
+@dataclasses.dataclass
+class PercentageBetterComparator:
+    """Fraction of x-positions where compared's median beats baseline's."""
+
+    baseline_curve: ConvergenceCurve
+
+    def score(self, compared: ConvergenceCurve) -> float:
+        base = self.baseline_curve
+        sign = 1.0 if base.trend == ConvergenceCurve.YTrend.INCREASING else -1.0
+        n = min(base.ys.shape[1], compared.ys.shape[1])
+        base_med = sign * base.percentile_curve(50.0)[:n]
+        comp_med = sign * compared.percentile_curve(50.0)[:n]
+        return float(np.mean(comp_med > base_med))

@@ -1,0 +1,57 @@
+"""Benchmark-state → records/DataFrame summaries.
+
+Parity with ``/root/reference/vizier/_src/benchmarks/analyzers/state_analyzer.py:87``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from vizier_tpu.benchmarks.analyzers import convergence_curve as cc
+from vizier_tpu.benchmarks.runners import benchmark_state
+from vizier_tpu.pyvizier import trial as trial_
+
+
+class BenchmarkStateAnalyzer:
+    """Summarizes finished benchmark states into plain records."""
+
+    @staticmethod
+    def to_records(
+        states: Sequence[benchmark_state.BenchmarkState],
+        *,
+        algorithm_names: Optional[Sequence[str]] = None,
+    ) -> List[Dict]:
+        records = []
+        for i, state in enumerate(states):
+            problem = state.experimenter.problem_statement()
+            metric = next(
+                m for m in problem.metric_information if not m.is_safety_metric
+            )
+            trials = state.algorithm.supporter.GetTrials(
+                status_matches=trial_.TrialStatus.COMPLETED
+            )
+            curve = cc.ConvergenceCurveConverter(
+                metric, flip_signs_for_min=True
+            ).convert(trials)
+            records.append(
+                {
+                    "algorithm": (
+                        algorithm_names[i] if algorithm_names else f"algo_{i}"
+                    ),
+                    "num_trials": len(trials),
+                    "best_objective": float(curve.ys[0, -1]) if len(trials) else np.nan,
+                    "curve_xs": curve.xs,
+                    "curve_ys": curve.ys[0],
+                }
+            )
+        return records
+
+    @staticmethod
+    def to_dataframe(states, *, algorithm_names=None):
+        import pandas as pd
+
+        return pd.DataFrame(
+            BenchmarkStateAnalyzer.to_records(states, algorithm_names=algorithm_names)
+        )

@@ -1,0 +1,58 @@
+"""Experimenter factories: named benchmark construction.
+
+Parity with
+``/root/reference/vizier/_src/benchmarks/experimenters/experimenter_factory.py:44,110``:
+``BBOBFactory``/``SingleObjectiveExperimenterFactory`` build (optionally
+shifted/noised/discretized) objectives by name — the configuration unit
+benchmark sweeps iterate over.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from vizier_tpu.benchmarks.experimenters import base, wrappers
+from vizier_tpu.benchmarks.experimenters.synthetic import bbob
+
+
+@dataclasses.dataclass
+class SingleObjectiveExperimenterFactory:
+    """Builds a BBOB experimenter by name with standard wrappers."""
+
+    name: str
+    dim: int = 4
+    shift: Optional[np.ndarray] = None
+    noise_std: Optional[float] = None
+    discrete_dict: Optional[dict] = None
+    seed: int = 0
+
+    def __call__(self) -> base.Experimenter:
+        if self.name not in bbob.BBOB_FUNCTIONS:
+            raise ValueError(
+                f"Unknown BBOB function {self.name!r}; "
+                f"choices: {sorted(bbob.BBOB_FUNCTIONS)}"
+            )
+        exptr: base.Experimenter = base.NumpyExperimenter(
+            bbob.BBOB_FUNCTIONS[self.name], base.bbob_problem(self.dim)
+        )
+        if self.shift is not None:
+            exptr = wrappers.ShiftingExperimenter(exptr, np.asarray(self.shift))
+        if self.discrete_dict:
+            exptr = wrappers.DiscretizingExperimenter(exptr, self.discrete_dict)
+        if self.noise_std is not None:
+            exptr = wrappers.NoisyExperimenter(
+                exptr, noise_std=self.noise_std, seed=self.seed
+            )
+        return exptr
+
+    @property
+    def description(self) -> str:
+        parts = [f"{self.name}_{self.dim}d"]
+        if self.shift is not None:
+            parts.append("shifted")
+        if self.noise_std:
+            parts.append(f"noise{self.noise_std}")
+        return "_".join(parts)

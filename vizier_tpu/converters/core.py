@@ -326,7 +326,9 @@ class MetricsEncoder:
     def encode(self, trials: Sequence[trial_.Trial]) -> np.ndarray:
         out = np.full((len(trials), len(self._metrics)), np.nan, dtype=np.float64)
         for i, t in enumerate(trials):
-            if t.final_measurement is None:
+            # Infeasible trials contribute NaN even if they carry a
+            # measurement (e.g. safety-warped trials keep their data).
+            if t.final_measurement is None or t.infeasible:
                 continue
             for j, info in enumerate(self._metrics):
                 metric = t.final_measurement.metrics.get(info.name)

@@ -192,16 +192,22 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         )
         return features, n_pad
 
+    @staticmethod
+    def _padded_labels(warped: np.ndarray, n_pad: int) -> types.PaddedArray:
+        """The ONE warped-label padding implementation."""
+        return types.PaddedArray.from_array(
+            warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
+        )
+
     def _warped_model_data(self, extra_rows: int = 0) -> types.ModelData:
         """Encode + warp labels + pad. Labels leave here all-MAXIMIZE ~N(0,1)."""
         conv = self._converter
         raw_labels = conv.metrics.encode(self._trials)  # [N, M], NaN infeasible
         warped = self._warper(raw_labels[:, self.metric_index])
         features, n_pad = self._padded_features(self._trials, extra_rows)
-        labels = types.PaddedArray.from_array(
-            warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
+        return types.ModelData(
+            features=features, labels=self._padded_labels(warped, n_pad)
         )
-        return types.ModelData(features=features, labels=labels)
 
     def set_priors(self, prior_trials: Sequence[Sequence[trial_.Trial]]) -> None:
         """Registers prior-study trials for stacked-residual transfer learning.
@@ -281,10 +287,9 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         raw = conv.metrics.encode(trials)
         warped = self._warper(raw[:, self.metric_index])
         features, n_pad = self._padded_features(trials)
-        labels = types.PaddedArray.from_array(
-            warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
+        return gp_lib.GPData.from_model_data(
+            types.ModelData(features, self._padded_labels(warped, n_pad))
         )
-        return gp_lib.GPData.from_model_data(types.ModelData(features, labels))
 
     def _suggest_with_priors(self, count: int) -> List[trial_.TrialSuggestion]:
         from vizier_tpu.models import stacked_residual
@@ -333,11 +338,10 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         refs = []
         for j in objective_idx:
             warped = self._warper(raw[:, j])
-            labels = types.PaddedArray.from_array(
-                warped[:, None].astype(np.float32), (n_pad, 1), fill_value=np.nan
-            )
             datas.append(
-                gp_lib.GPData.from_model_data(types.ModelData(features, labels))
+                gp_lib.GPData.from_model_data(
+                    types.ModelData(features, self._padded_labels(warped, n_pad))
+                )
             )
             refs.append(float(np.min(warped)) - 0.1)
         batched = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *datas)

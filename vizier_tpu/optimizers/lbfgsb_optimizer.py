@@ -1,0 +1,107 @@
+"""Gradient-based acquisition maximization (continuous-only).
+
+Parity with
+``/root/reference/vizier/_src/algorithms/optimizers/lbfgsb_optimizer.py:230``:
+maximizes a differentiable acquisition over [0, 1]^D via multi-restart
+L-BFGS — bounds handled by a sigmoid reparameterization (same trick as the
+ARD train), so the whole thing is one jitted program with vmapped restarts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from vizier_tpu.models import kernels
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+from vizier_tpu.optimizers import vectorized as vectorized_lib
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class LBFGSBOptimizer:
+    """Continuous acquisition maximizer under the vectorized-result API."""
+
+    num_restarts: int = 16
+    maxiter: int = 50
+
+    def __call__(
+        self,
+        score_fn: vectorized_lib.ScoreFn,
+        rng: Array,
+        *,
+        num_continuous: int,
+        count: int = 1,
+    ) -> vectorized_lib.VectorizedOptimizerResult:
+        def unconstrained_loss(z: Array) -> Array:
+            x = jax.nn.sigmoid(z)[None, :]  # (0,1)^D
+            feats = kernels.MixedFeatures(
+                x, jnp.zeros((1, 0), jnp.int32)
+            )
+            return -score_fn(feats)[0]
+
+        def run_one(key: Array) -> Tuple[Array, Array]:
+            z0 = jax.random.normal(key, (num_continuous,), dtype=jnp.float32) * 2.0
+            z, loss = lbfgs_lib.lbfgs_minimize(
+                unconstrained_loss, z0, maxiter=self.maxiter
+            )
+            return jax.nn.sigmoid(z), -loss
+
+        keys = jax.random.split(rng, self.num_restarts)
+        xs, scores = jax.vmap(run_one)(keys)
+        top_scores, idx = jax.lax.top_k(scores, count)
+        return vectorized_lib.VectorizedOptimizerResult(
+            kernels.MixedFeatures(
+                xs[idx], jnp.zeros((count, 0), jnp.int32)
+            ),
+            top_scores,
+        )
+
+
+@dataclasses.dataclass
+class DesignerAsOptimizer:
+    """Uses any Designer as a (gradient-free) acquisition optimizer.
+
+    Parity with ``optimizers/designer_optimizer.py:93``: the acquisition is
+    treated as the objective of a mini-study driven by the designer.
+    """
+
+    designer_factory: Callable  # problem -> Designer
+    num_rounds: int = 20
+    batch_size: int = 10
+
+    def optimize(
+        self,
+        score_fn,  # list[TrialSuggestion] -> list[float]
+        problem,
+        *,
+        count: int = 1,
+    ):
+        from vizier_tpu.algorithms import core as core_lib
+        from vizier_tpu.pyvizier import trial as trial_
+
+        designer = self.designer_factory(problem)
+        scored = []
+        next_id = 1
+        for _ in range(self.num_rounds):
+            suggestions = designer.suggest(self.batch_size)
+            if not suggestions:
+                break
+            values = score_fn(suggestions)
+            completed = []
+            for s, v in zip(suggestions, values):
+                t = s.to_trial(next_id)
+                next_id += 1
+                t.complete(
+                    trial_.Measurement(metrics={"acquisition": float(v)})
+                )
+                completed.append(t)
+                scored.append((float(v), s))
+            designer.update(core_lib.CompletedTrials(completed), core_lib.ActiveTrials())
+        scored.sort(key=lambda pair: -pair[0])
+        return [s for _, s in scored[:count]]

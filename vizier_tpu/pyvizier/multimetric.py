@@ -79,14 +79,13 @@ class SafetyChecker:
     ) -> Sequence[trial_.Trial]:
         """Marks unsafe completed trials infeasible (in place); returns them.
 
-        The final measurement is cleared — label encoders treat a trial with
-        a measurement as feasible data, so the objective of an unsafe trial
-        must not leak into model training.
+        Measurement data is preserved (so safety checks and analyzers keep
+        working); label encoders exclude infeasible trials regardless of
+        their measurements, so the objective cannot leak into model training.
         """
         for t in trials:
             if not self.is_safe(t):
                 t.infeasibility_reason = t.infeasibility_reason or "Safety violation."
-                t.final_measurement = None
         return trials
 
     def is_safe(self, trial: trial_.Trial) -> bool:

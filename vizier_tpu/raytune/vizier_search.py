@@ -1,0 +1,131 @@
+"""Ray Tune integration: ``VizierSearch`` searcher.
+
+Parity with ``/root/reference/vizier/_src/raytune/vizier_search.py:32`` and
+``converters.py``: a ``ray.tune.search.Searcher`` backed by the vizier-tpu
+study service. Ray is not bundled in this image, so the module degrades to a
+clear ImportError at construction time while remaining importable (the
+search-space converter is pure and fully testable without ray).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.service import clients
+
+try:  # pragma: no cover - exercised only where ray is installed.
+    from ray.tune.search import Searcher as _RaySearcher
+
+    _RAY_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    _RaySearcher = object
+    _RAY_AVAILABLE = False
+
+
+class SearchSpaceConverter:
+    """Ray Tune param_space dict → vizier SearchSpace."""
+
+    @staticmethod
+    def to_vizier(param_space: Dict[str, Any]) -> vz.SearchSpace:
+        space = vz.SearchSpace()
+        root = space.root
+        for name, domain in param_space.items():
+            if isinstance(domain, dict):  # plain-dict mini-language
+                kind = domain.get("type")
+                if kind == "uniform":
+                    root.add_float_param(name, domain["min"], domain["max"])
+                elif kind == "loguniform":
+                    root.add_float_param(
+                        name, domain["min"], domain["max"], scale_type=vz.ScaleType.LOG
+                    )
+                elif kind == "randint":
+                    root.add_int_param(name, domain["min"], domain["max"])
+                elif kind == "choice":
+                    values = domain["values"]
+                    if all(isinstance(v, str) for v in values):
+                        root.add_categorical_param(name, values)
+                    else:
+                        root.add_discrete_param(name, values)
+                else:
+                    raise ValueError(f"Unknown domain type {kind!r} for {name!r}.")
+                continue
+            # Ray Domain objects (duck-typed to avoid a hard ray dependency).
+            cls = type(domain).__name__
+            if cls == "Float":
+                sampler = type(getattr(domain, "sampler", None)).__name__
+                scale = vz.ScaleType.LOG if "LogUniform" in sampler else vz.ScaleType.LINEAR
+                root.add_float_param(name, domain.lower, domain.upper, scale_type=scale)
+            elif cls == "Integer":
+                root.add_int_param(name, domain.lower, domain.upper - 1)
+            elif cls == "Categorical":
+                values = list(domain.categories)
+                if all(isinstance(v, str) for v in values):
+                    root.add_categorical_param(name, values)
+                else:
+                    root.add_discrete_param(name, values)
+            else:
+                raise ValueError(f"Unsupported ray domain {cls!r} for {name!r}.")
+        return space
+
+
+class VizierSearch(_RaySearcher):
+    """ray.tune Searcher delegating suggestions to a vizier-tpu study."""
+
+    def __init__(
+        self,
+        param_space: Dict[str, Any],
+        *,
+        metric: str,
+        mode: str = "max",
+        algorithm: str = "DEFAULT",
+        **kwargs,
+    ):
+        if not _RAY_AVAILABLE:
+            raise ImportError(
+                "ray is not installed in this environment; VizierSearch requires "
+                "ray[tune]. The SearchSpaceConverter works standalone."
+            )
+        super().__init__(metric=metric, mode=mode, **kwargs)
+        goal = (
+            vz.ObjectiveMetricGoal.MAXIMIZE
+            if mode == "max"
+            else vz.ObjectiveMetricGoal.MINIMIZE
+        )
+        config = vz.StudyConfig(algorithm=algorithm)
+        config.search_space = SearchSpaceConverter.to_vizier(param_space)
+        config.metric_information.append(
+            vz.MetricInformation(name=metric, goal=goal)
+        )
+        self._study = clients.Study.from_study_config(config, owner="raytune")
+        self._ray_to_vizier: Dict[str, int] = {}
+        self._metric = metric
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        (trial,) = self._study.suggest(count=1, client_id=trial_id)
+        self._ray_to_vizier[trial_id] = trial.id
+        return dict(trial.parameters)
+
+    def on_trial_complete(
+        self, trial_id: str, result: Optional[Dict] = None, error: bool = False
+    ) -> None:
+        uid = self._ray_to_vizier.pop(trial_id, None)
+        if uid is None:
+            return
+        trial = self._study.get_trial(uid)
+        if error or result is None or self._metric not in result:
+            trial.complete(infeasible_reason="ray trial errored")
+        else:
+            trial.complete(
+                vz.Measurement(metrics={self._metric: float(result[self._metric])})
+            )
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        uid = self._ray_to_vizier.get(trial_id)
+        if uid is not None and self._metric in result:
+            self._study.get_trial(uid).add_measurement(
+                vz.Measurement(
+                    metrics={self._metric: float(result[self._metric])},
+                    steps=float(result.get("training_iteration", 0)),
+                )
+            )
