@@ -1,0 +1,295 @@
+"""Tests: singleton params, parameter iterators, multi-task GP, perf stress."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.pythia.singleton_params import SingletonParameterHandler
+from vizier_tpu.pyvizier.parameter_iterators import SequentialParameterBuilder
+
+
+class TestSingletonParams:
+    def test_strip_and_augment(self):
+        problem = vz.ProblemStatement()
+        root = problem.search_space.root
+        root.add_float_param("x", 0.0, 1.0)
+        root.add_float_param("fixed_f", 2.0, 2.0)
+        root.add_categorical_param("fixed_c", ["only"])
+        root.add_int_param("fixed_i", 3, 3)
+        problem.metric_information.append(vz.MetricInformation(name="obj"))
+        handler = SingletonParameterHandler(problem)
+        assert handler.reduced_problem.search_space.parameter_names() == ["x"]
+        assert handler.fixed_parameters == {"fixed_f": 2.0, "fixed_c": "only", "fixed_i": 3}
+        s = vz.TrialSuggestion(parameters={"x": 0.5})
+        (aug,) = handler.augment([s])
+        assert aug.parameters.get_value("fixed_c") == "only"
+        assert problem.search_space.contains(aug.parameters)
+
+    def test_strip_trials(self):
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 1.0)
+        problem.search_space.root.add_categorical_param("fixed", ["v"])
+        problem.metric_information.append(vz.MetricInformation(name="obj"))
+        handler = SingletonParameterHandler(problem)
+        t = vz.Trial(id=1, parameters={"x": 0.3, "fixed": "v"})
+        t.complete(vz.Measurement(metrics={"obj": 1.0}))
+        (stripped,) = handler.strip([t])
+        assert "fixed" not in stripped.parameters
+        assert stripped.final_measurement is t.final_measurement
+
+    def test_conditional_parent_not_stripped(self):
+        problem = vz.ProblemStatement()
+        sel = problem.search_space.root.add_categorical_param("gate", ["only"])
+        sel.select_values(["only"]).add_float_param("child", 0.0, 1.0)
+        problem.metric_information.append(vz.MetricInformation(name="obj"))
+        handler = SingletonParameterHandler(problem)
+        # Parent has children → must stay even though single-valued.
+        assert "gate" in handler.reduced_problem.search_space.parameter_names()
+
+
+class TestSequentialParameterBuilder:
+    def test_walks_conditional_tree(self):
+        space = vz.SearchSpace()
+        model = space.root.add_categorical_param("model", ["linear", "dnn"])
+        model.select_values(["dnn"]).add_int_param("depth", 1, 4)
+        space.root.add_float_param("lr", 0.0, 1.0)
+
+        builder = SequentialParameterBuilder(space)
+        chosen = {"model": "dnn", "depth": 2, "lr": 0.5}
+        visited = []
+        for config in builder:
+            visited.append(config.name)
+            builder.choose_value(chosen[config.name])
+        assert visited == ["model", "depth", "lr"]
+        assert space.contains(builder.parameters)
+
+    def test_inactive_branch_skipped(self):
+        space = vz.SearchSpace()
+        model = space.root.add_categorical_param("model", ["linear", "dnn"])
+        model.select_values(["dnn"]).add_int_param("depth", 1, 4)
+        builder = SequentialParameterBuilder(space)
+        visited = []
+        for config in builder:
+            visited.append(config.name)
+            builder.choose_value("linear" if config.name == "model" else 1)
+        assert visited == ["model"]
+
+
+class TestMultiTaskGP:
+    def _multitask_data(self, n=12, rho=0.9):
+        from vizier_tpu import types
+        from vizier_tpu.models import gp as gp_lib
+        from vizier_tpu.models.multitask_gp import MultiTaskData
+
+        rng = np.random.default_rng(0)
+        x = rng.uniform(size=(n, 1)).astype(np.float32)
+        f = np.sin(5 * x[:, 0])
+        y1 = f + 0.05 * rng.normal(size=n)
+        y2 = rho * f + 0.05 * rng.normal(size=n)
+        datas = []
+        for y in (y1, y2):
+            features = types.ContinuousAndCategorical(
+                continuous=types.PaddedArray.from_array(x, (n, 1)),
+                categorical=types.PaddedArray.from_array(
+                    np.zeros((n, 0), np.int32), (n, 0), fill_value=0
+                ),
+            )
+            labels = types.PaddedArray.from_array(
+                y[:, None].astype(np.float32), (n, 1), fill_value=np.nan
+            )
+            datas.append(
+                gp_lib.GPData.from_model_data(types.ModelData(features, labels))
+            )
+        return MultiTaskData.from_gp_datas(tuple(datas)), x, f
+
+    def test_training_improves_likelihood(self):
+        from vizier_tpu.models.multitask_gp import MultiTaskGaussianProcess
+        from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+
+        data, _, _ = self._multitask_data()
+        model = MultiTaskGaussianProcess(
+            num_continuous=1, num_categorical=0, num_tasks=2
+        )
+        coll = model.param_collection()
+        inits = coll.batch_random_init_unconstrained(jax.random.PRNGKey(0), 4)
+        loss_fn = lambda p: model.neg_log_likelihood(p, data)
+        init_losses = jax.vmap(loss_fn)(inits)
+        result = lbfgs_lib.AdamOptimizer(maxiter=60)(loss_fn, inits)
+        assert float(result.best_loss) < float(jnp.min(init_losses))
+
+    def test_cross_task_transfer(self):
+        """Task 2 observations should sharpen task 1 predictions."""
+        from vizier_tpu.models import kernels
+        from vizier_tpu.models.multitask_gp import (
+            MultiTaskData,
+            MultiTaskGaussianProcess,
+        )
+        from vizier_tpu import types
+        from vizier_tpu.models import gp as gp_lib
+
+        # Task 1: only 2 observations. Task 2 (perfectly correlated): dense.
+        rng = np.random.default_rng(1)
+        n = 16
+        x = np.linspace(0, 1, n).astype(np.float32)[:, None]
+        f = np.sin(5 * x[:, 0])
+
+        def mk(y, mask_rows):
+            features = types.ContinuousAndCategorical(
+                continuous=types.PaddedArray.from_array(x, (n, 1)),
+                categorical=types.PaddedArray.from_array(
+                    np.zeros((n, 0), np.int32), (n, 0), fill_value=0
+                ),
+            )
+            yy = np.where(mask_rows, y, np.nan)
+            labels = types.PaddedArray.from_array(
+                yy[:, None].astype(np.float32), (n, 1), fill_value=np.nan
+            )
+            return gp_lib.GPData.from_model_data(
+                types.ModelData(features, labels)
+            )
+
+        sparse_mask = np.zeros(n, dtype=bool)
+        sparse_mask[[0, n - 1]] = True
+        data = MultiTaskData.from_gp_datas(
+            (mk(f, sparse_mask), mk(f, np.ones(n, dtype=bool)))
+        )
+        model = MultiTaskGaussianProcess(
+            num_continuous=1, num_categorical=0, num_tasks=2
+        )
+        # Hand-set correlated task covariance and good kernel params.
+        coll = model.param_collection()
+        constrained = {
+            "amplitude": jnp.asarray(1.0),
+            "noise_stddev": jnp.asarray(0.05),
+            "continuous_length_scales": jnp.asarray([0.2]),
+            "task_chol_diag": jnp.asarray([1.0, 0.1]),
+            "task_chol_offdiag": jnp.asarray([1.0]),
+        }
+        state = model.precompute(coll.unconstrain(constrained), data)
+        query = kernels.MixedFeatures(
+            jnp.asarray([[0.5]], jnp.float32), jnp.zeros((1, 0), jnp.int32)
+        )
+        mean, stddev = state.predict(query)
+        # Task 1 mean at 0.5 should track f despite having no nearby task-1
+        # observation, thanks to the correlated task 2 data.
+        assert abs(float(mean[0, 0]) - np.sin(2.5)) < 0.4
+        assert mean.shape == (2, 1) and stddev.shape == (2, 1)
+
+
+class TestServiceThroughput:
+    """Parity with the reference performance_test.py: multi-client stress
+    at its configs (clients x trials), wall time logged, no assertions on
+    speed — only on correctness under concurrency."""
+
+    @pytest.mark.parametrize("num_clients,num_trials", [(1, 10), (2, 10), (10, 4)])
+    def test_stress(self, num_clients, num_trials):
+        import threading
+
+        from vizier_tpu.service import clients as clients_lib
+        from vizier_tpu.service import vizier_client
+
+        vizier_client._local_servicer = None
+        config = vz.StudyConfig(algorithm="RANDOM_SEARCH")
+        config.search_space.root.add_float_param("x", 0.0, 1.0)
+        config.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        study = clients_lib.Study.from_study_config(
+            config, owner="perf", study_id=f"stress-{num_clients}x{num_trials}"
+        )
+        errors = []
+
+        def worker(wid):
+            try:
+                for _ in range(num_trials):
+                    for t in study.suggest(count=1, client_id=f"w{wid}"):
+                        t.complete(vz.Measurement(metrics={"obj": 0.5}))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        start = time.time()
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(num_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.time() - start
+        assert not errors
+        trials = list(study.trials())
+        assert len(trials) == num_clients * num_trials
+        print(
+            f"\n[throughput] {num_clients} clients x {num_trials} trials: "
+            f"{elapsed:.2f}s ({len(trials) / elapsed:.0f} trials/s)"
+        )
+
+
+class TestClassification:
+    def _problem(self):
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", 0.0, 1.0)
+        p.metric_information.append(vz.MetricInformation(name="obj"))
+        return p
+
+    @pytest.mark.parametrize("kind", ["gp", "logistic"])
+    def test_learns_infeasible_region(self, kind):
+        from vizier_tpu.algorithms.classification import FeasibilityClassifier
+
+        problem = self._problem()
+        trials = []
+        rng = np.random.default_rng(0)
+        for i in range(40):
+            x = float(rng.uniform())
+            t = vz.Trial(id=i + 1, parameters={"x": x})
+            if x > 0.5:  # right half always fails
+                t.complete(infeasibility_reason="fail")
+            else:
+                t.complete(vz.Measurement(metrics={"obj": 1.0}))
+            trials.append(t)
+        clf = FeasibilityClassifier(problem, kind=kind).fit(trials)
+        probs = clf.predict_proba_feasible(
+            [
+                vz.TrialSuggestion(parameters={"x": 0.1}),
+                vz.TrialSuggestion(parameters={"x": 0.9}),
+            ]
+        )
+        assert probs[0] > 0.7 and probs[1] < 0.3
+
+    def test_all_feasible_constant(self):
+        from vizier_tpu.algorithms.classification import FeasibilityClassifier
+
+        problem = self._problem()
+        t = vz.Trial(id=1, parameters={"x": 0.5})
+        t.complete(vz.Measurement(metrics={"obj": 1.0}))
+        clf = FeasibilityClassifier(problem).fit([t])
+        assert clf.predict_proba_feasible(
+            [vz.TrialSuggestion(parameters={"x": 0.3})]
+        )[0] == 1.0
+
+
+class TestCurveRegression:
+    def test_power_law_extrapolation(self):
+        from vizier_tpu.algorithms.classification import TrialCurveRegressor
+
+        t = vz.Trial(id=1, parameters={})
+        # y = 0.9 - 0.5 * s^-0.5
+        for s in (1, 4, 16, 64):
+            t.measurements.append(
+                vz.Measurement(metrics={"acc": 0.9 - 0.5 * s**-0.5}, steps=s)
+            )
+        reg = TrialCurveRegressor("acc").fit(t)
+        assert reg is not None
+        assert abs(reg.predict(256) - (0.9 - 0.5 * 256**-0.5)) < 0.02
+        assert abs(reg.asymptote - 0.9) < 0.05
+
+    def test_too_few_points(self):
+        from vizier_tpu.algorithms.classification import TrialCurveRegressor
+
+        t = vz.Trial(id=1, parameters={})
+        t.measurements.append(vz.Measurement(metrics={"acc": 0.5}, steps=1))
+        assert TrialCurveRegressor("acc").fit(t) is None

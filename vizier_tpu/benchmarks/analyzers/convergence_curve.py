@@ -114,9 +114,7 @@ class LogEfficiencyConvergenceCurveComparator:
         base = self.baseline_curve
         if base.trend != compared.trend:
             raise ValueError(f"Trend mismatch: {base.trend} vs {compared.trend}.")
-        sign = 1.0 if base.trend == ConvergenceCurve.YTrend.INCREASING else -1.0
-        base_med = sign * base.percentile_curve(50.0)
-        comp_med = sign * compared.percentile_curve(50.0)
+        base_med, comp_med = _signed_median_curves(base, compared, align=False)
         # Objective threshold: final median of the baseline.
         target = base_med[-1]
         base_t = _first_index_reaching(base_med, target)
@@ -135,6 +133,22 @@ class LogEfficiencyConvergenceCurveComparator:
 def _first_index_reaching(values: np.ndarray, target: float) -> Optional[int]:
     hits = np.nonzero(values >= target - 1e-12)[0]
     return int(hits[0]) if len(hits) else None
+
+
+def _signed_median_curves(
+    base: ConvergenceCurve, compared: ConvergenceCurve, *, align: bool
+):
+    """Median curves of both, sign-flipped so bigger is always better.
+
+    ``align=True`` truncates both to the shorter length.
+    """
+    sign = 1.0 if base.trend == ConvergenceCurve.YTrend.INCREASING else -1.0
+    base_med = sign * base.percentile_curve(50.0)
+    comp_med = sign * compared.percentile_curve(50.0)
+    if align:
+        n = min(len(base_med), len(comp_med))
+        return base_med[:n], comp_med[:n]
+    return base_med, comp_med
 
 
 @dataclasses.dataclass
@@ -206,7 +220,12 @@ class HypervolumeCurveConverter:
         for t in trials:
             row = []
             for info in self._metrics:
-                if t.final_measurement and info.name in t.final_measurement.metrics:
+                usable = (
+                    t.final_measurement
+                    and not t.infeasible  # same invariant as MetricsEncoder
+                    and info.name in t.final_measurement.metrics
+                )
+                if usable:
                     v = t.final_measurement.metrics[info.name].value
                     row.append(-v if info.goal.is_minimize else v)
                 else:
@@ -241,9 +260,7 @@ class PercentageBetterComparator:
     baseline_curve: ConvergenceCurve
 
     def score(self, compared: ConvergenceCurve) -> float:
-        base = self.baseline_curve
-        sign = 1.0 if base.trend == ConvergenceCurve.YTrend.INCREASING else -1.0
-        n = min(base.ys.shape[1], compared.ys.shape[1])
-        base_med = sign * base.percentile_curve(50.0)[:n]
-        comp_med = sign * compared.percentile_curve(50.0)[:n]
+        base_med, comp_med = _signed_median_curves(
+            self.baseline_curve, compared, align=True
+        )
         return float(np.mean(comp_med > base_med))

@@ -80,6 +80,11 @@ class MixedFeatures(NamedTuple):
     categorical: Array  # [N, Ds] int
 
 
+# Route continuous-only cross-kernels through the fused Pallas TPU kernel
+# when the problem is big enough to pay off (set False to force jnp).
+_PALLAS_MIN_ELEMENTS = 128 * 128
+
+
 def matern52_ard(
     f1: MixedFeatures,
     f2: MixedFeatures,
@@ -90,7 +95,27 @@ def matern52_ard(
     continuous_dim_mask: Optional[Array] = None,
     categorical_dim_mask: Optional[Array] = None,
 ) -> Array:
-    """Full mixed-feature ARD Matern-5/2 kernel matrix [N, M]."""
+    """Full mixed-feature ARD Matern-5/2 kernel matrix [N, M].
+
+    On TPU backends, continuous-only kernels above ``_PALLAS_MIN_ELEMENTS``
+    output elements use the fused Pallas kernel (``ops.matern_pallas``) —
+    no [N, M, D] intermediate in HBM.
+    """
+    if (
+        f1.categorical.shape[-1] == 0
+        and f1.continuous.shape[0] * f2.continuous.shape[0] >= _PALLAS_MIN_ELEMENTS
+    ):
+        from vizier_tpu.ops import matern_pallas
+
+        if matern_pallas.is_tpu_backend():
+            inv = 1.0 / continuous_length_scales
+            if continuous_dim_mask is not None:
+                inv = jnp.where(continuous_dim_mask, inv, 0.0)
+            # custom-vjp wrapper: pallas forward, differentiable backward
+            # (the ARD likelihood takes gradients through this Gram).
+            return matern_pallas.matern52_ard_continuous_fused(
+                f1.continuous, f2.continuous, inv, amplitude
+            )
     sq = scaled_sq_distance_continuous(
         f1.continuous, f2.continuous, continuous_length_scales, dim_mask=continuous_dim_mask
     )

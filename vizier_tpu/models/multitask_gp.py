@@ -1,0 +1,170 @@
+"""Multi-task GP: a separable task kernel over correlated metrics.
+
+Parity with
+``/root/reference/vizier/_src/jax/models/multitask_tuned_gp_models.py``
+(``MultiTaskType``: INDEPENDENT / SEPARABLE task-kernel priors): the
+covariance factorizes as ``K((x,i),(x',j)) = k_x(x,x') · B[i,j]`` with
+``B = L Lᵀ + d·I`` Cholesky-parameterized. The joint Gram is the Kronecker
+product ``B ⊗ K_x`` over flattened (task-major) observations, mask-safe the
+same way as the single-task GP. INDEPENDENT multi-task is served by the
+per-metric vmapped training in ``designers.gp_bandit``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vizier_tpu.models import gp as gp_lib
+from vizier_tpu.models import kernels
+from vizier_tpu.models import params as params_lib
+
+Array = jax.Array
+_JITTER = 1e-5
+_LOG_2PI = 1.8378770664093453
+
+
+class MultiTaskType(enum.Enum):
+    INDEPENDENT = "INDEPENDENT"
+    SEPARABLE = "SEPARABLE"
+
+
+@flax.struct.dataclass
+class MultiTaskData:
+    """Shared features, per-task labels [M, N] with a joint mask."""
+
+    features_data: gp_lib.GPData  # labels field unused; masks/features shared
+    task_labels: Array  # [M, N]
+    task_mask: Array  # [M, N] bool (valid observation of task m at row n)
+
+    @classmethod
+    def from_gp_datas(cls, datas: Tuple[gp_lib.GPData, ...]) -> "MultiTaskData":
+        labels = jnp.stack([d.labels for d in datas])
+        masks = jnp.stack([d.row_mask for d in datas])
+        return cls(features_data=datas[0], task_labels=labels, task_mask=masks)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiTaskGaussianProcess:
+    """Separable multi-task GP over ``num_tasks`` correlated metrics."""
+
+    num_continuous: int
+    num_categorical: int
+    num_tasks: int
+
+    def _base(self) -> gp_lib.VizierGaussianProcess:
+        return gp_lib.VizierGaussianProcess(
+            num_continuous=self.num_continuous, num_categorical=self.num_categorical
+        )
+
+    def param_collection(self) -> params_lib.ParameterCollection:
+        specs = list(self._base().param_collection().specs)
+        m = self.num_tasks
+        # Task covariance: lower-triangular factor entries, soft-clipped to
+        # keep B well-scaled; diagonal entries strictly positive.
+        specs.append(
+            params_lib.ParameterSpec(
+                "task_chol_diag", (m,), params_lib.SoftClip(0.05, 5.0), 0.3, 2.0
+            )
+        )
+        if m > 1:
+            ntril = m * (m - 1) // 2
+            # Off-diagonal factor magnitudes (sign handled via two halves is
+            # unnecessary for PSD B; positive couplings cover the common
+            # "metrics agree" case and keep the single-pytree machinery).
+            specs.append(
+                params_lib.ParameterSpec(
+                    "task_chol_offdiag", (ntril,), params_lib.SoftClip(1e-3, 5.0),
+                    0.01, 0.5,
+                )
+            )
+        return params_lib.ParameterCollection(tuple(specs))
+
+    def _task_cov(self, p: params_lib.Params) -> Array:
+        m = self.num_tasks
+        chol = jnp.diag(p["task_chol_diag"])
+        if m > 1:
+            rows, cols = jnp.tril_indices(m, k=-1)
+            chol = chol.at[rows, cols].set(p["task_chol_offdiag"])
+        return chol @ chol.T + 1e-6 * jnp.eye(m)
+
+    def _joint_gram(self, p: params_lib.Params, data: MultiTaskData) -> Array:
+        base = self._base()
+        fd = data.features_data
+        kx = base._kernel(p, fd.features(), fd.features(), fd)  # [N, N]
+        b = self._task_cov(p)  # [M, M]
+        gram = jnp.kron(b, kx)  # [MN, MN], task-major
+        mask = data.task_mask.reshape(-1)  # [MN]
+        pair = mask[:, None] & mask[None, :]
+        gram = jnp.where(pair, gram, 0.0)
+        noise = p["noise_stddev"] * p["noise_stddev"] + _JITTER
+        return gram + jnp.diag(jnp.where(mask, noise, 1.0))
+
+    def neg_log_likelihood(
+        self, unconstrained: params_lib.Params, data: MultiTaskData
+    ) -> Array:
+        coll = self.param_collection()
+        p = coll.constrain(unconstrained)
+        gram = self._joint_gram(p, data)
+        y = jnp.where(data.task_mask, data.task_labels, 0.0).reshape(-1)
+        chol = jnp.linalg.cholesky(gram)
+        alpha = jax.scipy.linalg.cho_solve((chol, True), y)
+        mask = data.task_mask.reshape(-1)
+        n_valid = jnp.sum(mask.astype(jnp.float32))
+        nll = (
+            0.5 * jnp.dot(y, alpha)
+            + jnp.sum(jnp.where(mask, jnp.log(jnp.diagonal(chol)), 0.0))
+            + 0.5 * n_valid * _LOG_2PI
+        )
+        loss = nll + coll.regularization(p)
+        return jnp.where(jnp.isfinite(loss), loss, jnp.asarray(1e10, loss.dtype))
+
+    def precompute(
+        self, unconstrained: params_lib.Params, data: MultiTaskData
+    ) -> "MultiTaskGPState":
+        p = self.param_collection().constrain(unconstrained)
+        gram = self._joint_gram(p, data)
+        y = jnp.where(data.task_mask, data.task_labels, 0.0).reshape(-1)
+        chol = jnp.linalg.cholesky(gram)
+        alpha = jax.scipy.linalg.cho_solve((chol, True), y)
+        return MultiTaskGPState(
+            model=self, params=p, data=data, chol=chol, alpha=alpha
+        )
+
+
+@flax.struct.dataclass
+class MultiTaskGPState:
+    model: MultiTaskGaussianProcess = flax.struct.field(pytree_node=False)
+    params: params_lib.Params
+    data: MultiTaskData
+    chol: Array  # [MN, MN]
+    alpha: Array  # [MN]
+
+    def predict(self, query: kernels.MixedFeatures) -> Tuple[Array, Array]:
+        """Posterior per task: mean [M, Q], stddev [M, Q]."""
+        model, p, data = self.model, self.params, self.data
+        base = model._base()
+        fd = data.features_data
+        kx_star = base._kernel(p, query, fd.features(), fd)  # [Q, N]
+        b = model._task_cov(p)  # [M, M]
+        # Cross-covariance of task m at query q with all (task, row) obs:
+        # kron(b[m], kx_star[q]) → build [M, Q, M*N].
+        k_star = jnp.einsum("mt,qn->mqtn", b, kx_star).reshape(
+            model.num_tasks, query.continuous.shape[0], -1
+        )
+        mask = data.task_mask.reshape(-1)
+        k_star = jnp.where(mask[None, None, :], k_star, 0.0)
+        mean = k_star @ self.alpha  # [M, Q]
+        flat = k_star.reshape(-1, k_star.shape[-1])  # [MQ, MN]
+        v = jax.scipy.linalg.solve_triangular(self.chol, flat.T, lower=True)
+        prior_var = (
+            p["amplitude"] * p["amplitude"] * jnp.diag(b)[:, None]
+        )  # [M, 1]
+        var = prior_var - jnp.sum(v * v, axis=0).reshape(mean.shape)
+        return mean, jnp.sqrt(jnp.maximum(var, 1e-12))

@@ -86,6 +86,11 @@ class DesignerAsOptimizer:
         from vizier_tpu.pyvizier import trial as trial_
 
         designer = self.designer_factory(problem)
+        # Feed scores back under the problem's own objective metric name so
+        # model-based designers actually see the labels.
+        metric_name = next(
+            m.name for m in problem.metric_information if not m.is_safety_metric
+        )
         scored = []
         next_id = 1
         for _ in range(self.num_rounds):
@@ -98,7 +103,7 @@ class DesignerAsOptimizer:
                 t = s.to_trial(next_id)
                 next_id += 1
                 t.complete(
-                    trial_.Measurement(metrics={"acquisition": float(v)})
+                    trial_.Measurement(metrics={metric_name: float(v)})
                 )
                 completed.append(t)
                 scored.append((float(v), s))

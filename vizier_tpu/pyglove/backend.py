@@ -32,6 +32,19 @@ except ImportError:  # pragma: no cover
 _DNA_KEY = "dna_spec_values"
 _NS = "pyglove"
 
+# Global registry study_name -> (dna_spec, generator). The PRIMARY tuner
+# registers its generator here so the in-process policy factory can host it
+# (parity with the reference's global policy cache, ``backend.py:66``).
+_GENERATOR_REGISTRY: Dict[str, tuple] = {}
+
+
+def register_generator(study_name: str, dna_spec, algorithm) -> None:
+    _GENERATOR_REGISTRY[study_name] = (dna_spec, algorithm)
+
+
+def get_registered_generator(study_name: str):
+    return _GENERATOR_REGISTRY.get(study_name)
+
 
 class DNATrialConverter:
     """Serialized-DNA ⇄ trial converters (pure; no pyglove required).
@@ -77,15 +90,16 @@ class TunerPolicy(policy_lib.Policy):
         return True
 
     def suggest(self, request: policy_lib.SuggestRequest) -> policy_lib.SuggestDecision:
-        # Feed newly-completed trials back into the generator.
+        # Feed newly-completed FEASIBLE trials back into the generator.
         completed = self._supporter.GetTrials(status_matches=vz.TrialStatus.COMPLETED)
         for t in completed:
-            if t.id in self._fed_ids or t.final_measurement is None:
+            if t.id in self._fed_ids or t.final_measurement is None or t.infeasible:
                 continue
             decisions = DNATrialConverter.to_decisions(t)
             dna = pg.DNA(decisions)  # type: ignore[union-attr]
             dna.use_spec(self._dna_spec)
-            metric = next(iter(t.final_measurement.metrics.values()))
+            metrics = t.final_measurement.metrics
+            metric = metrics.get("reward") or next(iter(metrics.values()))
             self._algorithm.feedback(dna, metric.value)
             self._fed_ids.add(t.id)
         suggestions = []
@@ -129,6 +143,9 @@ class VizierBackend:
         )
         self._dna_spec = dna_spec
         self._algorithm = algorithm
+        if dna_spec is not None and algorithm is not None:
+            # PRIMARY tuner: host the generator for the policy factory.
+            register_generator(self._study.resource_name, dna_spec, algorithm)
 
     def next_trial(self):
         (trial,) = self._study.suggest(count=1)

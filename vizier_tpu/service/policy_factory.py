@@ -95,4 +95,16 @@ class DefaultPolicyFactory:
             return designer_policy.DesignerPolicy(
                 policy_supporter, lambda p, **kw: harmonica.HarmonicaDesigner(p)
             )
+        if algorithm == "PYGLOVE":
+            from vizier_tpu.pyglove import backend as pyglove_backend
+
+            registered = pyglove_backend.get_registered_generator(study_name)
+            if registered is None:
+                raise ValueError(
+                    f"No PyGlove generator registered for study {study_name!r}; "
+                    "construct VizierBackend with dna_spec and algorithm in the "
+                    "primary tuner process first."
+                )
+            dna_spec, generator = registered
+            return pyglove_backend.TunerPolicy(policy_supporter, dna_spec, generator)
         raise ValueError(f"Unknown algorithm: {algorithm!r}")
