@@ -21,6 +21,8 @@ import numpy as np
 
 
 def main() -> None:
+    import os
+
     import jax
 
     from vizier_tpu import types
@@ -33,9 +35,14 @@ def main() -> None:
     from vizier_tpu.optimizers import vectorized as vectorized_lib
     from vizier_tpu.designers.gp_bandit import _maximize_acquisition, _train_gp
 
-    num_trials, dim = 1000, 20
-    n_pad = 1024  # next power-of-2 padding bucket
+    # SCALE < 1 shrinks the problem for smoke-testing on CPU; the driver
+    # runs the full-size benchmark (SCALE unset) on TPU.
+    scale = float(os.environ.get("VIZIER_BENCH_SCALE", "1.0"))
+    num_trials, dim = max(int(1000 * scale), 16), 20
+    n_pad = 1 << (num_trials - 1).bit_length()  # next power-of-2 bucket
     batch_count = 25  # suggestion batch (reference default batch)
+    max_evals = max(int(75_000 * scale), 500)
+    repeats = 5 if scale >= 1.0 else 2
 
     rng = np.random.default_rng(0)
     x = rng.uniform(size=(num_trials, dim)).astype(np.float32)
@@ -56,7 +63,7 @@ def main() -> None:
     model = gp_lib.VizierGaussianProcess(num_continuous=dim, num_categorical=0)
     ard = lbfgs_lib.LbfgsOptimizer(maxiter=50)
     strategy = eagle_lib.VectorizedEagleStrategy(num_continuous=dim, category_sizes=())
-    vec_opt = vectorized_lib.VectorizedOptimizer(strategy, max_evaluations=75_000)
+    vec_opt = vectorized_lib.VectorizedOptimizer(strategy, max_evaluations=max_evals)
 
     def one_suggest(seed: int):
         key = jax.random.PRNGKey(seed)
@@ -81,17 +88,22 @@ def main() -> None:
 
     one_suggest(0)  # compile
     times = []
-    for i in range(1, 6):
+    for i in range(1, repeats + 1):
         t0 = time.perf_counter()
         one_suggest(i)
         times.append((time.perf_counter() - t0) * 1000.0)
     p50 = float(np.percentile(times, 50))
 
     target_ms = 1000.0
+    if scale >= 1.0:
+        # Stable id for longitudinal tracking across rounds.
+        metric = "gp_ucb_suggest_p50@1000x20d_75k_evals"
+    else:
+        metric = f"gp_ucb_suggest_p50@{num_trials}x{dim}d_{max_evals}evals_SMOKE"
     print(
         json.dumps(
             {
-                "metric": "gp_ucb_suggest_p50@1000x20d_75k_evals",
+                "metric": metric,
                 "value": round(p50, 1),
                 "unit": "ms",
                 "vs_baseline": round(target_ms / p50, 3),

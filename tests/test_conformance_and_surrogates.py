@@ -1,0 +1,80 @@
+"""Client-ABC conformance (run against the OSS client) + surrogate tests."""
+
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.client.client_abc_testing import StudyConformance
+from vizier_tpu.service import clients as clients_lib
+from vizier_tpu.service import vizier_client
+
+
+class TestOSSClientConformance(StudyConformance):
+    """The shipped service client must pass the full behavioral contract."""
+
+    def setup_method(self):
+        vizier_client._local_servicer = None
+
+    def create_study(self, problem, study_id):
+        config = vz.StudyConfig.from_problem(problem, vz.Algorithm.RANDOM_SEARCH)
+        return clients_lib.Study.from_study_config(
+            config, owner="conformance", study_id=study_id
+        )
+
+
+class TestTabularSurrogate:
+    def _experimenter(self):
+        from vizier_tpu.benchmarks.experimenters.surrogates import (
+            TabularSurrogateExperimenter,
+        )
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 1.0)
+        problem.search_space.root.add_categorical_param("op", ["a", "b"])
+        problem.metric_information.append(
+            vz.MetricInformation(name="objective", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        rows = [
+            {"x": 0.0, "op": "a"},
+            {"x": 1.0, "op": "a"},
+            {"x": 0.5, "op": "b"},
+        ]
+        return TabularSurrogateExperimenter(problem, rows, [0.1, 0.9, 0.5])
+
+    def test_exact_lookup(self):
+        exp = self._experimenter()
+        t = vz.Trial(id=1, parameters={"x": 1.0, "op": "a"})
+        exp.evaluate([t])
+        assert t.final_measurement.metrics["objective"].value == 0.9
+
+    def test_nearest_snap(self):
+        exp = self._experimenter()
+        t = vz.Trial(id=1, parameters={"x": 0.93, "op": "a"})
+        exp.evaluate([t])
+        assert t.final_measurement.metrics["objective"].value == 0.9
+
+    def test_handlers_require_data(self):
+        from vizier_tpu.benchmarks.experimenters.surrogates import (
+            HPOBHandler,
+            NASBench201Handler,
+        )
+
+        with pytest.raises(FileNotFoundError):
+            HPOBHandler(root_dir=None).make_experimenter("ss", "ds")
+        with pytest.raises(FileNotFoundError):
+            NASBench201Handler().make_experimenter()
+        # The NASBench problem shell itself works without data.
+        problem = NASBench201Handler().problem_statement()
+        assert problem.search_space.num_parameters() == 6
+
+
+class TestYeoJohnson:
+    def test_gaussianizes_skew(self):
+        from scipy import stats
+
+        from vizier_tpu.models.output_warpers import YeoJohnsonWarper
+
+        rng = np.random.default_rng(0)
+        y = np.exp(rng.normal(size=300))
+        warped = YeoJohnsonWarper()(y)
+        assert abs(stats.skew(warped)) < abs(stats.skew(y)) / 3

@@ -1,0 +1,119 @@
+"""Client-ABC conformance suite.
+
+Parity with ``/root/reference/vizier/client/client_abc_testing.py:48``: a
+behavioral test mixin any ``StudyInterface`` implementation (this OSS
+service, a cloud client, an in-RAM fake) must pass. Subclasses implement
+``create_study(problem, study_id)``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import TypeVar
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.client import client_abc
+
+_S = TypeVar("_S", bound=client_abc.StudyInterface)
+
+
+class StudyConformance(abc.ABC):
+    """Mixin of behavioral tests over the StudyInterface contract."""
+
+    @abc.abstractmethod
+    def create_study(self, problem: vz.ProblemStatement, study_id: str) -> _S:
+        ...
+
+    def _problem(self) -> vz.ProblemStatement:
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 1.0)
+        problem.search_space.root.add_categorical_param("c", ["a", "b"])
+        problem.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        return problem
+
+    # -- suggest / complete --------------------------------------------------
+
+    def test_suggest_returns_count(self):
+        study = self.create_study(self._problem(), "conf-suggest")
+        trials = study.suggest(count=3)
+        assert len(trials) == 3
+        assert all(t.status == vz.TrialStatus.ACTIVE for t in trials)
+
+    def test_complete_and_materialize(self):
+        study = self.create_study(self._problem(), "conf-complete")
+        (trial,) = study.suggest(count=1)
+        final = trial.complete(vz.Measurement(metrics={"obj": 0.7}))
+        assert final.metrics["obj"].value == 0.7
+        materialized = trial.materialize()
+        assert materialized.status == vz.TrialStatus.COMPLETED
+
+    def test_parameters_external_types(self):
+        study = self.create_study(self._problem(), "conf-params")
+        (trial,) = study.suggest(count=1)
+        params = trial.parameters
+        assert isinstance(params["x"], float)
+        assert params["c"] in ("a", "b")
+
+    def test_infeasible_completion(self):
+        study = self.create_study(self._problem(), "conf-infeasible")
+        (trial,) = study.suggest(count=1)
+        trial.complete(infeasible_reason="broke")
+        assert trial.materialize().infeasible
+
+    def test_intermediate_measurements(self):
+        study = self.create_study(self._problem(), "conf-measure")
+        (trial,) = study.suggest(count=1)
+        trial.add_measurement(vz.Measurement(metrics={"obj": 0.1}, steps=1))
+        trial.add_measurement(vz.Measurement(metrics={"obj": 0.2}, steps=2))
+        assert len(trial.materialize().measurements) == 2
+
+    # -- listing / filtering -------------------------------------------------
+
+    def test_trials_listing_and_filter(self):
+        study = self.create_study(self._problem(), "conf-list")
+        a, b = study.suggest(count=2)
+        a.complete(vz.Measurement(metrics={"obj": 1.0}))
+        completed = list(study.trials(vz.TrialFilter(status=[vz.TrialStatus.COMPLETED])))
+        assert [t.id for t in completed] == [a.id]
+        assert len(list(study.trials())) == 2
+
+    def test_get_trial_and_missing(self):
+        study = self.create_study(self._problem(), "conf-get")
+        (trial,) = study.suggest(count=1)
+        assert study.get_trial(trial.id).id == trial.id
+        try:
+            study.get_trial(424242)
+        except client_abc.ResourceNotFoundError:
+            pass
+        else:  # pragma: no cover
+            raise AssertionError("Expected ResourceNotFoundError.")
+
+    def test_optimal_trials(self):
+        study = self.create_study(self._problem(), "conf-optimal")
+        values = [0.2, 0.9, 0.5]
+        for trial, v in zip(study.suggest(count=3), values):
+            trial.complete(vz.Measurement(metrics={"obj": v}))
+        (best,) = study.optimal_trials()
+        assert best.materialize().final_measurement.metrics["obj"].value == 0.9
+
+    # -- study-level ----------------------------------------------------------
+
+    def test_materialize_study_config(self):
+        study = self.create_study(self._problem(), "conf-config")
+        config = study.materialize_study_config()
+        assert set(config.search_space.parameter_names()) == {"x", "c"}
+
+    def test_metadata_roundtrip(self):
+        study = self.create_study(self._problem(), "conf-md")
+        md = vz.Metadata()
+        md.ns("user")["note"] = "hello"
+        study.update_metadata(md)
+        assert study.materialize_study_config().metadata.ns("user")["note"] == "hello"
+
+    def test_delete_trial(self):
+        study = self.create_study(self._problem(), "conf-del")
+        a, b = study.suggest(count=2)
+        a.delete()
+        assert [t.id for t in study.trials()] == [b.id]

@@ -83,14 +83,26 @@ class DesignerAsOptimizer:
         count: int = 1,
     ):
         from vizier_tpu.algorithms import core as core_lib
+        from vizier_tpu.pyvizier import base_study_config
         from vizier_tpu.pyvizier import trial as trial_
 
-        designer = self.designer_factory(problem)
-        # Feed scores back under the problem's own objective metric name so
-        # model-based designers actually see the labels.
-        metric_name = next(
-            m.name for m in problem.metric_information if not m.is_safety_metric
+        # The designer optimizes a synthetic always-MAXIMIZE acquisition
+        # metric over the caller's search space — the caller's own metric
+        # goals must not flip the acquisition's sign.
+        metric_name = "acquisition"
+        inner_problem = base_study_config.ProblemStatement(
+            search_space=problem.search_space,
+            metric_information=base_study_config.MetricsConfig(
+                [
+                    base_study_config.MetricInformation(
+                        name=metric_name,
+                        goal=base_study_config.ObjectiveMetricGoal.MAXIMIZE,
+                    )
+                ]
+            ),
         )
+        designer = self.designer_factory(inner_problem)
+        del problem  # everything below uses inner_problem's metric
         scored = []
         next_id = 1
         for _ in range(self.num_rounds):
