@@ -95,11 +95,11 @@ def main() -> None:
     p50 = float(np.percentile(times, 50))
 
     target_ms = 1000.0
-    if scale >= 1.0:
+    if scale == 1.0:
         # Stable id for longitudinal tracking across rounds.
         metric = "gp_ucb_suggest_p50@1000x20d_75k_evals"
     else:
-        metric = f"gp_ucb_suggest_p50@{num_trials}x{dim}d_{max_evals}evals_SMOKE"
+        metric = f"gp_ucb_suggest_p50@{num_trials}x{dim}d_{max_evals}evals_scaled"
     print(
         json.dumps(
             {

@@ -1,0 +1,86 @@
+"""Tests for spatio-temporal converters and the cross-problem scaler."""
+
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.converters.embedder import ProblemAndTrialsScaler
+from vizier_tpu.converters.spatio_temporal import (
+    SparseSpatioTemporalConverter,
+    TimedLabelsExtractor,
+)
+
+
+def _metrics():
+    return vz.MetricsConfig([vz.MetricInformation(name="acc")])
+
+
+class TestTimedLabels:
+    def _trial_with_curve(self, steps_values):
+        t = vz.Trial(id=1, parameters={})
+        for s, v in steps_values:
+            t.measurements.append(vz.Measurement(metrics={"acc": v}, steps=s))
+        return t
+
+    def test_extract(self):
+        extractor = TimedLabelsExtractor(_metrics())
+        curve = extractor.convert_trial(
+            self._trial_with_curve([(1, 0.1), (2, 0.2), (4, 0.4)])
+        )
+        np.testing.assert_array_equal(curve.positions, [1, 2, 4])
+        np.testing.assert_allclose(curve.values[:, 0], [0.1, 0.2, 0.4])
+
+    def test_missing_metric_is_nan(self):
+        extractor = TimedLabelsExtractor(_metrics())
+        t = vz.Trial(id=1, parameters={})
+        t.measurements.append(vz.Measurement(metrics={"other": 1.0}, steps=1))
+        curve = extractor.convert_trial(t)
+        assert np.isnan(curve.values[0, 0])
+
+    def test_aligned_grid_carry_forward(self):
+        converter = SparseSpatioTemporalConverter(TimedLabelsExtractor(_metrics()))
+        a = self._trial_with_curve([(1, 0.1), (3, 0.3)])
+        b = self._trial_with_curve([(2, 0.5)])
+        values, mask, grid = converter.to_arrays([a, b])
+        np.testing.assert_array_equal(grid, [1, 2, 3])
+        # Trial a: carries 0.1 forward at step 2.
+        np.testing.assert_allclose(values[0, :, 0], [0.1, 0.1, 0.3])
+        # Trial b starts at step 2; step 1 is masked out.
+        assert not mask[1, 0] and mask[1, 1]
+        np.testing.assert_allclose(values[1, 1:, 0], [0.5, 0.5])
+
+
+class TestProblemAndTrialsScaler:
+    def test_maps_prior_trials(self):
+        current = vz.ProblemStatement()
+        root = current.search_space.root
+        root.add_float_param("lr", 1e-4, 1e-2, scale_type=vz.ScaleType.LOG)
+        root.add_int_param("layers", 1, 4)
+        root.add_categorical_param("opt", ["adam", "sgd"])
+        current.metric_information.append(vz.MetricInformation(name="acc"))
+
+        # Prior trial from a wider/looser space with an extra param and an
+        # unknown category.
+        prior = vz.Trial(
+            id=7,
+            parameters={"lr": 0.5, "layers": 9, "opt": "rmsprop", "extra": 3},
+        )
+        prior.complete(vz.Measurement(metrics={"acc": 0.8}))
+        scaler = ProblemAndTrialsScaler(current)
+        (mapped,) = scaler.map_trials([prior])
+        assert mapped.parameters.get_value("lr") == pytest.approx(1e-2)  # clipped
+        assert mapped.parameters.get_value("layers") == 4  # clipped
+        assert mapped.parameters.get_value("opt") == "adam"  # unknown -> default
+        assert "extra" not in mapped.parameters
+        assert current.search_space.contains(mapped.parameters)
+        assert mapped.final_measurement.metrics["acc"].value == 0.8
+
+    def test_missing_param_takes_default(self):
+        current = vz.ProblemStatement()
+        current.search_space.root.add_float_param("x", 0.0, 1.0)
+        current.search_space.root.add_float_param("y", 0.0, 1.0, default_value=0.25)
+        current.metric_information.append(vz.MetricInformation(name="m"))
+        prior = vz.Trial(id=1, parameters={"x": 0.5})
+        prior.complete(vz.Measurement(metrics={"m": 1.0}))
+        (mapped,) = ProblemAndTrialsScaler(current).map_trials([prior])
+        assert mapped.parameters.get_value("y") == 0.25

@@ -78,3 +78,44 @@ class TestYeoJohnson:
         y = np.exp(rng.normal(size=300))
         warped = YeoJohnsonWarper()(y)
         assert abs(stats.skew(warped)) < abs(stats.skew(y)) / 3
+
+
+class TestSurrogateRegressions:
+    """Regressions from the ninth code review."""
+
+    def test_categorical_mismatch_is_disqualifying(self):
+        from vizier_tpu.benchmarks.experimenters.surrogates import (
+            TabularSurrogateExperimenter,
+        )
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 10.0)
+        problem.search_space.root.add_categorical_param("op", ["a", "b"])
+        problem.metric_information.append(vz.MetricInformation(name="objective"))
+        # Row with op='a' is numerically distant; op='b' rows don't exist
+        # near x=0 — the exact-category row must still win.
+        rows = [{"x": 9.0, "op": "a"}, {"x": 0.1, "op": "b"}]
+        exp = TabularSurrogateExperimenter(problem, rows, [0.9, 0.1])
+        t = vz.Trial(id=1, parameters={"x": 0.0, "op": "a"})
+        exp.evaluate([t])
+        assert t.final_measurement.metrics["objective"].value == 0.9
+
+    def test_unknown_categorical_combo_infeasible(self):
+        from vizier_tpu.benchmarks.experimenters.surrogates import (
+            TabularSurrogateExperimenter,
+        )
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_categorical_param("op", ["a", "b"])
+        problem.metric_information.append(vz.MetricInformation(name="objective"))
+        exp = TabularSurrogateExperimenter(problem, [{"op": "a"}], [1.0])
+        t = vz.Trial(id=1, parameters={"op": "b"})
+        exp.evaluate([t])
+        assert t.infeasible
+
+    def test_hpob_mode_filenames(self):
+        from vizier_tpu.benchmarks.experimenters.surrogates import HPOBHandler
+
+        assert HPOBHandler._MODE_FILES["v3-test"] == "meta-test-dataset.json"
+        with pytest.raises(ValueError, match="Unknown HPO-B mode"):
+            HPOBHandler(root_dir="/tmp", mode="bogus").make_experimenter("s", "d")

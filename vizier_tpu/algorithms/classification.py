@@ -44,6 +44,13 @@ class FeasibilityClassifier:
             self._model = None
             return self
         self._constant = None
+        try:
+            import sklearn  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "FeasibilityClassifier needs scikit-learn; install the "
+                "'vizier-tpu[classifiers]' extra."
+            ) from e
         if self.kind == "gp":
             from sklearn.gaussian_process import GaussianProcessClassifier
             from sklearn.gaussian_process.kernels import Matern

@@ -27,9 +27,10 @@ class TabularSurrogateExperimenter(base.Experimenter):
     """Nearest-neighbor lookup over a finite table of evaluated configs.
 
     ``rows``: list of {param_name: value}; ``objectives``: [N] values.
-    Evaluation snaps a suggestion to the nearest tabulated config (exact
-    match for categoricals, nearest scaled L2 for numerics) — the standard
-    way NAS/HPO tabular benchmarks are served.
+    Evaluation snaps a suggestion to the nearest tabulated config: exact
+    match REQUIRED for categoricals (no tabulated row with the suggested
+    categorical combination ⇒ infeasible), nearest scaled L2 for numerics —
+    the standard way NAS/HPO tabular benchmarks are served.
     """
 
     def __init__(
@@ -49,20 +50,30 @@ class TabularSurrogateExperimenter(base.Experimenter):
         self._objectives = np.asarray(objectives, dtype=np.float64)
         from vizier_tpu.converters import core as converters
 
-        self._converter = converters.TrialToArrayConverter.from_study_config(problem)
+        self._enc = converters.SearchSpaceEncoder(problem.search_space)
         table_trials = [trial_.Trial(id=i + 1, parameters=r) for i, r in enumerate(rows)]
-        self._table = self._converter.to_features(table_trials)
+        self._table_cont, self._table_cat = self._enc.encode(table_trials)
 
     def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
         if not suggestions:
             return
-        feats = self._converter.to_features(suggestions)
-        # Nearest row in one-hot/scaled space.
-        d = np.sum(
-            (feats[:, None, :] - self._table[None, :, :]) ** 2, axis=-1
-        )
+        cont, cat = self._enc.encode(suggestions)
+        # Continuous distance via the |a|²-2ab+|b|² expansion (no [M,N,D]
+        # intermediate); categorical mismatches are disqualifying.
+        a2 = np.sum(cont**2, axis=1, keepdims=True)
+        b2 = np.sum(self._table_cont**2, axis=1, keepdims=True).T
+        d = np.maximum(a2 + b2 - 2.0 * cont @ self._table_cont.T, 0.0)
+        if self._enc.num_categorical:
+            mismatch = (cat[:, None, :] != self._table_cat[None, :, :]).any(axis=-1)
+            d = np.where(mismatch, np.inf, d)
         nearest = d.argmin(axis=1)
-        for t, idx in zip(suggestions, nearest):
+        for t, idx, row in zip(suggestions, nearest, d):
+            if not np.isfinite(row[idx]):
+                t.complete(
+                    infeasibility_reason="No tabulated config with this "
+                    "categorical combination."
+                )
+                continue
             t.complete(
                 trial_.Measurement(
                     metrics={self._metric: float(self._objectives[idx])}
@@ -93,12 +104,23 @@ class HPOBHandler:
     root_dir: Optional[str] = None
     mode: str = "v3-test"
 
+    # Public HPO-B dump filenames by mode (the dataset ships these names).
+    _MODE_FILES = {
+        "v3-test": "meta-test-dataset.json",
+        "v3-train": "meta-train-dataset.json",
+        "v3-validation": "meta-validation-dataset.json",
+    }
+
     def make_experimenter(
         self, search_space_id: str, dataset_id: str
     ) -> base.Experimenter:
+        filename = self._MODE_FILES.get(self.mode)
+        if filename is None:
+            raise ValueError(
+                f"Unknown HPO-B mode {self.mode!r}; choices: {sorted(self._MODE_FILES)}"
+            )
         path = _require_file(
-            self.root_dir and os.path.join(self.root_dir, f"meta-{self.mode}.json"),
-            "HPO-B",
+            self.root_dir and os.path.join(self.root_dir, filename), "HPO-B"
         )
         with open(path) as f:
             data = json.load(f)

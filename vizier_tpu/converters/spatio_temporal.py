@@ -1,0 +1,92 @@
+"""Spatio-temporal converters: per-step measurement curves → arrays.
+
+Parity with ``/root/reference/vizier/pyvizier/converters/spatio_temporal.py``
+(``:234,341``): early-stopping and curve-extrapolation models consume
+``[num_trials, num_steps]`` label matrices aligned on a common step grid;
+this module extracts and aligns intermediate measurements.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+
+@dataclasses.dataclass
+class TimedLabels:
+    """One trial's curve: positions [T] and values [T, M]."""
+
+    positions: np.ndarray
+    values: np.ndarray
+
+
+@dataclasses.dataclass
+class TimedLabelsExtractor:
+    """Extracts per-trial measurement curves for the configured metrics."""
+
+    metrics: base_study_config.MetricsConfig
+    use_steps: bool = True
+
+    def convert_trial(self, trial: trial_.Trial) -> TimedLabels:
+        names = [m.name for m in self.metrics]
+        positions: List[float] = []
+        rows: List[List[float]] = []
+        for m in trial.measurements:
+            positions.append(m.steps if self.use_steps else m.elapsed_secs)
+            rows.append(
+                [
+                    m.metrics[n].value if n in m.metrics else np.nan
+                    for n in names
+                ]
+            )
+        return TimedLabels(
+            positions=np.asarray(positions, dtype=np.float64),
+            values=np.asarray(rows, dtype=np.float64).reshape(len(rows), len(names)),
+        )
+
+    def convert(self, trials: Sequence[trial_.Trial]) -> List[TimedLabels]:
+        return [self.convert_trial(t) for t in trials]
+
+
+@dataclasses.dataclass
+class SparseSpatioTemporalConverter:
+    """Aligns trial curves onto a common step grid → [N, T, M] with a mask.
+
+    Values are carried forward from the last reported position (the usual
+    convention for training-curve models); the mask marks grid points at or
+    beyond each trial's first measurement.
+    """
+
+    extractor: TimedLabelsExtractor
+
+    def to_arrays(
+        self, trials: Sequence[trial_.Trial], *, grid: Optional[np.ndarray] = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        curves = self.extractor.convert(trials)
+        if grid is None:
+            all_positions = np.concatenate(
+                [c.positions for c in curves if len(c.positions)] or [np.zeros(0)]
+            )
+            grid = np.unique(all_positions)
+        n, t = len(trials), len(grid)
+        m = len(self.extractor.metrics)
+        values = np.full((n, t, m), np.nan)
+        mask = np.zeros((n, t), dtype=bool)
+        for i, c in enumerate(curves):
+            if not len(c.positions):
+                continue
+            order = np.argsort(c.positions)
+            pos, val = c.positions[order], c.values[order]
+            idx = np.searchsorted(pos, grid, side="right") - 1
+            valid = idx >= 0
+            mask[i] = valid & (grid <= pos[-1] + 1e-12) | (valid & (grid >= pos[0]))
+            safe = np.clip(idx, 0, len(pos) - 1)
+            values[i] = val[safe]
+            values[i, ~valid] = np.nan
+            mask[i] = valid
+        return values, mask, grid
