@@ -82,9 +82,12 @@ class TestGPConvergenceGates:
 class TestMultichipEntry:
     def test_dryrun_multichip_on_virtual_mesh(self):
         """The driver's multi-chip dry run must keep working (8 CPU devices)."""
+        import os
         import sys
 
-        sys.path.insert(0, "/root/repo")
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
         from __graft_entry__ import dryrun_multichip, entry
 
         dryrun_multichip(8)

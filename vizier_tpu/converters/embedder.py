@@ -27,15 +27,20 @@ class ProblemAndTrialsScaler:
     problem: base_study_config.ProblemStatement
 
     def _snap(self, config: pc.ParameterConfig, value) -> pc.ParameterValueTypes:
-        if config.type == pc.ParameterType.DOUBLE:
-            lo, hi = config.bounds
-            return float(np.clip(float(value), lo, hi))
-        if config.type == pc.ParameterType.INTEGER:
-            lo, hi = config.bounds
-            return int(np.clip(int(round(float(value))), int(lo), int(hi)))
-        if config.type == pc.ParameterType.DISCRETE:
-            values = np.asarray([float(v) for v in config.feasible_values])
-            return float(values[np.abs(values - float(value)).argmin()])
+        try:
+            if config.type == pc.ParameterType.DOUBLE:
+                lo, hi = config.bounds
+                return float(np.clip(float(value), lo, hi))
+            if config.type == pc.ParameterType.INTEGER:
+                lo, hi = config.bounds
+                return int(np.clip(int(round(float(value))), int(lo), int(hi)))
+            if config.type == pc.ParameterType.DISCRETE:
+                values = np.asarray([float(v) for v in config.feasible_values])
+                return float(values[np.abs(values - float(value)).argmin()])
+        except (TypeError, ValueError):
+            # Prior study typed this name differently (e.g. categorical
+            # value in a numeric domain) — fall back to the default.
+            return config.first_feasible_value()
         # CATEGORICAL: unknown categories fall back to the default value.
         if config.contains(str(value)):
             return str(value)

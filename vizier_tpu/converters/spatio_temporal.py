@@ -83,8 +83,7 @@ class SparseSpatioTemporalConverter:
             order = np.argsort(c.positions)
             pos, val = c.positions[order], c.values[order]
             idx = np.searchsorted(pos, grid, side="right") - 1
-            valid = idx >= 0
-            mask[i] = valid & (grid <= pos[-1] + 1e-12) | (valid & (grid >= pos[0]))
+            valid = idx >= 0  # grid points at/after the trial's first report
             safe = np.clip(idx, 0, len(pos) - 1)
             values[i] = val[safe]
             values[i, ~valid] = np.nan

@@ -55,10 +55,21 @@ def _train_gp(
     rng: Array,
     num_restarts: int,
     ensemble_size: int,
+    warm_start: Optional[gp_lib.Params] = None,
 ) -> gp_lib.GPState:
-    """ARD: restarts → L-BFGS (vmapped) → top-k precomputed posteriors."""
+    """ARD: restarts → L-BFGS (vmapped) → top-k precomputed posteriors.
+
+    ``warm_start`` (previous suggest's best unconstrained params) replaces
+    the first random restart — steady-state hyperparameters move little
+    between suggests, so one restart usually lands at the optimum
+    immediately and the rest guard against mode switches.
+    """
     coll = model.param_collection()
     inits = coll.batch_random_init_unconstrained(rng, num_restarts)
+    if warm_start is not None:
+        inits = jax.tree_util.tree_map(
+            lambda batch, warm: batch.at[0].set(warm), inits, warm_start
+        )
     loss_fn = lambda p: model.neg_log_likelihood(p, data)
     result = optimizer(loss_fn, inits, best_n=ensemble_size)
     return jax.vmap(lambda p: model.precompute(p, data))(result.params)
@@ -153,6 +164,12 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         self._trials: List[trial_.Trial] = []
         self._rng = jax.random.PRNGKey(self.rng_seed)
         self._last_predictive: Optional[gp_lib.EnsemblePredictive] = None
+        # Seed the warm start with a random init so _train_gp's pytree
+        # structure never changes across suggests (None -> dict would force
+        # a full recompile of the ARD program on the second call).
+        self._warm_params = self._model.param_collection().random_init_unconstrained(
+            jax.random.PRNGKey(self.rng_seed + 1)
+        )
 
     # -- Designer ----------------------------------------------------------
 
@@ -240,6 +257,13 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             self._next_rng(),
             self.ard_restarts,
             self.ensemble_size,
+            self._warm_params,
+        )
+        # Warm-start the next suggest from this one's best member
+        # (states.params are constrained; map back through the bijectors).
+        coll = self._model.param_collection()
+        self._warm_params = coll.unconstrain(
+            jax.tree_util.tree_map(lambda a: a[0], states.params)
         )
         predictive = gp_lib.EnsemblePredictive(states)
         self._last_predictive = predictive

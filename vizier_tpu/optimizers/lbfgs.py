@@ -31,7 +31,8 @@ Array = jax.Array
 Params = params_lib.Params
 LossFn = Callable[[Params], Array]
 
-DEFAULT_RANDOM_RESTARTS = 8
+# Matches the reference's published ARD budget (vizier/jax/optimizers.py:30).
+DEFAULT_RANDOM_RESTARTS = 4
 
 
 class OptimizeResult(NamedTuple):
