@@ -1,0 +1,29 @@
+"""Public JAX-layer facade.
+
+Parity with the reference facade ``vizier/jax`` (re-exporting the numerical
+core: models, optimizers, padded types). Absolute imports keep the name
+``vizier_tpu.jax`` from shadowing the real ``jax`` package.
+"""
+
+from vizier_tpu.models.gp import (
+    EnsemblePredictive,
+    GPData,
+    GPState,
+    VizierGaussianProcess,
+)
+from vizier_tpu.models.kernels import MixedFeatures, matern52_ard
+from vizier_tpu.models.multitask_gp import MultiTaskGaussianProcess, MultiTaskType
+from vizier_tpu.models.output_warpers import create_default_warper
+from vizier_tpu.models.params import ParameterCollection, ParameterSpec, SoftClip
+from vizier_tpu.models.stacked_residual import (
+    StackedResidualGP,
+    train_stacked_residual_gp,
+)
+from vizier_tpu.optimizers.lbfgs import (
+    DEFAULT_RANDOM_RESTARTS,
+    AdamOptimizer,
+    LbfgsOptimizer,
+    Optimizer,
+    default_optimizer,
+)
+from vizier_tpu.types import ContinuousAndCategorical, ModelData, ModelInput, PaddedArray

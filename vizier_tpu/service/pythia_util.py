@@ -1,0 +1,42 @@
+"""Cross-thread response handoff.
+
+Parity with ``/root/reference/vizier/_src/service/pythia_util.py:32``
+(``ResponseWaiter``): one thread computes a response while another blocks
+waiting for it, with error propagation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Generic, Optional, TypeVar
+
+_T = TypeVar("_T")
+
+
+class ResponseWaiter(Generic[_T]):
+    def __init__(self):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._response: Optional[_T] = None
+        self._error: Optional[BaseException] = None
+
+    def Report(self, response: _T) -> None:
+        with self._lock:
+            if self._event.is_set():
+                raise RuntimeError("ResponseWaiter already completed.")
+            self._response = response
+            self._event.set()
+
+    def ReportError(self, error: BaseException) -> None:
+        with self._lock:
+            if self._event.is_set():
+                raise RuntimeError("ResponseWaiter already completed.")
+            self._error = error
+            self._event.set()
+
+    def WaitForResponse(self, timeout: Optional[float] = None) -> _T:
+        if not self._event.wait(timeout):
+            raise TimeoutError("Timed out waiting for response.")
+        if self._error is not None:
+            raise self._error
+        return self._response  # type: ignore[return-value]

@@ -1,0 +1,9 @@
+"""Service-side pyvizier facade.
+
+Parity with the reference's ``vizier/service/pyvizier`` namespace (the
+service flavor of the shared data model — in this build they are unified,
+so this module simply re-exports the canonical facade).
+"""
+
+from vizier_tpu.pyvizier import *  # noqa: F401,F403
+from vizier_tpu.pyvizier import __all__  # noqa: F401
