@@ -227,3 +227,63 @@ class TestReviewRegressions:
         metrics = [vz.MetricInformation(name="f1"), vz.MetricInformation(name="f2")]
         curve = cc.HypervolumeCurveConverter(metrics).convert([])
         assert curve.ys.shape[-1] == 0
+
+
+class TestRound1Additions:
+    def test_mes_acquisition(self):
+        import jax
+        import jax.numpy as jnp
+
+        from vizier_tpu.designers.gp import acquisitions
+
+        y_star = jnp.asarray([1.0, 1.2, 0.9])
+        mes = acquisitions.MaxValueEntropySearch(y_star_samples=y_star)
+        mean = jnp.asarray([0.0, 0.8])
+        std = jnp.asarray([0.5, 0.5])
+        vals = np.asarray(mes(mean, std, jnp.asarray(0.0)))
+        assert vals.shape == (2,)
+        assert (vals >= 0).all()
+        assert vals[1] > vals[0]  # closer to y* -> more informative
+
+    def test_trial_cache_dedupes(self):
+        from vizier_tpu.algorithms.trial_caches import IdDeduplicatingTrialLoader
+        from vizier_tpu.pythia import local_policy_supporters
+
+        config = vz.StudyConfig()
+        config.search_space.root.add_float_param("x", 0.0, 1.0)
+        config.metric_information.append(vz.MetricInformation(name="m"))
+        supporter = local_policy_supporters.InRamPolicySupporter(config)
+        t1 = vz.Trial(parameters={"x": 0.1})
+        t1.complete(vz.Measurement(metrics={"m": 1.0}))
+        supporter.AddTrials([t1])
+        loader = IdDeduplicatingTrialLoader(supporter)
+        assert len(loader.new_completed_trials()) == 1
+        assert len(loader.new_completed_trials()) == 0
+        # Serialization round trip.
+        loader2 = IdDeduplicatingTrialLoader(supporter)
+        loader2.load(loader.dump())
+        assert len(loader2.new_completed_trials()) == 0
+
+    def test_plot_utils_render(self, tmp_path):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from vizier_tpu.benchmarks.analyzers import plot_utils
+
+        xs = np.arange(1, 11)
+        curve = cc.ConvergenceCurve(
+            xs=xs,
+            ys=np.stack([xs * 0.1, xs * 0.12]),
+            trend=cc.ConvergenceCurve.YTrend.INCREASING,
+        )
+        ax = plot_utils.plot_median_convergence({"algo": curve}, title="t")
+        fig = ax.get_figure()
+        out = tmp_path / "plot.png"
+        fig.savefig(out)
+        assert out.exists() and out.stat().st_size > 0
+
+    def test_gradient_free_optimizer_abc(self):
+        from vizier_tpu.optimizers.base import BranchSelector, GradientFreeOptimizer
+
+        assert hasattr(GradientFreeOptimizer, "optimize")
+        assert hasattr(BranchSelector, "select_branches")

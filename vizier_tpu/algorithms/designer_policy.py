@@ -123,13 +123,15 @@ class _SerializableDesignerPolicyBase(policy_lib.Policy):
         encoded_state = study_md.get(_DESIGNER_KEY)
         encoded_cache = study_md.get(_CACHE_KEY)
         if encoded_state is not None and encoded_cache is not None:
+            from vizier_tpu.algorithms import trial_caches
+
             try:
-                cached_ids = set(json.loads(encoded_cache))
+                cached_ids = trial_caches.decode_trial_ids(encoded_cache)
                 state_md = common.Metadata()
                 state_md.ns(_DESIGNER_KEY).update(
                     {"state": encoded_state}
                 )
-            except (ValueError, TypeError) as e:
+            except (serializable.DecodeError, ValueError, TypeError) as e:
                 _logger.warning("Corrupt designer cache; replaying all trials: %s", e)
                 state_md, cached_ids = None, set()
 
@@ -157,7 +159,11 @@ class _SerializableDesignerPolicyBase(policy_lib.Policy):
             state = dumped.ns(_DESIGNER_KEY).get("state")
             if state is not None:
                 delta.assign(_NS, _DESIGNER_KEY, state)
-                delta.assign(_NS, _CACHE_KEY, json.dumps(sorted(self._incorporated_ids)))
+                from vizier_tpu.algorithms import trial_caches
+
+                delta.assign(
+                    _NS, _CACHE_KEY, trial_caches.encode_trial_ids(self._incorporated_ids)
+                )
         except Exception as e:  # dump failure must not lose the suggestions
             _logger.warning("Failed to dump designer state: %s", e)
         return policy_lib.SuggestDecision(suggestions=list(suggestions), metadata=delta)

@@ -1,0 +1,39 @@
+"""Legacy gradient-free optimizer ABCs.
+
+Parity with ``/root/reference/vizier/_src/algorithms/optimizers/base.py``
+(``BranchSelector``, ``GradientFreeOptimizer``): the pre-vectorized
+interfaces some integrations still target; the modern path is
+``optimizers.vectorized``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict, List, Sequence
+
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+
+
+class BranchSelector(abc.ABC):
+    """Picks conditional-tree branches before continuous optimization."""
+
+    @abc.abstractmethod
+    def select_branches(
+        self, problem: base_study_config.ProblemStatement, count: int
+    ) -> List[Dict[str, trial_.ParameterValueTypes]]:
+        ...
+
+
+class GradientFreeOptimizer(abc.ABC):
+    """Maximizes a batched score function over a problem's search space."""
+
+    @abc.abstractmethod
+    def optimize(
+        self,
+        score_fn: Callable[[Sequence[trial_.TrialSuggestion]], Sequence[float]],
+        problem: base_study_config.ProblemStatement,
+        *,
+        count: int = 1,
+    ) -> List[trial_.TrialSuggestion]:
+        ...
