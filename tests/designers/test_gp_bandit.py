@@ -259,3 +259,41 @@ class TestGPBandit:
         p.metric_information.append(vz.MetricInformation(name="obj"))
         with pytest.raises(ValueError):
             VizierGPBandit(p)
+
+
+class TestRetraceDiscipline:
+    def test_no_retrace_within_padding_bucket(self):
+        """Suggests within one padding bucket must reuse the jit caches."""
+        from vizier_tpu.designers import gp_bandit as gpb
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", -1.0, 1.0)
+        problem.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        designer = gpb.VizierGPBandit(
+            problem,
+            max_acquisition_evaluations=300,
+            ard_restarts=2,
+            num_seed_trials=2,
+            ard_optimizer=_FAST_ARD,
+        )
+        rng = np.random.default_rng(0)
+
+        def complete_batch(k):
+            done = []
+            for s in designer.suggest(1):
+                t = s.to_trial(k)
+                t.complete(vz.Measurement(metrics={"obj": float(rng.uniform())}))
+                done.append(t)
+            designer.update(core_lib.CompletedTrials(done))
+
+        # Get past seeding and into the 8-bucket (3..7 trials pad to 8).
+        for k in range(1, 4):
+            complete_batch(k)
+        train_sizes = gpb._train_gp._cache_size()
+        acq_sizes = gpb._maximize_acquisition._cache_size()
+        for k in range(4, 7):  # still inside the 8-bucket
+            complete_batch(k)
+        assert gpb._train_gp._cache_size() == train_sizes
+        assert gpb._maximize_acquisition._cache_size() == acq_sizes

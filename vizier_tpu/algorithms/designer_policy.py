@@ -16,6 +16,7 @@ import logging
 from typing import Callable, Optional, Sequence
 
 from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.algorithms import trial_caches
 from vizier_tpu.pythia import policy as policy_lib
 from vizier_tpu.pythia import policy_supporter as supporter_lib
 from vizier_tpu.pyvizier import base_study_config
@@ -123,8 +124,6 @@ class _SerializableDesignerPolicyBase(policy_lib.Policy):
         encoded_state = study_md.get(_DESIGNER_KEY)
         encoded_cache = study_md.get(_CACHE_KEY)
         if encoded_state is not None and encoded_cache is not None:
-            from vizier_tpu.algorithms import trial_caches
-
             try:
                 cached_ids = trial_caches.decode_trial_ids(encoded_cache)
                 state_md = common.Metadata()
@@ -159,8 +158,6 @@ class _SerializableDesignerPolicyBase(policy_lib.Policy):
             state = dumped.ns(_DESIGNER_KEY).get("state")
             if state is not None:
                 delta.assign(_NS, _DESIGNER_KEY, state)
-                from vizier_tpu.algorithms import trial_caches
-
                 delta.assign(
                     _NS, _CACHE_KEY, trial_caches.encode_trial_ids(self._incorporated_ids)
                 )

@@ -26,12 +26,14 @@ def plot_median_convergence(
     """Plots each algorithm's median curve with a percentile band."""
     import matplotlib.pyplot as plt
 
+    if len(percentiles) != 2:
+        raise ValueError(f"percentiles must be a (low, high) pair, got {percentiles}.")
     if ax is None:
         _, ax = plt.subplots(figsize=(7, 4.5))
     for name, curve in curves_by_algorithm.items():
         median = curve.percentile_curve(50.0)
         (line,) = ax.plot(curve.xs, median, label=name)
-        if curve.num_batches > 1 and len(percentiles) == 2:
+        if curve.num_batches > 1:
             lo = curve.percentile_curve(percentiles[0])
             hi = curve.percentile_curve(percentiles[1])
             ax.fill_between(curve.xs, lo, hi, alpha=0.2, color=line.get_color())

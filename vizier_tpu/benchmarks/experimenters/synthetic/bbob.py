@@ -370,3 +370,22 @@ BBOB_FUNCTIONS: Dict[str, Callable[[np.ndarray], np.ndarray]] = {
     "Katsuura": Katsuura,
     "LunacekBiRastrigin": LunacekBiRastrigin,
 }
+
+
+@_batch
+def Branin(x: np.ndarray) -> np.ndarray:
+    """The classic 2-D Branin-Hoo function over the standard [-5,10]x[0,15].
+
+    Not part of BBOB, but the canonical GP-BO benchmark (BASELINE.md eval
+    configs). Inputs here are in BBOB's [-5, 5] frame and are affinely
+    mapped onto Branin's native domain; global minimum value ≈ 0.397887.
+    """
+    x1 = (x[..., 0] + 5.0) * 1.5 - 5.0  # [-5,5] -> [-5,10]
+    x2 = (x[..., 1] + 5.0) * 1.5  # [-5,5] -> [0,15]
+    a, b, c = 1.0, 5.1 / (4 * np.pi**2), 5.0 / np.pi
+    r, s, t = 6.0, 10.0, 1.0 / (8 * np.pi)
+    return a * (x2 - b * x1**2 + c * x1 - r) ** 2 + s * (1 - t) * np.cos(x1) + s
+
+
+# Non-BBOB extras served through the same interface.
+EXTRA_FUNCTIONS = {"Branin": Branin}
