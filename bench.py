@@ -38,6 +38,30 @@ def main() -> None:
     # SCALE < 1 shrinks the problem for smoke-testing on CPU; the driver
     # runs the full-size benchmark (SCALE unset) on TPU.
     scale = float(os.environ.get("VIZIER_BENCH_SCALE", "1.0"))
+
+    # Pre-flight the fused Pallas kernel on this backend; fall back to the
+    # jnp path (VIZIER_DISABLE_PALLAS) rather than failing the benchmark if
+    # the runtime cannot compile it.
+    if os.environ.get("VIZIER_DISABLE_PALLAS") is None:
+        try:
+            from vizier_tpu.ops import matern_pallas
+
+            if matern_pallas.is_tpu_backend():
+                import jax.numpy as jnp
+
+                probe = matern_pallas.matern52_ard_continuous_pallas(
+                    jnp.zeros((8, 4)), jnp.zeros((8, 4)), jnp.ones(4), jnp.asarray(1.0)
+                )
+                jax.block_until_ready(probe)
+        except Exception as e:  # pragma: no cover - hardware-specific
+            import sys
+
+            print(
+                f"pallas pre-flight failed ({type(e).__name__}); using jnp path",
+                file=sys.stderr,
+                flush=True,
+            )
+            os.environ["VIZIER_DISABLE_PALLAS"] = "1"
     num_trials, dim = max(int(1000 * scale), 16), 20
     n_pad = 1 << (num_trials - 1).bit_length()  # next power-of-2 bucket
     batch_count = 25  # suggestion batch (reference default batch)

@@ -81,8 +81,16 @@ class MixedFeatures(NamedTuple):
 
 
 # Route continuous-only cross-kernels through the fused Pallas TPU kernel
-# when the problem is big enough to pay off (set False to force jnp).
+# when the problem is big enough to pay off. Kill switch:
+# VIZIER_DISABLE_PALLAS=1 forces the jnp path (e.g. if a TPU runtime lacks
+# Mosaic support).
 _PALLAS_MIN_ELEMENTS = 128 * 128
+
+
+def _pallas_enabled() -> bool:
+    import os
+
+    return os.environ.get("VIZIER_DISABLE_PALLAS", "0") != "1"
 
 
 def matern52_ard(
@@ -104,6 +112,7 @@ def matern52_ard(
     if (
         f1.categorical.shape[-1] == 0
         and f1.continuous.shape[0] * f2.continuous.shape[0] >= _PALLAS_MIN_ELEMENTS
+        and _pallas_enabled()
     ):
         from vizier_tpu.ops import matern_pallas
 
