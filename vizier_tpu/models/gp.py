@@ -83,6 +83,11 @@ class VizierGaussianProcess:
     num_continuous: int
     num_categorical: int
     use_linear_mean: bool = False
+    # HEBO-style learnable Kumaraswamy input warping of the [0,1] continuous
+    # features (parity with the reference's hebo_gp_model.py): u ->
+    # 1-(1-u^a)^b with per-dimension a, b — lets the GP adapt to
+    # non-stationary objectives (e.g. log-like sensitivity near a boundary).
+    use_input_warping: bool = False
 
     # -- hyperparameter declaration ---------------------------------------
 
@@ -121,6 +126,19 @@ class VizierGaussianProcess:
                     prior_sigma=1.0,
                 )
             )
+        if self.use_input_warping and self.num_continuous:
+            for name in ("warp_a", "warp_b"):
+                specs.append(
+                    params_lib.ParameterSpec(
+                        name,
+                        (self.num_continuous,),
+                        sc(0.25, 4.0),
+                        0.8,
+                        1.25,
+                        prior_mu=0.0,  # log-normal centered at identity (a=b=1)
+                        prior_sigma=0.5,
+                    )
+                )
         if self.use_linear_mean and self.num_continuous:
             # Linear mean coefficients are unconstrained; modelled via a wide
             # softclip to keep the single-pytree machinery uniform.
@@ -133,11 +151,20 @@ class VizierGaussianProcess:
 
     # -- kernel & mean -----------------------------------------------------
 
+    def _warp_features(self, p: Params, f: kernels.MixedFeatures) -> kernels.MixedFeatures:
+        if not (self.use_input_warping and self.num_continuous):
+            return f
+        u = jnp.clip(f.continuous, 1e-6, 1.0 - 1e-6)
+        warped = 1.0 - (1.0 - u ** p["warp_a"]) ** p["warp_b"]
+        return kernels.MixedFeatures(warped, f.categorical)
+
     def _kernel(
         self, p: Params, f1: kernels.MixedFeatures, f2: kernels.MixedFeatures, data: GPData
     ) -> Array:
         cont_ls = p.get("continuous_length_scales", jnp.ones((self.num_continuous,)))
         cat_ls = p.get("categorical_length_scales", jnp.ones((self.num_categorical,)))
+        f1 = self._warp_features(p, f1)
+        f2 = self._warp_features(p, f2)
         return kernels.matern52_ard(
             f1,
             f2,
