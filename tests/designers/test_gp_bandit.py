@@ -297,3 +297,23 @@ class TestRetraceDiscipline:
             complete_batch(k)
         assert gpb._train_gp._cache_size() == train_sizes
         assert gpb._maximize_acquisition._cache_size() == acq_sizes
+
+
+class TestInputWarpingKnob:
+    def test_designer_exposes_input_warping(self):
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", 0.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        d = VizierGPBandit(
+            p,
+            use_input_warping=True,
+            max_acquisition_evaluations=300,
+            ard_restarts=2,
+            num_seed_trials=2,
+            ard_optimizer=_FAST_ARD,
+        )
+        assert d._model.use_input_warping
+        trials = test_runners.RandomMetricsRunner(p, iters=3, batch_size=2).run_designer(d)
+        assert len(trials) == 6

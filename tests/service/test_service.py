@@ -533,3 +533,17 @@ class TestReviewRegressions:
         assert study.materialize_state() == vz.StudyState.ACTIVE
         study.set_state(vz.StudyState.COMPLETED)
         assert study.materialize_state() == vz.StudyState.COMPLETED
+
+
+class TestListStudies:
+    def test_lists_owner_studies(self):
+        vizier_client._local_servicer = None
+        for sid in ("a", "b"):
+            clients_lib.Study.from_study_config(_config(), owner="lister", study_id=sid)
+        clients_lib.Study.from_study_config(_config(), owner="other", study_id="c")
+        studies = clients_lib.list_studies("lister")
+        names = sorted(s.resource_name for s in studies)
+        assert names == ["owners/lister/studies/a", "owners/lister/studies/b"]
+        # Handles are live: suggest works through them.
+        (t,) = studies[0].suggest(count=1)
+        assert t.status == vz.TrialStatus.ACTIVE

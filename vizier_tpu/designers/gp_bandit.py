@@ -122,6 +122,9 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     ensemble_size: int = 1
     max_acquisition_evaluations: int = 75_000
     use_trust_region: bool = True
+    # HEBO-style learnable Kumaraswamy input warping (non-stationary
+    # objectives); see models.gp.VizierGaussianProcess.use_input_warping.
+    use_input_warping: bool = False
     padding: Optional[padding_lib.PaddingSchedule] = None
     metric_index: int = 0
     rng_seed: int = 0
@@ -138,7 +141,9 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         )
         enc = self._converter.encoder
         self._model = gp_lib.VizierGaussianProcess(
-            num_continuous=enc.num_continuous, num_categorical=enc.num_categorical
+            num_continuous=enc.num_continuous,
+            num_categorical=enc.num_categorical,
+            use_input_warping=self.use_input_warping,
         )
         self._ard = self.ard_optimizer or lbfgs_lib.LbfgsOptimizer()
         # The acquisition optimizer works in the (possibly feature-padded)

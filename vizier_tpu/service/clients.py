@@ -19,6 +19,23 @@ NO_ENDPOINT = vizier_client.NO_ENDPOINT
 environment_variables = vizier_client.environment_variables
 
 
+def list_studies(owner: str, *, endpoint: Optional[str] = None) -> List["Study"]:
+    """All studies under an owner (parity with the ListStudies RPC)."""
+    from vizier_tpu.service import resources
+    from vizier_tpu.service.protos import vizier_service_pb2
+
+    service = vizier_client.create_service_stub(endpoint)
+    response = service.ListStudies(
+        vizier_service_pb2.ListStudiesRequest(
+            parent=resources.OwnerResource(owner).name
+        )
+    )
+    return [
+        Study(vizier_client.VizierClient(service, s.name, "default_client_id"))
+        for s in response.studies
+    ]
+
+
 class Trial(client_abc.TrialInterface):
     def __init__(self, client: vizier_client.VizierClient, uid: int):
         self._client = client
