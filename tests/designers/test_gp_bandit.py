@@ -317,3 +317,48 @@ class TestInputWarpingKnob:
         assert d._model.use_input_warping
         trials = test_runners.RandomMetricsRunner(p, iters=3, batch_size=2).run_designer(d)
         assert len(trials) == 6
+
+
+class TestJointQEIBatch:
+    def test_qei_batch_is_joint_and_diverse(self):
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", -1.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        d = VizierGPBandit(
+            p,
+            acquisition="qei",
+            max_acquisition_evaluations=1000,
+            ard_restarts=2,
+            num_seed_trials=3,
+            ard_optimizer=_FAST_ARD,
+        )
+        trials = []
+        for i, x in enumerate(np.linspace(-1, 1, 6)):
+            t = vz.Trial(id=i + 1, parameters={"x": float(x)})
+            t.complete(vz.Measurement(metrics={"obj": -((x - 0.3) ** 2)}))
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        batch = d.suggest(3)
+        xs = [s.parameters.get_value("x") for s in batch]
+        kinds = {s.metadata.ns("gp_bandit")["acquisition_kind"] for s in batch}
+        assert kinds == {"qei_joint"}
+        assert len(set(round(x, 4) for x in xs)) == 3  # joint batch is diverse
+
+    def test_qei_single_point_uses_ei(self):
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", -1.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        d = VizierGPBandit(
+            p,
+            acquisition="qei",
+            max_acquisition_evaluations=500,
+            ard_restarts=2,
+            num_seed_trials=2,
+            ard_optimizer=_FAST_ARD,
+        )
+        trials = test_runners.RandomMetricsRunner(p, iters=3, batch_size=1).run_designer(d)
+        assert len(trials) == 3

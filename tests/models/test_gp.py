@@ -294,3 +294,30 @@ class TestInputWarping:
         constrained["warp_b"] = jnp.full(2, 0.4)
         warped = float(model.neg_log_likelihood(coll.unconstrain(constrained), data))
         assert warped != pytest.approx(identity, rel=1e-4)
+
+
+class TestJointPosterior:
+    def test_predict_joint_matches_marginals(self):
+        model = gp_lib.VizierGaussianProcess(num_continuous=2, num_categorical=0)
+        data = _make_data(10, 16)
+        params = model.param_collection().random_init_unconstrained(jax.random.PRNGKey(2))
+        state = model.precompute(params, data)
+        query = _feats(np.random.default_rng(3).uniform(size=(5, 2)).astype(np.float32))
+        mean_m, std_m = state.predict(query)
+        mean_j, cov_j = state.predict_joint(query)
+        np.testing.assert_allclose(mean_j, mean_m, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.sqrt(np.diag(np.asarray(cov_j))), std_m, rtol=1e-2, atol=1e-3
+        )
+
+    def test_duplicated_points_perfectly_correlated(self):
+        """The property joint qEI relies on: copies share one posterior draw."""
+        model = gp_lib.VizierGaussianProcess(num_continuous=1, num_categorical=0)
+        data = _make_data(8, 8, dc=1)
+        params = model.param_collection().random_init_unconstrained(jax.random.PRNGKey(0))
+        state = model.precompute(params, data)
+        x = np.array([[0.37], [0.37]], np.float32)  # same point twice
+        _, cov = state.predict_joint(_feats(x))
+        cov = np.asarray(cov)
+        corr = cov[0, 1] / np.sqrt(cov[0, 0] * cov[1, 1])
+        assert corr == pytest.approx(1.0, abs=1e-3)

@@ -240,6 +240,23 @@ class GPState:
             var = var + p["noise_stddev"] * p["noise_stddev"]
         return mean, jnp.sqrt(jnp.maximum(var, 1e-12))
 
+    def predict_joint(self, query: kernels.MixedFeatures) -> Tuple[Array, Array]:
+        """Posterior mean [M] and full covariance [M, M] at query points.
+
+        Needed by joint q-batch acquisitions: duplicated batch members are
+        perfectly correlated, which marginal-only sampling cannot express.
+        """
+        model, p, data = self.model, self.params, self.data
+        k_star = model._kernel(p, query, data.features(), data)  # [M, N]
+        k_star = jnp.where(data.row_mask[None, :], k_star, 0.0)
+        mean = k_star @ self.alpha
+        v = jax.scipy.linalg.solve_triangular(self.chol, k_star.T, lower=True)  # [N, M]
+        k_qq = model._kernel(p, query, query, data)  # [M, M]
+        cov = k_qq - v.T @ v
+        # Symmetrize + jitter for downstream Cholesky.
+        cov = 0.5 * (cov + cov.T) + 1e-6 * jnp.eye(cov.shape[0], dtype=cov.dtype)
+        return mean, cov
+
     def sample(
         self, query: kernels.MixedFeatures, rng: Array, num_samples: int
     ) -> Array:
