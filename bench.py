@@ -15,14 +15,25 @@ reusable across suggests in a real serving process).
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
 
-def main() -> None:
-    import os
+def _progress(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
+
+def main() -> None:
+    _progress("init: importing jax + applying platform env")
+    # Round-1 lesson: without the config-level platform pin, the image's TPU
+    # sitecustomize makes `JAX_PLATFORMS=cpu python bench.py` hang in
+    # make_c_api_client. One shared implementation lives in __graft_entry__.
+    from __graft_entry__ import _honor_platform_env
+
+    _honor_platform_env()
     import jax
 
     from vizier_tpu import types
@@ -34,6 +45,8 @@ def main() -> None:
     from vizier_tpu.optimizers import lbfgs as lbfgs_lib
     from vizier_tpu.optimizers import vectorized as vectorized_lib
     from vizier_tpu.designers.gp_bandit import _maximize_acquisition, _train_gp
+
+    _progress(f"backend: {jax.default_backend()} ({len(jax.devices())} devices)")
 
     # SCALE < 1 shrinks the problem for smoke-testing on CPU; the driver
     # runs the full-size benchmark (SCALE unset) on TPU.
@@ -49,18 +62,14 @@ def main() -> None:
             if matern_pallas.is_tpu_backend():
                 import jax.numpy as jnp
 
+                _progress("pallas pre-flight: compiling probe kernel")
                 probe = matern_pallas.matern52_ard_continuous_pallas(
                     jnp.zeros((8, 4)), jnp.zeros((8, 4)), jnp.ones(4), jnp.asarray(1.0)
                 )
                 jax.block_until_ready(probe)
+                _progress("pallas pre-flight: ok")
         except Exception as e:  # pragma: no cover - hardware-specific
-            import sys
-
-            print(
-                f"pallas pre-flight failed ({type(e).__name__}); using jnp path",
-                file=sys.stderr,
-                flush=True,
-            )
+            _progress(f"pallas pre-flight failed ({type(e).__name__}); using jnp path")
             os.environ["VIZIER_DISABLE_PALLAS"] = "1"
     num_trials, dim = max(int(1000 * scale), 16), 20
     n_pad = 1 << (num_trials - 1).bit_length()  # next power-of-2 bucket
@@ -115,12 +124,19 @@ def main() -> None:
         jax.block_until_ready(result)
         return result
 
+    _progress(
+        f"compile: first suggest at {num_trials}x{dim}d, {max_evals} evals "
+        f"(first TPU compile can take ~20-40s)"
+    )
+    t0 = time.perf_counter()
     one_suggest(0)  # compile
+    _progress(f"compile: done in {time.perf_counter() - t0:.1f}s")
     times = []
     for i in range(1, repeats + 1):
         t0 = time.perf_counter()
         one_suggest(i)
         times.append((time.perf_counter() - t0) * 1000.0)
+        _progress(f"repeat {i}/{repeats}: {times[-1]:.1f} ms")
     p50 = float(np.percentile(times, 50))
 
     target_ms = 1000.0

@@ -73,17 +73,32 @@ class LogEI:
 
     def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
         z = (mean - best_label) / stddev
-        # log(s*(z Φ(z)+φ(z))). For very negative z use the asymptotic
-        # log φ(z) - log(z²) tail to avoid log(0).
-        body = z * _norm_cdf(z) + _norm_pdf(z)
-        safe = jnp.log(jnp.maximum(body, 1e-30)) + jnp.log(stddev)
-        tail = (
-            -0.5 * z * z
-            - jnp.log(jnp.maximum(z * z - 1.0, 1.0))
-            + jnp.log(stddev)
-            - 0.5 * jnp.log(2.0 * jnp.pi)
-        )
-        return jnp.where(z > -6.0, safe, tail)
+        # log(s * h(z)), h(z) = z Φ(z) + φ(z), in three regimes. Direct
+        # evaluation cancels catastrophically in f32 once z ≲ -2 (both terms
+        # shrink to ~φ(z) while h ~ φ(z)/z²), so the mid range uses
+        # h = φ(z)·(1 + z Φ(z)/φ(z)) via log1p — the cancellation then
+        # happens on an O(1) ratio instead of two tiny near-equal terms —
+        # and the deep tail (where Φ, φ underflow f32) uses the asymptotic
+        # h ≈ φ(z)(z²-3)/z⁴. Each branch is computed on a clipped copy of z
+        # so the unused branches stay finite under jnp.where gradients.
+        c = 0.5 * jnp.log(2.0 * jnp.pi)
+        log_s = jnp.log(stddev)
+
+        zd = jnp.maximum(z, -1.5)  # direct: z > -1
+        direct = jnp.log(zd * _norm_cdf(zd) + _norm_pdf(zd))
+
+        # mills: -10 < z <= -1. The ratio z·Φ(z)/φ(z) ∈ (-1, 0) is formed in
+        # log space (log_ndtr stays accurate where f32 Φ saturates to 0).
+        zm = jnp.clip(z, -12.0, -0.5)
+        log_phi_m = -0.5 * zm * zm - c
+        t = jnp.log(-zm) + jax.scipy.special.log_ndtr(zm) - log_phi_m
+        ratio = -jnp.exp(jnp.minimum(t, 0.0))
+        mills = log_phi_m + jnp.log1p(jnp.maximum(ratio, -0.9999999))
+
+        zt = jnp.minimum(z, -4.0)  # tail: z <= -10
+        tail = -0.5 * zt * zt - c + jnp.log(zt * zt - 3.0) - 2.0 * jnp.log(zt * zt)
+
+        return jnp.where(z > -1.0, direct, jnp.where(z > -10.0, mills, tail)) + log_s
 
 
 @flax.struct.dataclass

@@ -461,8 +461,13 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     def _prior_features(self, data: gp_lib.GPData) -> kernels.MixedFeatures:
         """Top observed points (by warped label) to seed the eagle pool."""
         labels = jnp.where(data.row_mask, data.labels, -jnp.inf)
+        # k stays a function of the *padded* row count so shapes are stable
+        # within a padding bucket (no retrace); slots past the valid rows
+        # would be all-zero padding rows, so redirect them to the best row.
         k = min(10, data.num_rows)
         _, idx = jax.lax.top_k(labels, k)
+        num_valid = jnp.sum(data.row_mask)
+        idx = jnp.where(jnp.arange(k) < num_valid, idx, idx[0])
         return kernels.MixedFeatures(data.continuous[idx], data.categorical[idx])
 
     # -- Predictor ---------------------------------------------------------

@@ -284,41 +284,50 @@ class VizierServicer:
     def AddTrialMeasurement(
         self, request: vizier_service_pb2.AddTrialMeasurementRequest, context=None
     ) -> study_pb2.Trial:
-        trial = self.datastore.get_trial(request.trial_name)
-        if trial.state in (study_pb2.Trial.SUCCEEDED, study_pb2.Trial.INFEASIBLE):
-            raise ValueError(f"Trial {request.trial_name} is already completed.")
-        trial.measurements.add().CopyFrom(request.measurement)
-        self.datastore.update_trial(trial)
-        return trial
+        study_name = resources.TrialResource.from_name(
+            request.trial_name
+        ).study_resource.name
+        # Read-modify-write under the study lock: two workers racing here must
+        # not both pass the completed check or drop each other's measurement.
+        with self._study_locks[study_name]:
+            trial = self.datastore.get_trial(request.trial_name)
+            if trial.state in (study_pb2.Trial.SUCCEEDED, study_pb2.Trial.INFEASIBLE):
+                raise ValueError(f"Trial {request.trial_name} is already completed.")
+            trial.measurements.add().CopyFrom(request.measurement)
+            self.datastore.update_trial(trial)
+            return trial
 
     def CompleteTrial(
         self, request: vizier_service_pb2.CompleteTrialRequest, context=None
     ) -> study_pb2.Trial:
-        trial = self.datastore.get_trial(request.name)
         study_name = resources.TrialResource.from_name(request.name).study_resource.name
-        study = self.datastore.load_study(study_name)
-        if study.state == study_pb2.Study.COMPLETED:
-            raise ValueError(f"Study {study_name} is completed; trials are immutable.")
-        if trial.state in (study_pb2.Trial.SUCCEEDED, study_pb2.Trial.INFEASIBLE):
-            raise ValueError(f"Trial {request.name} is already completed.")
+        with self._study_locks[study_name]:
+            trial = self.datastore.get_trial(request.name)
+            study = self.datastore.load_study(study_name)
+            if study.state == study_pb2.Study.COMPLETED:
+                raise ValueError(
+                    f"Study {study_name} is completed; trials are immutable."
+                )
+            if trial.state in (study_pb2.Trial.SUCCEEDED, study_pb2.Trial.INFEASIBLE):
+                raise ValueError(f"Trial {request.name} is already completed.")
 
-        if request.HasField("final_measurement"):
-            trial.final_measurement.CopyFrom(request.final_measurement)
-            trial.state = study_pb2.Trial.SUCCEEDED
-        elif trial.measurements:
-            trial.final_measurement.CopyFrom(trial.measurements[-1])
-            trial.state = study_pb2.Trial.SUCCEEDED
-        else:
-            trial.state = study_pb2.Trial.INFEASIBLE
-            trial.infeasibility_reason = (
-                request.infeasible_reason or "Completed without any measurement."
-            )
-        if request.trial_infeasible:
-            trial.state = study_pb2.Trial.INFEASIBLE
-            trial.infeasibility_reason = request.infeasible_reason or "infeasible"
-        trial.completion_time_secs = time.time()
-        self.datastore.update_trial(trial)
-        return trial
+            if request.HasField("final_measurement"):
+                trial.final_measurement.CopyFrom(request.final_measurement)
+                trial.state = study_pb2.Trial.SUCCEEDED
+            elif trial.measurements:
+                trial.final_measurement.CopyFrom(trial.measurements[-1])
+                trial.state = study_pb2.Trial.SUCCEEDED
+            else:
+                trial.state = study_pb2.Trial.INFEASIBLE
+                trial.infeasibility_reason = (
+                    request.infeasible_reason or "Completed without any measurement."
+                )
+            if request.trial_infeasible:
+                trial.state = study_pb2.Trial.INFEASIBLE
+                trial.infeasibility_reason = request.infeasible_reason or "infeasible"
+            trial.completion_time_secs = time.time()
+            self.datastore.update_trial(trial)
+            return trial
 
     def DeleteTrial(
         self, request: vizier_service_pb2.DeleteTrialRequest, context=None
@@ -329,11 +338,13 @@ class VizierServicer:
     def StopTrial(
         self, request: vizier_service_pb2.StopTrialRequest, context=None
     ) -> study_pb2.Trial:
-        trial = self.datastore.get_trial(request.name)
-        if trial.state in (study_pb2.Trial.ACTIVE, study_pb2.Trial.REQUESTED):
-            trial.state = study_pb2.Trial.STOPPING
-            self.datastore.update_trial(trial)
-        return trial
+        study_name = resources.TrialResource.from_name(request.name).study_resource.name
+        with self._study_locks[study_name]:
+            trial = self.datastore.get_trial(request.name)
+            if trial.state in (study_pb2.Trial.ACTIVE, study_pb2.Trial.REQUESTED):
+                trial.state = study_pb2.Trial.STOPPING
+                self.datastore.update_trial(trial)
+            return trial
 
     # -- early stopping ----------------------------------------------------
 
