@@ -17,6 +17,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# The designers' auto-mesh would route EVERY GP test through 8-pool sharded
+# sweeps; on virtual CPU devices that multiplies work ~8x with no
+# parallelism gain. Dedicated mesh tests opt back in with use_mesh=True.
+os.environ.setdefault("VIZIER_DISABLE_MESH", "1")
 
 import jax  # noqa: E402
 

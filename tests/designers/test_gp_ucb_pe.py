@@ -1,0 +1,367 @@
+"""GP-UCB-PE behavioral tests (reference ``gp_ucb_pe_test.py`` scenarios).
+
+Covers: pending-point batch diversity, the UCB/PE decision logic and its
+overwrite probabilities, multimetric penalty modes + HV-scalarized UCB,
+the joint set acquisition, the high-noise regime, capacity guarding, and
+unwarped prediction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.designers.gp_ucb_pe import (
+    UCBPEConfig,
+    VizierGPUCBPEBandit,
+    _append_row,
+)
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+
+_FAST_ARD = lbfgs_lib.AdamOptimizer(maxiter=20)
+
+
+def _single_metric_problem(categorical: bool = False) -> vz.ProblemStatement:
+    p = vz.ProblemStatement()
+    p.search_space.root.add_float_param("x", 0.0, 1.0)
+    if categorical:
+        p.search_space.root.add_categorical_param("c", ["a", "b", "c"])
+    p.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return p
+
+
+def _multi_metric_problem() -> vz.ProblemStatement:
+    p = vz.ProblemStatement()
+    p.search_space.root.add_float_param("x", 0.0, 1.0)
+    p.search_space.root.add_categorical_param("c", ["a", "b"])
+    p.metric_information.append(
+        vz.MetricInformation(name="f1", goal=vz.ObjectiveMetricGoal.MINIMIZE)
+    )
+    p.metric_information.append(
+        vz.MetricInformation(name="f2", goal=vz.ObjectiveMetricGoal.MINIMIZE)
+    )
+    return p
+
+
+def _designer(problem, **kwargs):
+    kwargs.setdefault("max_acquisition_evaluations", 300)
+    kwargs.setdefault("ard_restarts", 2)
+    kwargs.setdefault("ard_optimizer", _FAST_ARD)
+    return VizierGPUCBPEBandit(problem, **kwargs)
+
+
+def _complete(problem, xs, fn, start_id=1):
+    trials = []
+    names = problem.search_space.parameter_names()
+    for i, x in enumerate(xs):
+        params = {"x": float(x)}
+        if "c" in names:
+            values = list(problem.search_space.get("c").feasible_values)
+            params["c"] = values[i % len(values)]
+        t = vz.Trial(id=start_id + i, parameters=params)
+        metrics = fn(float(x))
+        t.complete(vz.Measurement(metrics=metrics))
+        trials.append(t)
+    return trials
+
+
+class TestDecisionLogic:
+    def test_first_pick_is_ucb_with_fresh_completions(self):
+        """pe_overwrite_probability=0 → fresh data forces UCB on pick 1."""
+        p = _single_metric_problem()
+        d = _designer(
+            p,
+            config=UCBPEConfig(
+                pe_overwrite_probability=0.0,
+                pe_overwrite_probability_in_high_noise=0.0,
+                ucb_overwrite_probability=0.0,
+            ),
+        )
+        d.update(
+            core_lib.CompletedTrials(
+                _complete(p, np.linspace(0, 1, 6), lambda x: {"obj": -((x - 0.6) ** 2)})
+            )
+        )
+        s = d.suggest(3)
+        flags = [si.metadata.ns("gp_ucb_pe")["use_ucb"] for si in s]
+        assert flags[0] == "True"
+        # Later picks see pick 1 as pending → PE (overwrite prob is 0).
+        assert flags[1] == "False" and flags[2] == "False"
+
+    def test_all_pe_when_no_new_completions(self):
+        """Active trials newer than completions → PE (ucb_overwrite=0)."""
+        p = _single_metric_problem()
+        d = _designer(p, config=UCBPEConfig(ucb_overwrite_probability=0.0))
+        completed = _complete(
+            p, np.linspace(0, 1, 5), lambda x: {"obj": -((x - 0.4) ** 2)}
+        )
+        active = [vz.Trial(id=50, parameters={"x": 0.9})]  # created after
+        d.update(core_lib.CompletedTrials(completed), core_lib.ActiveTrials(active))
+        s = d.suggest(2)
+        flags = [si.metadata.ns("gp_ucb_pe")["use_ucb"] for si in s]
+        assert flags == ["False", "False"]
+
+    def test_ucb_overwrite_probability_one_forces_ucb(self):
+        p = _single_metric_problem()
+        d = _designer(p, config=UCBPEConfig(ucb_overwrite_probability=1.0))
+        completed = _complete(
+            p, np.linspace(0, 1, 5), lambda x: {"obj": -((x - 0.4) ** 2)}
+        )
+        active = [vz.Trial(id=50, parameters={"x": 0.9})]
+        d.update(core_lib.CompletedTrials(completed), core_lib.ActiveTrials(active))
+        s = d.suggest(2)
+        flags = [si.metadata.ns("gp_ucb_pe")["use_ucb"] for si in s]
+        assert flags == ["True", "True"]
+
+
+class TestBatchDiversity:
+    def test_batch_picks_are_distinct(self):
+        """Pending-point conditioning must spread the batch out.
+
+        Sparse data keeps real posterior uncertainty between observations, so
+        the PE picks have room to diversify; with a dense noiseless quadratic
+        the promising region itself shrinks to a point and crowding is the
+        semantically-correct behavior.
+        """
+        p = _single_metric_problem()
+        d = _designer(
+            p,
+            max_acquisition_evaluations=800,
+            config=UCBPEConfig(
+                pe_overwrite_probability=0.0,
+                ucb_overwrite_probability=0.0,
+                cb_violation_penalty_coefficient=1.0,
+            ),
+        )
+        d.update(
+            core_lib.CompletedTrials(
+                _complete(p, [0.1, 0.9], lambda x: {"obj": -((x - 0.5) ** 2)})
+            )
+        )
+        s = d.suggest(4)
+        xs = sorted(float(si.parameters["x"].value) for si in s)
+        gaps = np.diff(xs)
+        # No two suggestions collapse onto the same point.
+        assert (gaps > 1e-3).all(), xs
+
+    def test_pending_active_trials_are_avoided(self):
+        """A pending point deflates stddev around itself → PE goes elsewhere."""
+        p = _single_metric_problem()
+        d = _designer(
+            p,
+            max_acquisition_evaluations=800,
+            config=UCBPEConfig(ucb_overwrite_probability=0.0),
+        )
+        completed = _complete(
+            p, np.linspace(0, 1, 6), lambda x: {"obj": -((x - 0.5) ** 2)}
+        )
+        active = [vz.Trial(id=40, parameters={"x": 0.52})]
+        d.update(core_lib.CompletedTrials(completed), core_lib.ActiveTrials(active))
+        s = d.suggest(1)
+        x = float(s[0].parameters["x"].value)
+        assert abs(x - 0.52) > 0.02
+
+
+class TestMultimetric:
+    @pytest.mark.parametrize("mode", ["union", "intersection", "average"])
+    def test_penalty_modes_run_mixed_space(self, mode):
+        p = _multi_metric_problem()
+        d = _designer(
+            p,
+            config=UCBPEConfig(
+                num_scalarizations=32,
+                multimetric_promising_region_penalty_type=mode,
+            ),
+        )
+        trials = []
+        for i, x in enumerate(np.linspace(0, 1, 6)):
+            t = vz.Trial(
+                id=i + 1, parameters={"x": float(x), "c": ["a", "b"][i % 2]}
+            )
+            t.complete(
+                vz.Measurement(metrics={"f1": x**2, "f2": (x - 1) ** 2})
+            )
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        s = d.suggest(3)  # mixed-space multi-objective q-batch: the gap row
+        assert len(s) == 3
+        assert all("use_ucb" in si.metadata.ns("gp_ucb_pe") for si in s)
+
+    def test_invalid_penalty_mode_rejected(self):
+        with pytest.raises(ValueError):
+            UCBPEConfig(multimetric_promising_region_penalty_type="bogus")
+
+    def test_multimetric_predict_shapes(self):
+        p = _multi_metric_problem()
+        d = _designer(p, config=UCBPEConfig(num_scalarizations=16))
+        trials = []
+        for i, x in enumerate(np.linspace(0, 1, 5)):
+            t = vz.Trial(
+                id=i + 1, parameters={"x": float(x), "c": ["a", "b"][i % 2]}
+            )
+            t.complete(vz.Measurement(metrics={"f1": x, "f2": 1 - x}))
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        s = d.suggest(2)
+        pred = d.predict(s, num_samples=64)
+        assert pred.mean.shape == (2, 2)
+        assert np.isfinite(pred.stddev).all()
+
+
+class TestSetAcquisition:
+    def test_joint_set_pe_batch(self):
+        p = _single_metric_problem()
+        d = _designer(
+            p,
+            config=UCBPEConfig(optimize_set_acquisition_for_exploration=True),
+        )
+        d.update(
+            core_lib.CompletedTrials(
+                _complete(p, np.linspace(0, 1, 6), lambda x: {"obj": -((x - 0.3) ** 2)})
+            )
+        )
+        s = d.suggest(3)
+        assert len(s) == 3
+        xs = sorted(float(si.parameters["x"].value) for si in s)
+        # log-det objective decorrelates the set: members must not coincide.
+        assert (np.diff(xs) > 1e-4).all(), xs
+
+    def test_set_acquisition_rejects_multimetric(self):
+        p = _multi_metric_problem()
+        d = _designer(
+            p,
+            config=UCBPEConfig(optimize_set_acquisition_for_exploration=True),
+        )
+        trials = []
+        for i, x in enumerate(np.linspace(0, 1, 5)):
+            t = vz.Trial(
+                id=i + 1, parameters={"x": float(x), "c": ["a", "b"][i % 2]}
+            )
+            t.complete(vz.Measurement(metrics={"f1": x, "f2": 1 - x}))
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        with pytest.raises(ValueError, match="one objective"):
+            d.suggest(2)
+
+
+class TestHighNoiseRegime:
+    def test_snr_flips_pe_probability(self):
+        """In high noise, pe_overwrite_in_high_noise=1 forces PE on pick 1."""
+        p = _single_metric_problem()
+        d = _designer(
+            p,
+            config=UCBPEConfig(
+                signal_to_noise_threshold=1e6,  # everything counts as noisy
+                pe_overwrite_probability=0.0,
+                pe_overwrite_probability_in_high_noise=1.0,
+                ucb_overwrite_probability=0.0,
+            ),
+        )
+        rng = np.random.default_rng(0)
+        d.update(
+            core_lib.CompletedTrials(
+                _complete(
+                    p,
+                    np.linspace(0, 1, 8),
+                    lambda x: {"obj": float(rng.normal())},  # pure noise
+                )
+            )
+        )
+        s = d.suggest(1)
+        assert s[0].metadata.ns("gp_ucb_pe")["use_ucb"] == "False"
+
+
+class TestPlumbing:
+    def test_capacity_reserved_for_batch(self):
+        p = _single_metric_problem()
+        d = _designer(p)
+        d.update(
+            core_lib.CompletedTrials(
+                _complete(p, np.linspace(0, 1, 7), lambda x: {"obj": x})
+            )
+        )
+        all_data = d._all_points_data(5)
+        spare = all_data.row_mask.shape[0] - int(jnp.sum(all_data.row_mask))
+        assert spare >= 5
+
+    def test_append_row_fills_first_free_slot(self):
+        p = _single_metric_problem()
+        d = _designer(p)
+        d.update(
+            core_lib.CompletedTrials(
+                _complete(p, np.linspace(0, 1, 3), lambda x: {"obj": x})
+            )
+        )
+        all_data = d._all_points_data(2)
+        n_before = int(jnp.sum(all_data.row_mask))
+        from vizier_tpu.models import kernels as kernels_lib
+
+        x = kernels_lib.MixedFeatures(
+            jnp.full((1, all_data.continuous.shape[-1]), 0.25),
+            jnp.zeros((1, all_data.categorical.shape[-1]), jnp.int32),
+        )
+        grown = _append_row(all_data, x)
+        assert int(jnp.sum(grown.row_mask)) == n_before + 1
+        np.testing.assert_allclose(grown.continuous[n_before], 0.25)
+
+    def test_seed_trials_count_includes_active(self):
+        p = _single_metric_problem()
+        d = _designer(p, num_seed_trials=3)
+        active = [vz.Trial(id=i, parameters={"x": 0.5}) for i in range(1, 4)]
+        d.update(core_lib.CompletedTrials([]), core_lib.ActiveTrials(active))
+        # 3 active >= 3 seeds → GP path (runs ARD on an empty completed set).
+        s = d.suggest(1)
+        assert len(s) == 1
+
+    def test_sample_with_zero_completed_trials(self):
+        """sample()/predict() on a fresh study (active-only) must not crash."""
+        p = _single_metric_problem()
+        d = _designer(p, num_seed_trials=2)
+        active = [vz.Trial(id=i, parameters={"x": 0.3 * i}) for i in (1, 2)]
+        d.update(core_lib.CompletedTrials([]), core_lib.ActiveTrials(active))
+        s = d.suggest(1)
+        samples = d.sample(s, rng=jax.random.PRNGKey(0), num_samples=8)
+        assert samples.shape == (8, 1)
+        assert np.isfinite(samples).all()
+
+    def test_predict_reuses_cached_fit(self):
+        """predict() after suggest() must not retrain the GP."""
+        p = _single_metric_problem()
+        d = _designer(p)
+        d.update(
+            core_lib.CompletedTrials(
+                _complete(p, np.linspace(0, 1, 6), lambda x: {"obj": x})
+            )
+        )
+        s = d.suggest(1)
+        assert d._cached_states is not None
+        states_before = d._cached_states[0]
+        d.predict(s, num_samples=16)
+        assert d._cached_states[0] is states_before  # same fit object
+        # New completed data invalidates the cache.
+        d.update(
+            core_lib.CompletedTrials(
+                _complete(p, [0.55], lambda x: {"obj": x}, start_id=50)
+            )
+        )
+        assert d._cached_states is None
+
+    def test_unwarped_sample_scale(self):
+        """Samples come back in the ORIGINAL metric scale, not warped."""
+        p = _single_metric_problem()
+        d = _designer(p)
+        # Labels around 1000 — warped space is ~[-0.5, 0.5], so unwarping
+        # must restore the magnitude.
+        d.update(
+            core_lib.CompletedTrials(
+                _complete(p, np.linspace(0, 1, 8), lambda x: {"obj": 1000.0 + x})
+            )
+        )
+        s = d.suggest(1)
+        samples = d.sample(s, rng=jax.random.PRNGKey(1), num_samples=32)
+        assert samples.shape == (32, 1)
+        assert 900.0 < np.median(samples) < 1100.0

@@ -1,0 +1,204 @@
+"""Property tests for the output-warper suite.
+
+Mirrors the reference's ``output_warpers_test.py`` coverage: finiteness,
+rank preservation, edge cases (all-equal, all-NaN), outlier removal,
+gaussianization, and warp→unwarp round-trips.
+"""
+
+import numpy as np
+import pytest
+
+from vizier_tpu.models import output_warpers
+
+
+def _rand_labels(n=25, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, 1)) * 10.0 + 3.0
+
+
+class TestDefaultPipeline:
+    def test_finite_and_rank_preserving(self):
+        rng = np.random.default_rng(1)
+        y = rng.normal(size=(40, 1)) * 100
+        out = output_warpers.create_default_warper().warp(y.copy())
+        assert np.isfinite(out).all()
+        # Rank order of finite labels is preserved.
+        assert (np.argsort(out[:, 0]) == np.argsort(y[:, 0])).all()
+
+    def test_all_equal_labels_map_to_zero(self):
+        w = output_warpers.create_default_warper()
+        out = w.warp(np.full((7, 1), 3.25))
+        np.testing.assert_array_equal(out, np.zeros((7, 1)))
+        np.testing.assert_array_equal(w.unwarp(out), np.zeros((7, 1)))
+
+    def test_all_nan_labels_map_to_minus_one(self):
+        w = output_warpers.create_default_warper()
+        out = w.warp(np.full((4, 1), np.nan))
+        np.testing.assert_array_equal(out, -np.ones((4, 1)))
+        assert np.isnan(w.unwarp(out)).all()
+
+    def test_neg_inf_treated_as_infeasible(self):
+        w = output_warpers.create_default_warper()
+        y = np.array([[1.0], [-np.inf], [2.0]])
+        out = w.warp(y)
+        assert np.isfinite(out).all()
+        assert out[1, 0] == out.min()
+
+    def test_pos_inf_rejected(self):
+        w = output_warpers.create_default_warper()
+        with pytest.raises(ValueError):
+            w.warp(np.array([[1.0], [np.inf]]))
+
+    def test_outlier_compressed(self):
+        y = np.concatenate([_rand_labels(30, 2), [[-1e20]]], axis=0)
+        out = output_warpers.create_default_warper().warp(y)
+        assert np.isfinite(out).all()
+        # Warped range is bounded (log warp maps into ~[-0.5, 0.5] + shift).
+        assert out.max() - out.min() < 10.0
+
+    def test_unwarp_round_trip_on_warped_labels(self):
+        w = output_warpers.create_default_warper()
+        y = _rand_labels(20, 3)
+        warped = w.warp(y.copy())
+        back = w.unwarp(warped)
+        np.testing.assert_allclose(back, y, rtol=1e-4, atol=1e-6)
+
+
+class TestLogWarper:
+    def test_range_and_roundtrip(self):
+        w = output_warpers.LogWarper()
+        y = _rand_labels(15, 4)
+        out = w.warp(y.copy())
+        assert (out >= -0.5 - 1e-9).all() and (out <= 0.5 + 1e-9).all()
+        np.testing.assert_allclose(w.unwarp(out), y, rtol=1e-6)
+
+    def test_best_value_maps_to_half(self):
+        w = output_warpers.LogWarper()
+        y = np.array([[1.0], [5.0], [9.0]])
+        out = w.warp(y)
+        assert out[2, 0] == pytest.approx(0.5)
+        assert out[0, 0] == pytest.approx(-0.5)
+
+    def test_nan_passthrough(self):
+        w = output_warpers.LogWarper()
+        out = w.warp(np.array([[1.0], [np.nan], [2.0]]))
+        assert np.isnan(out[1, 0])
+
+
+class TestHalfRank:
+    def test_good_half_untouched(self):
+        w = output_warpers.HalfRankWarper()
+        y = np.array([[0.0], [1.0], [2.0], [3.0], [-1000.0]])
+        out = w.warp(y.copy())
+        np.testing.assert_allclose(out[2:4], y[2:4])
+        assert out.min() > -100
+
+    def test_unwarp_recovers_observed_values(self):
+        w = output_warpers.HalfRankWarper()
+        y = _rand_labels(21, 5)
+        warped = w.warp(y.copy())
+        back = w.unwarp(warped)
+        np.testing.assert_allclose(back, y, rtol=1e-5, atol=1e-7)
+
+    def test_unwarp_extrapolates_below_image(self):
+        w = output_warpers.HalfRankWarper()
+        y = _rand_labels(21, 6)
+        warped = w.warp(y.copy())
+        below = np.full((1, 1), warped.min() - 1.0)
+        back = w.unwarp(below)
+        assert back[0, 0] < y.min()
+
+
+class TestInfeasibleWarper:
+    def test_infeasible_worse_than_all_feasible(self):
+        w = output_warpers.InfeasibleWarper()
+        out = w.warp(np.array([[1.0], [np.nan], [3.0]]))
+        assert np.isfinite(out).all()
+        assert out[1, 0] == out.min()
+
+    def test_unwarp_restores_feasible(self):
+        w = output_warpers.InfeasibleWarper()
+        y = np.array([[1.0], [np.nan], [3.0]])
+        out = w.warp(y.copy())
+        back = w.unwarp(out)
+        np.testing.assert_allclose(back[[0, 2], 0], [1.0, 3.0], rtol=1e-9)
+
+    def test_all_nan_maps_to_zero(self):
+        w = output_warpers.InfeasibleWarper()
+        out = w.warp(np.full((3, 1), np.nan))
+        np.testing.assert_array_equal(out, np.zeros((3, 1)))
+
+    def test_frequency_weighted_mean_is_zero(self):
+        """The documented invariant: shift applies to imputed rows too, so
+        the warped column's mean is exactly zero (GP zero-mean prior)."""
+        w = output_warpers.InfeasibleWarper()
+        out = w.warp(np.array([[0.0], [2.0], [np.nan], [np.nan]]))
+        np.testing.assert_allclose(out[:, 0], [0.5, 2.5, -1.5, -1.5])
+        # p_feasible = 2.5/5 = 0.5 → weighted mean = 0.5*1.5 + 0.5*(-1.5).
+        p = 2.5 / 5.0
+        assert p * np.mean(out[:2, 0]) + (1 - p) * out[2, 0] == pytest.approx(0.0)
+
+    def test_unwarp_inverts_imputed_rows(self):
+        w = output_warpers.InfeasibleWarper()
+        y = np.array([[0.0], [2.0], [np.nan]])
+        out = w.warp(y.copy())
+        back = w.unwarp(out)
+        np.testing.assert_allclose(back[:2, 0], [0.0, 2.0])
+        # Imputed row unwarps back to the raw bad value (lo - (range/2 + 1)).
+        assert back[2, 0] == pytest.approx(-2.0)
+
+
+class TestDetectOutliers:
+    def test_extreme_bad_value_removed(self):
+        y = np.concatenate([_rand_labels(30, 7), [[-1e6]]], axis=0)
+        out = output_warpers.DetectOutliers().warp(y.copy())
+        assert np.isnan(out[-1, 0])
+        assert np.isfinite(out[:-1]).all()
+
+    def test_normal_values_kept(self):
+        y = _rand_labels(30, 8)
+        out = output_warpers.DetectOutliers().warp(y.copy())
+        assert np.isfinite(out).all()
+
+    def test_small_sample_estimator(self):
+        y = np.concatenate([_rand_labels(8, 9), [[-1e8]]], axis=0)
+        out = output_warpers.DetectOutliers().warp(y.copy())
+        assert np.isnan(out[-1, 0])
+
+
+class TestTransformToGaussian:
+    def test_output_roughly_standard_normal(self):
+        y = np.exp(_rand_labels(200, 10) / 5.0)  # heavily skewed
+        out = output_warpers.TransformToGaussian(use_rank=True).warp(y.copy())
+        assert np.isfinite(out).all()
+        assert abs(np.mean(out)) < 0.5
+        assert 0.3 < np.std(out) < 3.0
+
+    def test_rank_preserved(self):
+        y = _rand_labels(50, 11)
+        out = output_warpers.TransformToGaussian().warp(y.copy())
+        assert (np.argsort(out[:, 0]) == np.argsort(y[:, 0])).all()
+
+
+class TestWarpOutliersPipeline:
+    def test_outliers_become_infeasible_then_finite(self):
+        y = np.concatenate([_rand_labels(30, 12), [[-1e30]]], axis=0)
+        out = output_warpers.create_warp_outliers_warper().warp(y.copy())
+        assert np.isfinite(out).all()
+        # The outlier lands at the bottom of the warped scale.
+        assert out[-1, 0] == out.min()
+
+
+class TestNormalizeLabels:
+    def test_maps_to_unit_interval(self):
+        w = output_warpers.NormalizeLabels()
+        y = _rand_labels(10, 13)
+        out = w.warp(y.copy())
+        assert out.min() == pytest.approx(0.0)
+        assert out.max() == pytest.approx(1.0)
+        np.testing.assert_allclose(w.unwarp(out), y, rtol=1e-9)
+
+    def test_all_equal_to_midpoint(self):
+        w = output_warpers.NormalizeLabels(target_interval=(-1.0, 1.0))
+        out = w.warp(np.full((5, 1), 7.0))
+        np.testing.assert_array_equal(out, np.zeros((5, 1)))

@@ -272,7 +272,10 @@ class TestReviewRegressions:
             trials.append(t)
         d.update(core_lib.CompletedTrials(trials))
         (s, _) = d.suggest(2)
-        assert s.metadata.ns("gp_bandit")["acquisition_kind"] == "hv_scalarized_ucb"
+        # Multi-objective studies are handled NATIVELY by the default
+        # algorithm (HV-scalarized UCB + scalarized PE penalties), not routed
+        # away to the GP-bandit path.
+        assert "use_ucb" in s.metadata.ns("gp_ucb_pe")
 
     def test_safety_warp_clears_measurement(self):
         from vizier_tpu.pyvizier import multimetric

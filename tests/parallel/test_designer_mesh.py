@@ -1,0 +1,101 @@
+"""The sharded data plane IS the production designer path (VERDICT r1 #2).
+
+Asserts that designers auto-build a mesh, route ARD restarts + acquisition
+pools through ``vizier_tpu.parallel``, and that an 8-device mesh suggest()
+agrees with the single-device suggest() within tolerance on a peaked
+objective.
+"""
+
+import numpy as np
+import jax
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.designers.gp_bandit import VizierGPBandit
+from vizier_tpu.designers.gp_ucb_pe import UCBPEConfig, VizierGPUCBPEBandit
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+
+_FAST_ARD = lbfgs_lib.LbfgsOptimizer(maxiter=25)
+
+
+def _problem():
+    p = vz.ProblemStatement()
+    p.search_space.root.add_float_param("x", 0.0, 1.0)
+    p.search_space.root.add_float_param("y", 0.0, 1.0)
+    p.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return p
+
+
+def _trials(n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    trials = []
+    for i in range(n):
+        x, y = rng.uniform(), rng.uniform()
+        t = vz.Trial(id=i + 1, parameters={"x": float(x), "y": float(y)})
+        t.complete(
+            vz.Measurement(
+                metrics={"obj": -((x - 0.62) ** 2) - (y - 0.31) ** 2}
+            )
+        )
+        trials.append(t)
+    return trials
+
+
+def _suggest_xy(designer, count=1):
+    designer.update(core_lib.CompletedTrials(_trials()))
+    s = designer.suggest(count)
+    return np.array(
+        [[float(si.parameters["x"].value), float(si.parameters["y"].value)] for si in s]
+    )
+
+
+class TestDesignerMeshIsProductionPath:
+    def test_gp_bandit_builds_mesh_automatically(self, monkeypatch):
+        monkeypatch.delenv("VIZIER_DISABLE_MESH", raising=False)
+        d = VizierGPBandit(_problem())
+        assert d._mesh is not None
+        assert len(d._mesh.devices.flat) == len(jax.devices())
+
+    def test_mesh_suggest_matches_single_device(self):
+        kwargs = dict(
+            ard_restarts=8,
+            ard_optimizer=_FAST_ARD,
+            max_acquisition_evaluations=2000,
+            num_seed_trials=2,
+            rng_seed=3,
+        )
+        single = VizierGPBandit(_problem(), use_mesh=False, **kwargs)
+        meshed = VizierGPBandit(_problem(), use_mesh=True, **kwargs)
+        assert meshed._mesh is not None and len(meshed._mesh.devices.flat) == 8
+        xy_single = _suggest_xy(single)[0]
+        xy_meshed = _suggest_xy(meshed)[0]
+        # Both must land near the optimum (0.62, 0.31); the sharded path runs
+        # 8 independent pools so exact equality is not expected.
+        assert np.linalg.norm(xy_single - np.array([0.62, 0.31])) < 0.25
+        assert np.linalg.norm(xy_meshed - np.array([0.62, 0.31])) < 0.25
+        assert np.linalg.norm(xy_single - xy_meshed) < 0.3
+
+    def test_ucb_pe_default_runs_on_mesh(self):
+        d = VizierGPUCBPEBandit(
+            _problem(),
+            use_mesh=True,
+            ard_restarts=8,
+            ard_optimizer=_FAST_ARD,
+            max_acquisition_evaluations=600,
+            config=UCBPEConfig(num_scalarizations=16),
+        )
+        assert d._mesh is not None
+        xy = _suggest_xy(d, count=3)
+        assert xy.shape == (3, 2)
+        assert np.isfinite(xy).all()
+
+    def test_mesh_restarts_round_up_to_device_multiple(self):
+        d = VizierGPBandit(
+            _problem(), use_mesh=True, ard_restarts=5, ard_optimizer=_FAST_ARD
+        )
+        # 5 restarts on 8 devices → padded to 8 at the _train boundary.
+        ndev = d._mesh_size()
+        restarts = -(-d.ard_restarts // ndev) * ndev
+        assert restarts == 8

@@ -17,6 +17,7 @@ def __getattr__(name):
     lazy = {
         "VizierGPBandit": ("vizier_tpu.designers.gp_bandit", "VizierGPBandit"),
         "VizierGPUCBPEBandit": ("vizier_tpu.designers.gp_ucb_pe", "VizierGPUCBPEBandit"),
+        "UCBPEConfig": ("vizier_tpu.designers.gp_ucb_pe", "UCBPEConfig"),
         "NSGA2Designer": ("vizier_tpu.designers.evolution", "NSGA2Designer"),
         "CMAESDesigner": ("vizier_tpu.designers.cmaes", "CMAESDesigner"),
         "EagleStrategyDesigner": ("vizier_tpu.designers.eagle_strategy", "EagleStrategyDesigner"),

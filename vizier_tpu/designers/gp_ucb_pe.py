@@ -1,24 +1,42 @@
 """VizierGPUCBPEBandit: the DEFAULT algorithm (GP-UCB with Pure Exploration).
 
-Parity with ``/root/reference/vizier/_src/algorithms/designers/gp_ucb_pe.py:609``
-(the service default, ``policy_factory.py:40-47``; algorithm from Contal et
-al., "Parallel Gaussian Process Optimization with UCB and Pure Exploration"):
-the first suggestion of a batch maximizes UCB; the rest maximize posterior
-stddev (pure exploration) restricted to the *relevant region*
-``{x : UCB(x) >= max LCB}``, with the GP fantasy-conditioned on each picked
-point (label = posterior mean) so PE picks don't collapse onto each other.
+Parity with ``/root/reference/vizier/_src/algorithms/designers/gp_ucb_pe.py``
+(config ``:80``, score functions ``:282,384,510``, designer ``:609`` — the
+service default, ``policy_factory.py:40-47``; algorithm from Contal et al.,
+"Parallel Gaussian Process Optimization with UCB and Pure Exploration"):
 
-TPU-first: the WHOLE batch loop — per-pick Cholesky re-conditioning, region
-penalty, and the eagle acquisition sweep — is one jitted ``fori_loop``;
-fantasy points are written into spare padded rows of the same ``GPData`` (no
-reshapes, no retraces across batch sizes within a padding bucket).
+- Two conditioned posteriors: ``completed`` (observed labels) and ``all``
+  (completed + pending/active + already-picked batch points, labels ignored
+  — only the stddev matters, and GP posterior stddev is label-free).
+- **UCB score** = mean(completed) + c·stddev(all): pending points deflate
+  the stddev so concurrent workers do not duplicate suggestions.
+- **PE score** = stddev(all) + penalty·min(explore_ucb − threshold, 0) where
+  the threshold is the completed-posterior *mean at the argmax-UCB point*
+  over observed+pending features, and explore_ucb uses its own (smaller)
+  coefficient — pure exploration restricted to the promising region.
+- **UCB/PE choice** per pick: fresh completed trials → UCB except w.p.
+  ``pe_overwrite_probability`` (raised in the high-noise regime detected by
+  the signal-to-noise threshold); otherwise PE except w.p.
+  ``ucb_overwrite_probability``. Within a batch, picks after the first see
+  the earlier picks as pending, so they explore.
+- **Multimetric**: per-metric independent GPs; UCB hypervolume-scalarized
+  along random directions (clamped at the observed labels' scalarization);
+  PE penalty scalarized by union/intersection/average across metrics.
+- **Set acquisition** (optional): the PE batch is optimized *jointly* —
+  log-det of the batch posterior covariance — instead of greedily.
+
+TPU-first: the WHOLE batch loop — per-pick Cholesky re-conditioning on the
+growing pending set, penalty, and the eagle acquisition sweep — is one
+jitted ``fori_loop``; picks are written into spare padded rows (no reshapes
+or retraces within a padding bucket), and ensemble members × metrics are
+``vmap``-batched Cholesky factorizations that XLA maps onto the MXU.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,100 +48,395 @@ from vizier_tpu.designers import gp_bandit
 from vizier_tpu.designers.gp import acquisitions
 from vizier_tpu.models import gp as gp_lib
 from vizier_tpu.models import kernels
-from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+from vizier_tpu.models import output_warpers
+from vizier_tpu.optimizers import eagle as eagle_lib
 from vizier_tpu.optimizers import vectorized as vectorized_lib
 from vizier_tpu.pyvizier import base_study_config
 from vizier_tpu.pyvizier import trial as trial_
 
 Array = jax.Array
 
+_PE_NOISE_STDDEV = 1e-5  # noise floor for the all-predictive in high noise
 
-def _append_fantasy(
-    data: gp_lib.GPData, x: kernels.MixedFeatures, label: Array
+
+@dataclasses.dataclass(frozen=True)
+class UCBPEConfig:
+    """UCB-PE config (reference ``UCBPEConfig``, ``gp_ucb_pe.py:80-132``).
+
+    Frozen/hashable so it rides into jitted programs as a static argument.
+    """
+
+    ucb_coefficient: float = 1.8
+    # A separate (smaller) coefficient defining the region worth exploring.
+    explore_region_ucb_coefficient: float = 0.5
+    # Slope of the linear penalty for violating UCB(x) >= threshold.
+    cb_violation_penalty_coefficient: float = 10.0
+    # P(UCB) when there are NO new completed trials.
+    ucb_overwrite_probability: float = 0.25
+    # P(PE) when there ARE new completed trials.
+    pe_overwrite_probability: float = 0.1
+    # Same, in the detected-high-noise regime.
+    pe_overwrite_probability_in_high_noise: float = 0.7
+    # signal/noise variance ratio below which noise is considered high
+    # (0 disables the high-noise behaviors).
+    signal_to_noise_threshold: float = 0.7
+    # Optimize the exploration batch jointly (log-det set acquisition).
+    optimize_set_acquisition_for_exploration: bool = False
+    # Multimetric promising-region penalty: union | intersection | average.
+    multimetric_promising_region_penalty_type: str = "average"
+    # Random HV-scalarization directions for multimetric UCB.
+    num_scalarizations: int = 1000
+
+    def __post_init__(self):
+        if self.multimetric_promising_region_penalty_type not in (
+            "union",
+            "intersection",
+            "average",
+        ):
+            raise ValueError(
+                "multimetric_promising_region_penalty_type must be one of "
+                "'union' | 'intersection' | 'average', got "
+                f"{self.multimetric_promising_region_penalty_type!r}."
+            )
+
+
+def _mixture_predict(
+    states, query: kernels.MixedFeatures
+) -> Tuple[Array, Array]:
+    """Moment-matched mixture over the ensemble axis, per metric.
+
+    ``states``: GPState pytree with leading axes [M, E]. Returns
+    ([M, Q] mean, [M, Q] stddev).
+    """
+    means, stddevs = jax.vmap(jax.vmap(lambda s: s.predict(query)))(states)
+    mean = jnp.mean(means, axis=1)
+    second = jnp.mean(stddevs**2 + means**2, axis=1)
+    var = jnp.maximum(second - mean**2, 1e-12)
+    return mean, jnp.sqrt(var)
+
+
+def _pe_conditioning(
+    states_completed: gp_lib.GPState,  # [M, E]
+    all_data: gp_lib.GPData,
+    config: UCBPEConfig,
+) -> Tuple[dict, Array, Array]:
+    """(pe_params, noise_is_high, threshold[M]): shared UCB-PE conditioning.
+
+    - High-noise detection: all ensemble members' signal/noise variance
+      ratios below the config threshold → the all-points predictive gets a
+      near-zero noise floor so pending points fully deflate local stddev.
+    - Promising-region threshold: completed-posterior mean at the
+      argmax-UCB point among observed + pending features, per metric.
+    """
+    params = states_completed.params  # constrained, [M, E] leaves
+    snr = (params["amplitude"] / params["noise_stddev"]) ** 2
+    noise_is_high = jnp.all(snr < config.signal_to_noise_threshold) & (
+        config.signal_to_noise_threshold > 0.0
+    )
+    pe_params = dict(params)
+    pe_params["noise_stddev"] = jnp.where(
+        noise_is_high, _PE_NOISE_STDDEV, params["noise_stddev"]
+    )
+    all_pts = all_data.features()
+    mean_at, std_at = _mixture_predict(states_completed, all_pts)  # [M, N2]
+    ucb_at = jnp.where(
+        all_data.row_mask[None, :],
+        mean_at + config.ucb_coefficient * std_at,
+        -jnp.inf,
+    )
+    threshold = jnp.take_along_axis(
+        mean_at, jnp.argmax(ucb_at, axis=-1, keepdims=True), axis=-1
+    )[:, 0]  # [M]
+    return pe_params, noise_is_high, threshold
+
+
+def _append_row(
+    data: gp_lib.GPData, x: kernels.MixedFeatures
 ) -> gp_lib.GPData:
-    """Writes (x, label) into the first padded row (no-op if at capacity)."""
+    """Writes x into the first free padded row (labels stay 0: stddev-only)."""
     idx = jnp.sum(data.row_mask.astype(jnp.int32))  # first free slot
     return gp_lib.GPData(
         continuous=data.continuous.at[idx].set(x.continuous[0]),
         categorical=data.categorical.at[idx].set(x.categorical[0]),
-        labels=data.labels.at[idx].set(label),
+        labels=data.labels,
         row_mask=data.row_mask.at[idx].set(True),
         cont_dim_mask=data.cont_dim_mask,
         cat_dim_mask=data.cat_dim_mask,
     )
 
 
+def _hv_scalarized(
+    values: Array,  # [M, Q] per-metric acquisition values
+    weights: Array,  # [K, M] positive scalarization directions
+    ref_point: Array,  # [M]
+    labels: Array,  # [M, N] warped labels (completed)
+    labels_mask: Array,  # [N]
+) -> Array:
+    """Random-direction hypervolume scalarization, clamped at the labels.
+
+    Reference ``UCBScoreFunction.score_with_aux`` + ``create_hv_scalarization``
+    (``acquisitions.py:571``, https://arxiv.org/abs/2006.04655): scalarize
+    per direction as min_m((v_m - ref_m)/w_m)^M, floor each direction at the
+    best scalarized observed label, then average over directions.
+    """
+    m = values.shape[0]
+    inv_w = 1.0 / jnp.maximum(weights, 1e-6)  # [K, M]
+    shifted = jnp.maximum(values - ref_point[:, None], 0.0)  # [M, Q]
+    per_dir = jnp.min(inv_w[:, :, None] * shifted[None, :, :], axis=1) ** m  # [K, Q]
+    lab_shifted = jnp.maximum(labels - ref_point[:, None], 0.0)  # [M, N]
+    lab_per_dir = jnp.min(inv_w[:, :, None] * lab_shifted[None, :, :], axis=1) ** m
+    lab_best = jnp.max(
+        jnp.where(labels_mask[None, :], lab_per_dir, -jnp.inf), axis=-1
+    )  # [K]
+    return jnp.mean(jnp.maximum(per_dir, lab_best[:, None]), axis=0)  # [Q]
+
+
+def _scalarize_penalty(penalty: Array, mode: str) -> Array:
+    """[M, Q] per-metric promising-region penalties → [Q] (reference modes)."""
+    if mode == "union":
+        return jnp.max(penalty, axis=0)
+    if mode == "intersection":
+        return jnp.min(penalty, axis=0)
+    return jnp.mean(penalty, axis=0)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "model",
-        "vec_opt",
-        "count",
-        "ucb_coefficient",
-        "explore_coefficient",
-        "use_trust_region",
+        "model", "vec_opt", "count", "config", "use_trust_region", "mesh"
     ),
 )
 def _suggest_batch(
     model: gp_lib.VizierGaussianProcess,
     vec_opt: vectorized_lib.VectorizedOptimizer,
-    ens_params: gp_lib.Params,  # unconstrained, leading ensemble axis
-    data: gp_lib.GPData,
+    states_completed: gp_lib.GPState,  # leading axes [M, E]
+    all_data: gp_lib.GPData,  # completed+active rows valid; labels 0
+    labels_mn: Array,  # [M, N1] warped labels of the completed data
+    labels_mask: Array,  # [N1]
+    ref_point: Array,  # [M]
+    prior_features: kernels.MixedFeatures,
     rng: Array,
+    first_has_new: Array,  # scalar bool: new completed since last active
+    has_completed: Array,  # scalar bool
     count: int,
-    ucb_coefficient: float,
-    explore_coefficient: float,
+    config: UCBPEConfig,
     use_trust_region: bool = True,
-) -> vectorized_lib.VectorizedOptimizerResult:
-    """UCB pick then PE picks with fantasy conditioning; all on device."""
-    dc = data.continuous.shape[-1]
-    ds = data.categorical.shape[-1]
+    mesh=None,  # jax.sharding.Mesh: shard the per-pick sweep's eagle pools
+) -> Tuple[vectorized_lib.VectorizedOptimizerResult, dict]:
+    """The greedy batch: per pick, UCB-or-PE with pending-point conditioning."""
+    dc = all_data.continuous.shape[-1]
+    ds = all_data.categorical.shape[-1]
+    num_metrics = labels_mn.shape[0]
+
+    trust = (
+        acquisitions.TrustRegion.from_data(all_data) if use_trust_region else None
+    )
 
     def pick(b, carry):
-        data, out_cont, out_cat, out_scores, rng = carry
-        rng, opt_rng = jax.random.split(rng)
-        states = jax.vmap(lambda p: model.precompute(p, data))(ens_params)
-        predictive = gp_lib.EnsemblePredictive(states)
-        trust = acquisitions.TrustRegion.from_data(data) if use_trust_region else None
+        all_data, out_cont, out_cat, out_scores, aux, rng = carry
+        rng, ucb_rng, w_rng, opt_rng = jax.random.split(rng, 4)
 
-        # Relevant-region threshold: max LCB over observed points.
-        obs = kernels.MixedFeatures(data.continuous, data.categorical)
-        obs_mean, obs_std = predictive.predict(obs)
-        lcb_obs = obs_mean - ucb_coefficient * obs_std
-        y_star = jnp.max(jnp.where(data.row_mask, lcb_obs, -jnp.inf))
+        # Shared conditioning, recomputed on the grown pending set.
+        pe_params, noise_is_high, threshold = _pe_conditioning(
+            states_completed, all_data, config
+        )
+        # Re-condition the all-points posterior on the grown pending set.
+        states_all = jax.vmap(
+            jax.vmap(lambda p: model.precompute_constrained(p, all_data))
+        )(pe_params)
+
+        # Pick-level UCB/PE decision (reference `_suggest_one` logic).
+        pe_p = jnp.where(
+            noise_is_high,
+            config.pe_overwrite_probability_in_high_noise,
+            config.pe_overwrite_probability,
+        )
+        use_ucb = jnp.where(
+            (b == 0) & first_has_new,
+            ~jax.random.bernoulli(ucb_rng, pe_p),
+            has_completed
+            & jax.random.bernoulli(ucb_rng, config.ucb_overwrite_probability),
+        )
+
+        weights = jnp.abs(
+            jax.random.normal(
+                w_rng, (config.num_scalarizations, num_metrics), jnp.float32
+            )
+        )
+        weights = weights / jnp.linalg.norm(weights, axis=-1, keepdims=True)
 
         def score_fn(query: kernels.MixedFeatures) -> Array:
-            mean, stddev = predictive.predict(query)
-            ucb = mean + ucb_coefficient * stddev
-            # b == 0: UCB. b > 0: PE (stddev) penalized outside the region
-            # where UCB >= y_star.
-            pe = explore_coefficient * stddev - 10.0 * jnp.maximum(y_star - ucb, 0.0)
-            value = jnp.where(b == 0, ucb, pe)
+            mean_c, std_c = _mixture_predict(states_completed, query)  # [M, Q]
+            _, std_all = _mixture_predict(states_all, query)  # [M, Q]
+            ucb_vals = mean_c + config.ucb_coefficient * std_all
+            if num_metrics == 1:
+                ucb_score = ucb_vals[0]
+            else:
+                ucb_score = _hv_scalarized(
+                    ucb_vals, weights, ref_point, labels_mn, labels_mask
+                )
+            explore_ucb = mean_c + config.explore_region_ucb_coefficient * std_c
+            penalty = config.cb_violation_penalty_coefficient * jnp.minimum(
+                explore_ucb - threshold[:, None], 0.0
+            )
+            if num_metrics == 1:
+                pe_score = std_all[0] + penalty[0]
+            else:
+                pe_score = jnp.mean(std_all, axis=0) + _scalarize_penalty(
+                    penalty, config.multimetric_promising_region_penalty_type
+                )
+            value = jnp.where(use_ucb, ucb_score, pe_score)
             if trust is not None:
                 value = value - trust.penalty(query)
             return value
 
-        result = vec_opt(score_fn, opt_rng, count=1)
+        if mesh is None:
+            result = vec_opt(
+                score_fn, opt_rng, count=1, prior_features=prior_features
+            )
+        else:
+            from vizier_tpu import parallel
+
+            result = parallel.maximize_score_fn_sharded(
+                vec_opt, score_fn, opt_rng, 1,
+                len(mesh.devices.flat), mesh, prior_features,
+            )
         x = kernels.MixedFeatures(
             result.features.continuous[:1], result.features.categorical[:1]
         )
-        mean, _ = predictive.predict(x)
-        data = _append_fantasy(data, x, mean[0])
+        mean_x, std_x = _mixture_predict(states_completed, x)  # [M, 1]
+        _, std_all_x = _mixture_predict(states_all, x)
+        all_data = _append_row(all_data, x)
         out_cont = out_cont.at[b].set(x.continuous[0])
         out_cat = out_cat.at[b].set(x.categorical[0])
         out_scores = out_scores.at[b].set(result.scores[0])
-        return data, out_cont, out_cat, out_scores, rng
+        aux = dict(
+            mean=aux["mean"].at[b].set(mean_x[:, 0]),
+            stddev=aux["stddev"].at[b].set(std_x[:, 0]),
+            stddev_from_all=aux["stddev_from_all"].at[b].set(std_all_x[:, 0]),
+            use_ucb=aux["use_ucb"].at[b].set(use_ucb),
+        )
+        return all_data, out_cont, out_cat, out_scores, aux, rng
 
+    init_aux = dict(
+        mean=jnp.zeros((count, num_metrics), jnp.float32),
+        stddev=jnp.zeros((count, num_metrics), jnp.float32),
+        stddev_from_all=jnp.zeros((count, num_metrics), jnp.float32),
+        use_ucb=jnp.zeros((count,), bool),
+    )
     init = (
-        data,
-        jnp.zeros((count, dc), data.continuous.dtype),
-        jnp.zeros((count, ds), data.categorical.dtype),
+        all_data,
+        jnp.zeros((count, dc), all_data.continuous.dtype),
+        jnp.zeros((count, ds), all_data.categorical.dtype),
         jnp.zeros((count,), jnp.float32),
+        init_aux,
         rng,
     )
-    _, out_cont, out_cat, out_scores, _ = jax.lax.fori_loop(0, count, pick, init)
-    return vectorized_lib.VectorizedOptimizerResult(
-        kernels.MixedFeatures(out_cont, out_cat), out_scores
+    _, out_cont, out_cat, out_scores, aux, _ = jax.lax.fori_loop(
+        0, count, pick, init
+    )
+    aux["trust_radius"] = (
+        trust.trust_radius() if trust is not None else jnp.asarray(jnp.inf)
+    )
+    return (
+        vectorized_lib.VectorizedOptimizerResult(
+            kernels.MixedFeatures(out_cont, out_cat), out_scores
+        ),
+        aux,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "vec_opt", "q", "config", "use_trust_region"),
+)
+def _suggest_set_pe(
+    model: gp_lib.VizierGaussianProcess,
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    states_completed: gp_lib.GPState,  # [M=1, E]
+    all_data: gp_lib.GPData,
+    rng: Array,
+    q: int,
+    config: UCBPEConfig,
+    use_trust_region: bool = True,
+) -> Tuple[vectorized_lib.VectorizedOptimizerResult, dict]:
+    """Joint exploration batch: maximize log-det of the set's posterior cov.
+
+    Reference ``SetPEScoreFunction`` (``gp_ucb_pe.py:510``, eq. (8) of
+    Contal et al.): candidates are whole q-point sets, searched in the
+    flattened (q·D)-space by the same eagle strategy; single-metric only.
+    """
+    dc = all_data.continuous.shape[-1]
+    ds = all_data.categorical.shape[-1]
+
+    pe_params, _, thresholds = _pe_conditioning(
+        states_completed, all_data, config
+    )
+    threshold = thresholds[0]  # single metric
+    states_all = jax.vmap(
+        jax.vmap(lambda p: model.precompute_constrained(p, all_data))
+    )(pe_params)
+    # Flatten [M=1, E] -> [E] for the joint-covariance math.
+    states_all_e = jax.tree_util.tree_map(lambda a: a[0], states_all)
+    trust = (
+        acquisitions.TrustRegion.from_data(all_data) if use_trust_region else None
+    )
+
+    def score_fn(flat: kernels.MixedFeatures) -> Array:
+        bsz = flat.continuous.shape[0]
+        pts_c = flat.continuous.reshape(bsz, q, dc)
+        pts_s = flat.categorical.reshape(bsz, q, ds)
+
+        def per_candidate(cont: Array, cat: Array) -> Array:
+            query = kernels.MixedFeatures(cont, cat)
+            means, covs = jax.vmap(lambda s: s.predict_joint(query))(
+                states_all_e
+            )  # [E, q], [E, q, q]
+            mu = jnp.mean(means, axis=0)
+            # Moment-matched mixture covariance over ensemble members.
+            cov = (
+                jnp.mean(covs + means[:, :, None] * means[:, None, :], axis=0)
+                - mu[:, None] * mu[None, :]
+            )
+            chol = jnp.linalg.cholesky(
+                cov + 1e-6 * jnp.eye(q, dtype=cov.dtype)
+            )
+            logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol)))
+            logdet = jnp.where(jnp.isnan(logdet), -jnp.inf, logdet)
+            mean_c, std_c = _mixture_predict(states_completed, query)  # [1, q]
+            explore_ucb = (
+                mean_c[0] + config.explore_region_ucb_coefficient * std_c[0]
+            )
+            value = logdet + config.cb_violation_penalty_coefficient * jnp.sum(
+                jnp.minimum(explore_ucb - threshold, 0.0)
+            )
+            if trust is not None:
+                value = value - jnp.sum(trust.penalty(query))
+            return value
+
+        return jax.vmap(per_candidate)(pts_c, pts_s)
+
+    result = vec_opt(score_fn, rng, count=1)
+    # Unflatten the winning set into q suggestions.
+    cont_rows = result.features.continuous[0].reshape(q, dc)
+    cat_rows = result.features.categorical[0].reshape(q, ds)
+    set_query = kernels.MixedFeatures(cont_rows, cat_rows)
+    mean_x, std_x = _mixture_predict(states_completed, set_query)  # [1, q]
+    _, std_all_x = _mixture_predict(states_all, set_query)
+    aux = dict(
+        mean=mean_x.T,  # [q, 1]
+        stddev=std_x.T,
+        stddev_from_all=std_all_x.T,
+        use_ucb=jnp.zeros((q,), bool),
+        trust_radius=(
+            trust.trust_radius() if trust is not None else jnp.asarray(jnp.inf)
+        ),
+    )
+    return (
+        vectorized_lib.VectorizedOptimizerResult(
+            set_query, jnp.full((q,), result.scores[0])
+        ),
+        aux,
     )
 
 
@@ -131,59 +444,281 @@ def _suggest_batch(
 class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
     """GP-UCB-PE batch designer (service DEFAULT)."""
 
-    explore_coefficient: float = 1.0
+    config: UCBPEConfig = UCBPEConfig()
+    num_seed_trials: int = 1  # reference default: center point first
+
+    def __post_init__(self):
+        super().__post_init__()
+        self._active_trials: List[trial_.Trial] = []
+        self._metric_warpers: List[output_warpers.WarperPipeline] = []
+        self._warpers_fitted = False
+        # Trained per-metric states, reused until new data arrives (predict/
+        # sample after a suggest must not pay a second ARD optimization).
+        self._cached_states = None
+        # Joint set-PE optimizers are built lazily per batch size.
+        self._set_opt_cache: dict = {}
+
+    # -- Designer ----------------------------------------------------------
+
+    def update(
+        self,
+        completed: core_lib.CompletedTrials,
+        all_active: core_lib.ActiveTrials = core_lib.ActiveTrials(),
+    ) -> None:
+        if completed.trials:
+            self._cached_states = None  # new labels invalidate the GP fit
+        self._trials.extend(completed.trials)
+        self._active_trials = list(all_active.trials)
+
+    def _has_new_completed_trials(self) -> bool:
+        """True iff a completed trial postdates every active trial's creation
+        (reference ``_has_new_completed_trials``, ``gp_ucb_pe.py:142``)."""
+        if not self._trials:
+            return False
+        if not self._active_trials:
+            return True
+        completion = [t.completion_time for t in self._trials if t.completion_time]
+        creation = [t.creation_time for t in self._active_trials if t.creation_time]
+        if not completion or not creation:
+            return True
+        return max(completion) > max(creation)
+
+    def _objective_indices(self) -> List[int]:
+        return [
+            j
+            for j, m in enumerate(self.problem.metric_information)
+            if not m.is_safety_metric
+        ]
+
+    def _train_states_me(self) -> Tuple[gp_lib.GPState, List[gp_lib.GPData]]:
+        """Per-metric GP training: GPState with leading [M, E] + the datas.
+
+        Cached between calls until update() delivers new completed trials —
+        predict()/sample() right after a suggest() reuse the same fit.
+        """
+        if self._cached_states is not None:
+            return self._cached_states
+        conv = self._converter
+        raw = conv.metrics.encode(self._trials)  # [N, M_all], all-MAXIMIZE
+        features, n_pad = self._padded_features(self._trials)
+        ensemble = max(self.ensemble_size, 1)
+        datas, states_list = [], []
+        self._metric_warpers = []
+        self._warpers_fitted = raw.shape[0] > 0
+        for j in self._objective_indices():
+            warper = output_warpers.create_default_warper()
+            warped = warper(raw[:, j]) if raw.shape[0] else raw[:, j]
+            self._metric_warpers.append(warper)
+            data = gp_lib.GPData.from_model_data(
+                types.ModelData(features, self._padded_labels(warped, n_pad))
+            )
+            datas.append(data)
+            # Mesh-aware: restarts shard over devices when a mesh is present.
+            states_list.append(self._train(data, self._next_rng(), ensemble))
+        states_me = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *states_list
+        )
+        self._cached_states = (states_me, datas)
+        return self._cached_states
+
+    def _all_points_data(self, count: int) -> gp_lib.GPData:
+        """GPData over completed+active rows with capacity for the picks."""
+        all_trials = list(self._trials) + list(self._active_trials)
+        features, n_pad = self._padded_features(all_trials, extra_rows=count)
+        spare = n_pad - len(all_trials)
+        if spare < count:  # capacity guard: _append_row must never no-op
+            raise RuntimeError(
+                f"Padded capacity {n_pad} leaves {spare} spare rows for a "
+                f"batch of {count}; padding schedule must reserve the batch."
+            )
+        zero_labels = types.PaddedArray.from_array(
+            np.zeros((len(all_trials), 1), np.float32), (n_pad, 1), fill_value=np.nan
+        )
+        return gp_lib.GPData.from_model_data(
+            types.ModelData(features, zero_labels)
+        )
 
     def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
         count = count or 1
-        n = len(self._trials)
-        if n < self.num_seed_trials:
+        if len(self._trials) + len(self._active_trials) < self.num_seed_trials:
             return self._seed_suggestions(count)
-        # Multi-objective and transfer-learning studies route through the
-        # parent's dedicated paths (UCB-PE batching is single-objective).
-        if self._num_objectives() > 1:
-            return self._suggest_multiobjective(count)
         if getattr(self, "_priors", None):
             return self._suggest_with_priors(count)
 
-        # Reserve padded capacity for the batch's fantasy rows.
-        conv = self._converter
-        data = gp_lib.GPData.from_model_data(
-            self._warped_model_data(extra_rows=count)
-        )
-
-        coll = self._model.param_collection()
-        inits = coll.batch_random_init_unconstrained(self._next_rng(), self.ard_restarts)
-        loss_fn = lambda p: self._model.neg_log_likelihood(p, data)
-        result = self._ard(loss_fn, inits, best_n=max(self.ensemble_size, 1))
+        states_me, datas = self._train_states_me()
         self._last_predictive = gp_lib.EnsemblePredictive(
-            jax.vmap(lambda p: self._model.precompute(p, data))(result.params)
+            jax.tree_util.tree_map(lambda a: a[0], states_me)
         )
+        all_data = self._all_points_data(count)
+        num_metrics = len(datas)
+        if num_metrics > 1 and self.config.optimize_set_acquisition_for_exploration:
+            raise ValueError(
+                "optimize_set_acquisition_for_exploration supports exactly "
+                "one objective metric."
+            )
 
-        batch = _suggest_batch(
+        labels_mn = jnp.stack([d.labels for d in datas])  # [M, N1]
+        labels_mask = datas[0].row_mask
+        # Reference point: nadir − 0.01·range of the warped labels.
+        lab_valid = jnp.where(labels_mask[None, :], labels_mn, jnp.nan)
+        lo = jnp.nan_to_num(jnp.nanmin(lab_valid, axis=-1), nan=0.0)
+        hi = jnp.nan_to_num(jnp.nanmax(lab_valid, axis=-1), nan=0.0)
+        ref_point = lo - 0.01 * jnp.maximum(hi - lo, 1e-6)
+
+        first_has_new = jnp.asarray(self._has_new_completed_trials())
+        has_completed = jnp.asarray(bool(self._trials))
+
+        if (
+            self.config.optimize_set_acquisition_for_exploration
+            and count > 1
+        ):
+            return self._suggest_with_set_acquisition(
+                count, states_me, all_data, labels_mn, labels_mask, ref_point,
+                first_has_new, has_completed, datas,
+            )
+
+        batch, aux = _suggest_batch(
             self._model,
             self._vec_opt,
-            result.params,
-            data,
+            states_me,
+            all_data,
+            labels_mn,
+            labels_mask,
+            ref_point,
+            self._prior_features(datas[0]),
             self._next_rng(),
+            first_has_new,
+            has_completed,
             count,
-            self.ucb_coefficient,
-            self.explore_coefficient,
+            self.config,
+            self.use_trust_region,
+            self._mesh,
+        )
+        return self._decode_ucb_pe(batch, aux, count)
+
+    def _suggest_with_set_acquisition(
+        self, count, states_me, all_data, labels_mn, labels_mask, ref_point,
+        first_has_new, has_completed, datas,
+    ) -> List[trial_.TrialSuggestion]:
+        """Reference flow: one UCB pick if fresh data, then a joint PE set."""
+        suggestions: List[trial_.TrialSuggestion] = []
+        if bool(first_has_new):
+            first, aux1 = _suggest_batch(
+                self._model, self._vec_opt, states_me, all_data,
+                labels_mn, labels_mask, ref_point,
+                self._prior_features(datas[0]), self._next_rng(),
+                first_has_new, has_completed, 1, self.config,
+                self.use_trust_region, self._mesh,
+            )
+            suggestions.extend(self._decode_ucb_pe(first, aux1, 1))
+            all_data = _append_row(
+                all_data,
+                kernels.MixedFeatures(
+                    first.features.continuous[:1], first.features.categorical[:1]
+                ),
+            )
+        q = count - len(suggestions)
+        set_opt = self._set_opt_cache.get(q)
+        if set_opt is None:
+            enc = self._converter.encoder
+            cat_sizes = tuple(enc.category_sizes) + (1,) * (
+                self._cat_width - enc.num_categorical
+            )
+            strategy = eagle_lib.VectorizedEagleStrategy(
+                num_continuous=self._cont_width * q,
+                category_sizes=cat_sizes * q,
+            )
+            set_opt = vectorized_lib.VectorizedOptimizer(
+                strategy, max_evaluations=self.max_acquisition_evaluations
+            )
+            self._set_opt_cache[q] = set_opt
+        result, aux = _suggest_set_pe(
+            self._model,
+            set_opt,
+            states_me,
+            all_data,
+            self._next_rng(),
+            q,
+            self.config,
             self.use_trust_region,
         )
-        cont_rows = np.asarray(batch.features.continuous)
-        cat_rows = np.asarray(batch.features.categorical)
-        scores = np.asarray(batch.scores)
+        suggestions.extend(self._decode_ucb_pe(result, aux, q))
+        return suggestions
+
+    def _decode_ucb_pe(
+        self, result: vectorized_lib.VectorizedOptimizerResult, aux: dict, count: int
+    ) -> List[trial_.TrialSuggestion]:
+        conv = self._converter
+        cont = np.asarray(result.features.continuous)[:count]
+        cat = np.asarray(result.features.categorical)[:count]
+        scores = np.asarray(result.scores)[:count]
+        mean = np.asarray(aux["mean"])
+        stddev = np.asarray(aux["stddev"])
+        stddev_all = np.asarray(aux["stddev_from_all"])
+        use_ucb = np.asarray(aux["use_ucb"])
+        trust_radius = float(np.asarray(aux["trust_radius"]))
         suggestions = []
         for i in range(count):
             params = conv.to_parameters(
-                cont_rows[i : i + 1, : conv.encoder.num_continuous],
-                cat_rows[i : i + 1, : conv.encoder.num_categorical],
+                cont[i : i + 1, : conv.encoder.num_continuous],
+                cat[i : i + 1, : conv.encoder.num_categorical],
             )[0]
             s = trial_.TrialSuggestion(parameters=params)
-            s.metadata.ns("gp_ucb_pe")["acquisition"] = float(scores[i])
-            s.metadata.ns("gp_ucb_pe")["kind"] = "ucb" if i == 0 else "pe"
+            ns = s.metadata.ns("gp_ucb_pe")
+            ns["acquisition"] = float(scores[i])
+            ns["use_ucb"] = str(bool(use_ucb[i]))
+            ns["trust_radius"] = trust_radius
+            pred = ns.ns("prediction_in_warped_y_space")
+            pred["mean"] = np.array2string(mean[i], separator=",")
+            pred["stddev"] = np.array2string(stddev[i], separator=",")
+            pred["stddev_from_all"] = np.array2string(
+                stddev_all[i], separator=","
+            )
             suggestions.append(s)
         return suggestions
+
+    # -- Predictor (unwarped; reference `sample`/`predict`) -----------------
+
+    def sample(
+        self,
+        suggestions: Sequence[trial_.TrialSuggestion],
+        rng: Optional[Array] = None,
+        num_samples: int = 1000,
+    ) -> np.ndarray:
+        """Unwarped posterior samples: [S, T] (single) or [S, T, M] (multi)."""
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        if not suggestions:
+            return np.zeros((num_samples, 0))
+        states_me, _ = self._train_states_me()
+        feats = self._encode_suggestions(suggestions)
+        mean, stddev = _mixture_predict(states_me, feats)  # [M, T]
+        eps = jax.random.normal(rng, (num_samples,) + mean.shape, mean.dtype)
+        warped = np.asarray(mean[None] + stddev[None] * eps)  # [S, M, T]
+        if not self._warpers_fitted:
+            # No completed labels to fit a warper on: the warped space IS the
+            # native space (prior samples on a fresh study).
+            out = warped
+            out = np.moveaxis(out, 1, 2)
+            return out[:, :, 0] if out.shape[-1] == 1 else out
+        out = np.empty_like(warped)
+        for m, warper in enumerate(self._metric_warpers):
+            flat = warped[:, m, :].reshape(-1, 1)
+            out[:, m, :] = warper.unwarp(flat).reshape(warped.shape[0], -1)
+        out = np.moveaxis(out, 1, 2)  # [S, T, M]
+        return out[:, :, 0] if out.shape[-1] == 1 else out
+
+    def predict(
+        self,
+        suggestions: Sequence[trial_.TrialSuggestion],
+        rng: Optional[Array] = None,
+        num_samples: Optional[int] = 1000,
+    ) -> core_lib.Prediction:
+        """Empirical mean/stddev of unwarped posterior samples."""
+        samples = self.sample(suggestions, rng, num_samples or 1000)
+        return core_lib.Prediction(
+            mean=np.mean(samples, axis=0), stddev=np.std(samples, axis=0)
+        )
 
 
 def default_factory(

@@ -209,6 +209,11 @@ class VizierGaussianProcess:
 
     def precompute(self, unconstrained: Params, data: GPData) -> "GPState":
         p = self.param_collection().constrain(unconstrained)
+        return self.precompute_constrained(p, data)
+
+    def precompute_constrained(self, p: Params, data: GPData) -> "GPState":
+        """Precompute from already-constrained params (e.g. after a noise
+        override for pure-exploration conditioning, gp_ucb_pe.py)."""
         gram = self._masked_gram(p, data)
         chol = jnp.linalg.cholesky(gram)
         alpha = jax.scipy.linalg.cho_solve((chol, True), data.labels)

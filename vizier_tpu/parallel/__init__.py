@@ -76,15 +76,21 @@ def train_gp_sharded(
     num_restarts: int,
     ensemble_size: int,
     mesh: Mesh,
+    warm_start: Optional[dict] = None,
 ) -> gp_lib.GPState:
     """Multi-restart ARD with the restart axis sharded over the mesh.
 
     ``num_restarts`` should be a multiple of the mesh size. Data is
     replicated (it is small); each device runs its restarts locally; the
-    final top-k selection is the only cross-device reduction.
+    final top-k selection is the only cross-device reduction. ``warm_start``
+    replaces the first restart (same contract as ``gp_bandit._train_gp``).
     """
     coll = model.param_collection()
     inits = coll.batch_random_init_unconstrained(rng, num_restarts)
+    if warm_start is not None:
+        inits = jax.tree_util.tree_map(
+            lambda batch, warm: batch.at[0].set(warm), inits, warm_start
+        )
     inits = jax.lax.with_sharding_constraint(
         inits, batch_sharded(mesh)
     )
@@ -99,12 +105,9 @@ def train_gp_sharded(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, static_argnames=("vec_opt", "count", "num_pools", "mesh")
-)
-def maximize_acquisition_sharded(
+def maximize_score_fn_sharded(
     vec_opt: vectorized_lib.VectorizedOptimizer,
-    scoring: acquisitions.ScoringFunction,
+    score_fn,
     rng: Array,
     count: int,
     num_pools: int,
@@ -115,14 +118,14 @@ def maximize_acquisition_sharded(
 
     Each pool consumes ``vec_opt.max_evaluations`` scores; total work is
     ``num_pools ×`` that, wall-clock ≈ one pool when num_pools == mesh size.
-    The merge is a single global top-k.
+    The merge is a single global top-k. Traceable (callable from inside
+    larger jitted programs, e.g. the UCB-PE batch loop).
     """
     keys = jax.random.split(rng, num_pools)
     keys = jax.lax.with_sharding_constraint(keys, batch_sharded(mesh))
-    scoring = jax.lax.with_sharding_constraint(scoring, replicated(mesh))
 
     def run_pool(key: Array) -> vectorized_lib.VectorizedOptimizerResult:
-        return vec_opt(scoring.score, key, count=count, prior_features=prior_features)
+        return vec_opt(score_fn, key, count=count, prior_features=prior_features)
 
     results = jax.vmap(run_pool)(keys)  # [pools, count, ...]
     flat = num_pools * count  # explicit: -1 breaks on zero-width categorical
@@ -136,6 +139,25 @@ def maximize_acquisition_sharded(
     top_scores, idx = jax.lax.top_k(flat_scores, count)
     return vectorized_lib.VectorizedOptimizerResult(
         kernels.MixedFeatures(flat_cont[idx], flat_cat[idx]), top_scores
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("vec_opt", "count", "num_pools", "mesh")
+)
+def maximize_acquisition_sharded(
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    scoring: acquisitions.ScoringFunction,
+    rng: Array,
+    count: int,
+    num_pools: int,
+    mesh: Mesh,
+    prior_features: Optional[kernels.MixedFeatures] = None,
+) -> vectorized_lib.VectorizedOptimizerResult:
+    """Pool-sharded sweep of a ScoringFunction pytree (jitted entry point)."""
+    scoring = jax.lax.with_sharding_constraint(scoring, replicated(mesh))
+    return maximize_score_fn_sharded(
+        vec_opt, scoring.score, rng, count, num_pools, mesh, prior_features
     )
 
 
