@@ -1,0 +1,124 @@
+"""Tests for trial-curve regression (trial_regression_utils parity)."""
+
+import numpy as np
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import regression
+from vizier_tpu.pyvizier import trial as trial_
+
+
+def _curve_trial(tid, lr, n_steps=10, final=None, metric="loss"):
+    t = trial_.Trial(id=tid, parameters={"lr": lr})
+    values = []
+    for s in range(1, n_steps + 1):
+        v = 1.0 / (lr * s + 0.1)  # decaying curve, faster for larger lr
+        values.append(v)
+        t.measurements.append(
+            trial_.Measurement(metrics={metric: v}, steps=s)
+        )
+    if final is not None or n_steps:
+        t.complete(
+            trial_.Measurement(
+                metrics={metric: final if final is not None else values[-1]},
+                steps=n_steps,
+            )
+        )
+    return t
+
+
+class TestTrialData:
+    def test_from_trial_sorted_deduped(self):
+        t = trial_.Trial(id=1, parameters={"lr": 0.1})
+        t.measurements.append(trial_.Measurement(metrics={"loss": 3.0}, steps=2))
+        t.measurements.append(trial_.Measurement(metrics={"loss": 5.0}, steps=1))
+        t.measurements.append(trial_.Measurement(metrics={"loss": 2.9}, steps=2))
+        data = regression.TrialData.from_trial(t, "loss")
+        assert data.steps == [1.0, 2.0]
+        assert data.objective_values == [5.0, 2.9]  # later measurement wins
+
+    def test_value_at_interpolates(self):
+        data = regression.TrialData(
+            id=1, parameters={}, steps=[0.0, 10.0], objective_values=[0.0, 1.0]
+        )
+        assert data.value_at(5.0) == 0.5
+        assert data.value_at(20.0) == 1.0  # clamped
+
+    def test_extrapolation_uses_final_slope(self):
+        data = regression.TrialData(
+            id=1, parameters={}, steps=[0.0, 1.0, 2.0],
+            objective_values=[0.0, 1.0, 2.0],
+        )
+        assert data.extrapolate_objective_value(4.0) == 4.0
+
+    def test_default_steps_fall_back_to_arrival_order(self):
+        """Measurements appended without steps (default 0.0) must not
+        collapse onto one point."""
+        t = trial_.Trial(id=1, parameters={})
+        for v in [5.0, 4.0, 3.0]:
+            t.measurements.append(trial_.Measurement(metrics={"loss": v}))
+        t.complete(trial_.Measurement(metrics={"loss": 2.0}))
+        data = regression.TrialData.from_trial(t, "loss")
+        assert len(data.steps) == 4
+        assert data.objective_values == [5.0, 4.0, 3.0, 2.0]
+
+    def test_missing_metric_returns_none(self):
+        t = trial_.Trial(id=1, parameters={})
+        t.complete(trial_.Measurement(metrics={"other": 1.0}))
+        assert regression.TrialData.from_trial(t, "loss") is None
+
+
+class TestGBMAutoRegressor:
+    def test_underfit_guard(self):
+        reg = regression.GBMAutoRegressor("loss", min_train_trials=5)
+        assert not reg.train([_curve_trial(1, 0.1)])
+        assert not reg.is_trained
+        assert reg.predict(_curve_trial(9, 0.1)) is None
+
+    def test_learns_curve_to_final_mapping(self):
+        rng = np.random.default_rng(0)
+        completed = [
+            _curve_trial(i + 1, float(lr))
+            for i, lr in enumerate(rng.uniform(0.05, 1.0, size=30))
+        ]
+        reg = regression.GBMAutoRegressor("loss", seed=0)
+        assert reg.train(completed)
+        # Predict for a partial (active) trial with only 4 of 10 steps.
+        lr = 0.5
+        partial = trial_.Trial(id=99, parameters={"lr": lr})
+        for s in range(1, 5):
+            partial.measurements.append(
+                trial_.Measurement(metrics={"loss": 1.0 / (lr * s + 0.1)}, steps=s)
+            )
+        pred = reg.predict(partial)
+        true_final = 1.0 / (lr * 10 + 0.1)
+        assert pred is not None
+        assert abs(pred - true_final) < 0.5  # same order as the true final
+
+
+class TestHallucinator:
+    def test_completes_stopped_trials(self):
+        rng = np.random.default_rng(1)
+        completed = [
+            _curve_trial(i + 1, float(lr))
+            for i, lr in enumerate(rng.uniform(0.05, 1.0, size=20))
+        ]
+        h = regression.TrialHallucinator("loss")
+        assert h.train(completed)
+        stopped = trial_.Trial(id=50, parameters={"lr": 0.3})
+        for s in range(1, 4):
+            stopped.measurements.append(
+                trial_.Measurement(metrics={"loss": 1.0 / (0.3 * s + 0.1)}, steps=s)
+            )
+        out = h.hallucinate_final_measurements([stopped])
+        assert len(out) == 1
+        assert out[0].is_completed
+        assert out[0].metadata.ns("regression")["hallucinated"] == "True"
+        assert np.isfinite(out[0].final_measurement.metrics["loss"].value)
+
+    def test_skips_trials_without_curves(self):
+        h = regression.TrialHallucinator("loss")
+        h.train(
+            [_curve_trial(i + 1, 0.1 + 0.02 * i) for i in range(10)]
+        )
+        bare = trial_.Trial(id=9, parameters={"lr": 0.1})
+        assert h.hallucinate_final_measurements([bare]) == []

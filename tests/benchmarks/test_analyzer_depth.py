@@ -1,0 +1,114 @@
+"""Analyzer depth tests: alignment/extrapolation, new comparators, and the
+BenchmarkRecord comparison machinery (the regret-parity instrument)."""
+
+import numpy as np
+import pytest
+
+from vizier_tpu.benchmarks.analyzers import convergence_curve as cc
+from vizier_tpu.benchmarks.analyzers import state_analyzer as sa
+
+
+def _curve(ys, trend=None):
+    ys = np.atleast_2d(np.asarray(ys, dtype=np.float64))
+    return cc.ConvergenceCurve(
+        xs=np.arange(1, ys.shape[1] + 1),
+        ys=ys,
+        trend=trend or cc.ConvergenceCurve.YTrend.INCREASING,
+    )
+
+
+class TestAlignment:
+    def test_align_combines_batches(self):
+        c1 = _curve([[0, 1, 2]])
+        c2 = _curve([[0, 2, 4, 6]])
+        combined = cc.ConvergenceCurve.align_xs([c1, c2])
+        assert combined.ys.shape == (2, 4)
+        # Shorter curve extends by interpolation clamp at its final value.
+        assert combined.ys[0, -1] == 2
+
+    def test_align_keep_separate(self):
+        c1 = _curve([[0, 1, 2]])
+        c2 = _curve([[0, 2, 4, 6]])
+        aligned = cc.ConvergenceCurve.align_xs([c1, c2], keep_curves_separate=True)
+        assert len(aligned) == 2
+        assert all(len(a.xs) == 4 for a in aligned)
+        assert aligned[0].ys.shape == (1, 4)
+
+    def test_align_rejects_mixed_trends(self):
+        c1 = _curve([[0, 1]])
+        c2 = _curve([[1, 0]], trend=cc.ConvergenceCurve.YTrend.DECREASING)
+        with pytest.raises(ValueError, match="trend"):
+            cc.ConvergenceCurve.align_xs([c1, c2])
+
+    def test_interpolate_at(self):
+        c = _curve([[0, 2, 4]])
+        out = c.interpolate_at(np.array([1.5, 2.5]))
+        np.testing.assert_allclose(out.ys[0], [1.0, 3.0])
+
+    def test_extrapolate_holds_incumbent(self):
+        c = _curve([[0, 3, 5]])
+        out = c.extrapolate_ys(2)
+        assert len(out.xs) == 5
+        np.testing.assert_allclose(out.ys[0, -2:], [5, 5])
+
+
+class TestOptimalityGap:
+    def test_closer_to_optimum_scores_positive(self):
+        base = _curve([[0, 1, 2]])
+        better = _curve([[0, 2, 3.9]])
+        comp = cc.OptimalityGapComparator(baseline_curve=base, optimum=4.0)
+        assert comp.score(better) > 0
+        assert comp.score(base) == pytest.approx(0.0)
+
+    def test_decreasing_trend(self):
+        base = _curve([[10, 5, 2]], trend=cc.ConvergenceCurve.YTrend.DECREASING)
+        better = _curve([[10, 3, 0.5]], trend=cc.ConvergenceCurve.YTrend.DECREASING)
+        comp = cc.OptimalityGapComparator(baseline_curve=base, optimum=0.0)
+        assert comp.score(better) > 0
+
+
+class TestBenchmarkRecords:
+    def _records(self):
+        meta = {"name": "sphere", "dim": "4"}
+        base = sa.BenchmarkRecord(
+            algorithm="random",
+            experimenter_metadata=meta,
+            plot_elements={"objective": sa.PlotElement(_curve([[0, 1, 2, 3]]))},
+        )
+        good = sa.BenchmarkRecord(
+            algorithm="gp",
+            experimenter_metadata=meta,
+            plot_elements={"objective": sa.PlotElement(_curve([[0, 3, 3.5]]))},
+        )
+        return [base, good]
+
+    def test_add_comparison_metrics(self):
+        records = sa.BenchmarkRecordAnalyzer.add_comparison_metrics(
+            self._records(), baseline_algo="random"
+        )
+        gp = next(r for r in records if r.algorithm == "gp")
+        assert gp.scores["log_efficiency_vs_random"] > 0
+        assert 0.0 <= gp.scores["win_rate_vs_random"] <= 1.0
+        assert gp.scores["pct_better_vs_random"] > 0.5
+
+    def test_mismatched_lengths_are_extrapolated(self):
+        records = sa.BenchmarkRecordAnalyzer.add_comparison_metrics(
+            self._records(), baseline_algo="random"
+        )
+        # Did not raise despite 4-vs-3 lengths; scores exist for both.
+        assert all("win_rate_vs_random" in r.scores for r in records)
+
+    def test_summarize_rows(self):
+        records = sa.BenchmarkRecordAnalyzer.add_comparison_metrics(
+            self._records(), baseline_algo="random"
+        )
+        rows = sa.BenchmarkRecordAnalyzer.summarize(records)
+        assert len(rows) == 2
+        assert {"algorithm", "experimenter", "objective_final_median"} <= set(
+            rows[0]
+        )
+
+    def test_summarize_dataframe(self):
+        df = sa.BenchmarkRecordAnalyzer.summarize_dataframe(self._records())
+        assert len(df) == 2
+        assert "objective_final_median" in df.columns

@@ -1,0 +1,172 @@
+"""Tests for the round-2 small-gap fills: scheduled UCB-PE preset,
+meta-learning phases, and BOCS horseshoe/SDP upgrades."""
+
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.benchmarks.experimenters import combinatorial
+from vizier_tpu.designers import bocs as bocs_lib
+from vizier_tpu.designers import meta_learning, scheduled_designer
+from vizier_tpu.pyvizier import trial as trial_
+
+
+def _problem():
+    p = vz.ProblemStatement()
+    p.search_space.root.add_float_param("x", 0.0, 1.0)
+    p.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return p
+
+
+class TestScheduledUcbPe:
+    def test_coefficients_decay_over_budget(self):
+        d = scheduled_designer.scheduled_gp_ucb_pe(
+            _problem(), expected_total_num_trials=10, seed=0
+        )
+        # Drive via the schedule machinery only (no GP work: inspect values).
+        assert d._maybe_rebuild() is not None
+        early = dict(d._current_values)
+        trials = []
+        for i in range(10):
+            t = trial_.Trial(id=i + 1, parameters={"x": i / 10})
+            t.complete(vz.Measurement(metrics={"obj": i / 10}))
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        d._maybe_rebuild()
+        late = dict(d._current_values)
+        assert late["ucb_coefficient"] < early["ucb_coefficient"]
+        assert (
+            late["explore_region_ucb_coefficient"]
+            < early["explore_region_ucb_coefficient"]
+        )
+
+    def test_inner_designer_is_ucb_pe(self):
+        from vizier_tpu.designers.gp_ucb_pe import VizierGPUCBPEBandit
+
+        d = scheduled_designer.scheduled_gp_ucb_pe(_problem(), seed=0)
+        inner = d._maybe_rebuild()
+        assert isinstance(inner, VizierGPUCBPEBandit)
+
+
+class TestMetaLearningPhases:
+    def _designer(self, **cfg_kwargs):
+        space = vz.SearchSpace()
+        space.root.add_float_param("knob", 0.0, 1.0)
+
+        from vizier_tpu.designers import RandomDesigner
+
+        def inner_factory(problem, **hparams):
+            return RandomDesigner(problem.search_space, seed=0)
+
+        return meta_learning.MetaLearningDesigner(
+            problem=_problem(),
+            tuning_space=space,
+            inner_factory=inner_factory,
+            config=meta_learning.MetaLearningConfig(
+                tuning_interval=4, **cfg_kwargs
+            ),
+            seed=0,
+        )
+
+    def _run(self, d, rounds, batch=2):
+        tid = 0
+        for _ in range(rounds):
+            trials = []
+            for s in d.suggest(batch):
+                tid += 1
+                t = s.to_trial(tid)
+                t.complete(vz.Measurement(metrics={"obj": np.random.rand()}))
+                trials.append(t)
+            d.update(core_lib.CompletedTrials(trials))
+
+    def test_initialize_phase_before_min_trials(self):
+        d = self._designer(tuning_min_num_trials=10)
+        assert d.state == meta_learning.MetaLearningState.INITIALIZE
+        self._run(d, rounds=2)
+        assert d.state == meta_learning.MetaLearningState.INITIALIZE
+        # No meta trials scored while initializing.
+        assert not d._meta_trials
+
+    def test_tune_phase_scores_configs(self):
+        d = self._designer(tuning_min_num_trials=0)
+        self._run(d, rounds=6)
+        assert d.state == meta_learning.MetaLearningState.TUNE
+        assert len(d._meta_trials) >= 1
+        for t in d._meta_trials:
+            assert meta_learning.META_METRIC in t.final_measurement.metrics
+
+    def test_use_best_params_locks_in(self):
+        d = self._designer(tuning_min_num_trials=0, tuning_max_num_trials=8)
+        self._run(d, rounds=8)
+        assert d.state == meta_learning.MetaLearningState.USE_BEST_PARAMS
+        n_meta = len(d._meta_trials)
+        self._run(d, rounds=3)
+        # Locked: no further meta exploration.
+        assert len(d._meta_trials) == n_meta
+
+
+class TestBocsUpgrades:
+    def _loop(self, designer, exp, rounds=5, batch=2):
+        tid = 0
+        best = np.inf
+        for _ in range(rounds):
+            trials = []
+            for s in designer.suggest(batch):
+                tid += 1
+                trials.append(s.to_trial(tid))
+            exp.evaluate(trials)
+            for t in trials:
+                best = min(
+                    best, t.final_measurement.metrics["main_objective"].value
+                )
+            designer.update(core_lib.CompletedTrials(trials))
+        return best
+
+    @pytest.mark.parametrize("surrogate", ["horseshoe", "ridge"])
+    @pytest.mark.parametrize("opt", ["sa", "sdp"])
+    def test_all_variants_run(self, surrogate, opt):
+        exp = combinatorial.ContaminationExperimenter(seed=0, n_stages=8)
+        d = bocs_lib.BOCSDesigner(
+            exp.problem_statement(),
+            seed=1,
+            surrogate=surrogate,
+            acquisition_optimizer=opt,
+            gibbs_samples=10,
+            anneal_steps=30,
+            num_restarts=2,
+        )
+        best = self._loop(d, exp)
+        assert np.isfinite(best)
+
+    def test_horseshoe_shrinks_spurious_coefficients(self):
+        """Sparse prior: inactive bits' coefficients shrink toward zero."""
+        rng = np.random.default_rng(0)
+        d = 10
+        n = 60
+        x = rng.integers(0, 2, size=(n, d)).astype(float)
+        y = 3.0 * x[:, 0] - 2.0 * x[:, 1] + 0.01 * rng.standard_normal(n)
+        phi = np.concatenate([np.ones((n, 1)), x], axis=1)
+        coef = bocs_lib._horseshoe_gibbs(
+            phi, y, np.random.default_rng(1), num_samples=100
+        )
+        active = np.abs(coef[1:3])
+        inactive = np.abs(coef[3:])
+        assert active.min() > 1.0
+        assert inactive.max() < 0.5
+
+    def test_unknown_options_rejected(self):
+        exp = combinatorial.ContaminationExperimenter(seed=0, n_stages=4)
+        d = bocs_lib.BOCSDesigner(
+            exp.problem_statement(), surrogate="bogus", seed=0
+        )
+        t = trial_.Trial(id=1, parameters={f"x_{i}": False for i in range(4)})
+        exp.evaluate([t])
+        d.update(core_lib.CompletedTrials([t]))
+        t2 = trial_.Trial(id=2, parameters={f"x_{i}": True for i in range(4)})
+        exp.evaluate([t2])
+        d.update(core_lib.CompletedTrials([t2]))
+        with pytest.raises(ValueError, match="surrogate"):
+            d.suggest(1)

@@ -29,7 +29,17 @@ class TestDefaultPipeline:
         w = output_warpers.create_default_warper()
         out = w.warp(np.full((7, 1), 3.25))
         np.testing.assert_array_equal(out, np.zeros((7, 1)))
-        np.testing.assert_array_equal(w.unwarp(out), np.zeros((7, 1)))
+        # Unwarp shifts back by the constant — including for non-sentinel
+        # inputs (GP samples around 0), which must not crash.
+        np.testing.assert_allclose(w.unwarp(out), np.full((7, 1), 3.25))
+        samples = np.array([[0.3], [-0.1], [0.0]])
+        np.testing.assert_allclose(w.unwarp(samples), samples + 3.25)
+
+    def test_all_nan_unwarp_of_arbitrary_values(self):
+        w = output_warpers.create_default_warper()
+        w.warp(np.full((3, 1), np.nan))
+        out = w.unwarp(np.array([[0.5], [-1.0]]))
+        assert np.isnan(out).all()
 
     def test_all_nan_labels_map_to_minus_one(self):
         w = output_warpers.create_default_warper()

@@ -45,18 +45,65 @@ class ConvergenceCurve:
         return self.ys.shape[0]
 
     @classmethod
-    def align_xs(cls, curves: Sequence["ConvergenceCurve"]) -> "ConvergenceCurve":
-        """Stacks curves onto a common x grid (interpolating where needed)."""
+    def align_xs(
+        cls,
+        curves: Sequence["ConvergenceCurve"],
+        *,
+        keep_curves_separate: bool = False,
+    ) -> "ConvergenceCurve" | List["ConvergenceCurve"]:
+        """Puts curves onto a common x grid (interpolating where needed).
+
+        Default combines all batches into one stacked curve (reference
+        ``_align_xs_combine_ys``); ``keep_curves_separate`` returns one
+        aligned curve per input (``_align_xs_keep_ys``) — needed when the
+        inputs are different algorithms that must not be pooled.
+        """
         if not curves:
             raise ValueError("No curves to align.")
         trend = curves[0].trend
+        if any(c.trend != trend for c in curves):
+            raise ValueError("Cannot align curves with mismatched trends.")
         max_x = max(float(c.xs[-1]) for c in curves)
         xs = np.arange(1, int(max_x) + 1)
+        if keep_curves_separate:
+            return [
+                cls(
+                    xs=xs,
+                    ys=np.stack([np.interp(xs, c.xs, row) for row in c.ys]),
+                    trend=trend,
+                )
+                for c in curves
+            ]
         ys = []
         for c in curves:
             for row in c.ys:
                 ys.append(np.interp(xs, c.xs, row))
         return cls(xs=xs, ys=np.stack(ys), trend=trend)
+
+    def interpolate_at(self, xs: np.ndarray) -> "ConvergenceCurve":
+        """This curve resampled at arbitrary x positions."""
+        xs = np.asarray(xs, dtype=np.float64)
+        ys = np.stack([np.interp(xs, self.xs, row) for row in self.ys])
+        return ConvergenceCurve(xs=xs, ys=ys, trend=self.trend)
+
+    def extrapolate_ys(self, num_extra_steps: int) -> "ConvergenceCurve":
+        """Extends each batch flat at its best-so-far value.
+
+        Reference ``extrapolate_ys`` (``convergence_curve.py:198``): a
+        best-so-far curve is a running extremum, so the honest extrapolation
+        holds the incumbent — comparators can then align curves from runs of
+        different lengths without fabricating progress.
+        """
+        if num_extra_steps <= 0:
+            return self
+        step = float(self.xs[-1] - self.xs[-2]) if len(self.xs) > 1 else 1.0
+        extra_xs = self.xs[-1] + step * np.arange(1, num_extra_steps + 1)
+        extra_ys = np.repeat(self.ys[:, -1:], num_extra_steps, axis=1)
+        return ConvergenceCurve(
+            xs=np.concatenate([self.xs, extra_xs]),
+            ys=np.concatenate([self.ys, extra_ys], axis=1),
+            trend=self.trend,
+        )
 
     def percentile_curve(self, percentile: float = 50.0) -> np.ndarray:
         return np.percentile(self.ys, percentile, axis=0)
@@ -264,3 +311,22 @@ class PercentageBetterComparator:
             self.baseline_curve, compared, align=True
         )
         return float(np.mean(comp_med > base_med))
+
+
+@dataclasses.dataclass
+class OptimalityGapComparator:
+    """Relative final-gap score of compared vs baseline.
+
+    Reference comparator family (``convergence_curve.py:913`` context):
+    both curves' final median distances to the optimum are compared as
+    log(baseline_gap / compared_gap) — positive means compared ends closer
+    to the optimum; 0 means parity.
+    """
+
+    baseline_curve: ConvergenceCurve
+    optimum: float
+
+    def score(self, compared: ConvergenceCurve) -> float:
+        base_gap = abs(self.optimum - np.median(self.baseline_curve.ys[:, -1]))
+        comp_gap = abs(self.optimum - np.median(compared.ys[:, -1]))
+        return float(np.log(max(base_gap, 1e-12) / max(comp_gap, 1e-12)))

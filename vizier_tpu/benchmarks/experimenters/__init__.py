@@ -1,0 +1,28 @@
+"""Benchmark experimenters: base protocol, synthetic suites, wrappers,
+combinatorial (COMBO) problems, and data-backed surrogate handlers."""
+
+from vizier_tpu.benchmarks.experimenters.base import Experimenter, NumpyExperimenter
+from vizier_tpu.benchmarks.experimenters.combinatorial import (
+    CentroidExperimenter,
+    ContaminationExperimenter,
+    IsingExperimenter,
+    L1CategoricalExperimenter,
+    PestControlExperimenter,
+)
+from vizier_tpu.benchmarks.experimenters.surrogates import (
+    Atari100kHandler,
+    HPOBHandler,
+    NASBench201Handler,
+    TabularSurrogateExperimenter,
+)
+from vizier_tpu.benchmarks.experimenters.wrappers import (
+    DiscretizingExperimenter,
+    InfeasibleExperimenter,
+    NoisyExperimenter,
+    NormalizingExperimenter,
+    PermutingExperimenter,
+    ShiftingExperimenter,
+    SignFlipExperimenter,
+    SparseExperimenter,
+    SwitchExperimenter,
+)

@@ -167,3 +167,77 @@ class NASBench201Handler:
         return TabularSurrogateExperimenter(
             self.problem_statement(), rows, ys, metric_name="accuracy"
         )
+
+    def make_synthetic_experimenter(
+        self, *, num_rows: int = 1024, seed: int = 0
+    ) -> base.Experimenter:
+        """NASBench-201-STYLE surrogate over a synthetic accuracy table.
+
+        Not real NASBench data (none is bundled in this image): a
+        deterministic structured objective over the same 6-op categorical
+        cell space — op quality + pairwise interactions + noise — so the
+        full tabular-benchmark pipeline (suggest → snap-to-table → accuracy)
+        runs end to end without the dataset.
+        """
+        rng = np.random.default_rng(seed)
+        n_ops = len(self.OPS)
+        quality = rng.normal(size=(6, n_ops))
+        pair = rng.normal(scale=0.3, size=(6, 6, n_ops, n_ops))
+        rows: List[Dict] = []
+        ys: List[float] = []
+        seen = set()
+        while len(rows) < num_rows:
+            idx = tuple(rng.integers(0, n_ops, size=6))
+            if idx in seen:
+                continue
+            seen.add(idx)
+            score = sum(quality[i, idx[i]] for i in range(6))
+            for i in range(6):
+                for j in range(i + 1, 6):
+                    score += pair[i, j, idx[i], idx[j]]
+            acc = 100.0 / (1.0 + np.exp(-score / 4.0))  # accuracy-like range
+            rows.append({f"op{i}": self.OPS[idx[i]] for i in range(6)})
+            ys.append(float(acc))
+        return TabularSurrogateExperimenter(
+            self.problem_statement(), rows, ys, metric_name="accuracy"
+        )
+
+
+@dataclasses.dataclass
+class Atari100kHandler:
+    """Atari-100k RL-tuning surrogate handler (reference ``atari100k``).
+
+    Expects a json table of {hyperparam columns..., "score": float} records
+    for one game; data is not bundled — pass the dump's path.
+    """
+
+    data_path: Optional[str] = None
+    # The Atari100k search space of the reference experimenter.
+    _FLOATS = (
+        ("learning_rate", 1e-5, 1e-2, pc.ScaleType.LOG),
+        ("epsilon", 1e-8, 1e-3, pc.ScaleType.LOG),
+    )
+    _INTS = (("n_steps", 1, 20), ("update_horizon", 1, 20))
+
+    def problem_statement(self) -> base_study_config.ProblemStatement:
+        problem = base_study_config.ProblemStatement()
+        for name, lo, hi, scale in self._FLOATS:
+            problem.search_space.root.add_float_param(name, lo, hi, scale_type=scale)
+        for name, lo, hi in self._INTS:
+            problem.search_space.root.add_int_param(name, lo, hi)
+        problem.metric_information.append(
+            base_study_config.MetricInformation(
+                name="score", goal=base_study_config.ObjectiveMetricGoal.MAXIMIZE
+            )
+        )
+        return problem
+
+    def make_experimenter(self) -> base.Experimenter:
+        path = _require_file(self.data_path, "Atari100k")
+        with open(path) as f:
+            table = json.load(f)
+        rows = [{k: v for k, v in row.items() if k != "score"} for row in table]
+        ys = [row["score"] for row in table]
+        return TabularSurrogateExperimenter(
+            self.problem_statement(), rows, ys, metric_name="score"
+        )

@@ -24,8 +24,28 @@ META_METRIC = "meta_reward"
 
 @dataclasses.dataclass
 class MetaLearningConfig:
-    tuning_interval: int = 20  # trials per meta round
+    """Reference ``MetaLearningConfig`` (``meta_learning.py:58``) semantics.
+
+    The meta-learner runs through three phases by completed-trial count:
+    INITIALIZE (below ``tuning_min_num_trials``: default hyperparams, gather
+    signal), TUNE (between the thresholds: each meta round tries one
+    hyperparameter config for ``tuning_interval`` trials and scores it), and
+    USE_BEST_PARAMS (past ``tuning_max_num_trials``: lock in the best-scoring
+    config — further exploration wastes suggestion budget).
+    """
+
+    tuning_interval: int = 20  # trials per meta round (num_trials_per_tuning)
     num_seed_rounds: int = 1
+    tuning_min_num_trials: int = 0  # TUNE starts at this many completed
+    tuning_max_num_trials: int = 10_000  # TUNE stops here → USE_BEST_PARAMS
+
+
+class MetaLearningState:
+    """Phase labels (reference ``MetaLearningState``)."""
+
+    INITIALIZE = "INITIALIZE"
+    TUNE = "TUNE"
+    USE_BEST_PARAMS = "USE_BEST_PARAMS"
 
 
 @dataclasses.dataclass
@@ -75,6 +95,45 @@ class MetaLearningDesigner(core_lib.Designer):
         self._prev_best = -np.inf
         self._meta_trial_id = 0
         self._all_completed: List[trial_.Trial] = []
+        self._meta_trials: List[trial_.Trial] = []  # scored hyperparam configs
+        self._locked_best = False
+
+    @property
+    def state(self) -> str:
+        n = len(self._all_completed)
+        if self._locked_best or n >= self.config.tuning_max_num_trials:
+            return MetaLearningState.USE_BEST_PARAMS
+        if n < self.config.tuning_min_num_trials:
+            return MetaLearningState.INITIALIZE
+        return MetaLearningState.TUNE
+
+    def _default_hparams(self) -> Dict:
+        """Center/default point of the tuning space (INITIALIZE phase)."""
+        return {
+            cfg.name: cfg.first_feasible_value()
+            for cfg in self.tuning_space.parameters
+        }
+
+    def _best_hparams(self) -> Dict:
+        """Hyperparams of the best-scoring completed meta trial."""
+        if not self._meta_trials:
+            return self._default_hparams()
+        best = max(
+            self._meta_trials,
+            key=lambda t: t.final_measurement.metrics[META_METRIC].value,
+        )
+        return {k: v.value for k, v in best.parameters.items()}
+
+    def _start_fixed(self, hparams: Dict) -> None:
+        """Builds the inner designer on fixed hyperparams (no meta round)."""
+        self._current_hparams = None
+        self._inner = self.inner_factory(self.problem, **hparams)
+        if self._all_completed:
+            self._inner.update(
+                core_lib.CompletedTrials(self._all_completed),
+                core_lib.ActiveTrials(),
+            )
+        self._round_trials = 0
 
     def _start_round(self) -> None:
         (suggestion,) = self._meta.suggest(1)
@@ -91,6 +150,8 @@ class MetaLearningDesigner(core_lib.Designer):
 
     def _finish_round(self) -> None:
         """Scores the finished config by its improvement over the incumbent."""
+        if self._current_hparams is None:
+            return  # fixed-hyperparam tenure (INITIALIZE/USE_BEST), unscored
         if np.isfinite(self._prev_best) and np.isfinite(self._round_best):
             reward = float(self._round_best - self._prev_best)
         elif np.isfinite(self._round_best):
@@ -101,6 +162,7 @@ class MetaLearningDesigner(core_lib.Designer):
         self._meta_trial_id += 1
         t = self._current_hparams.to_trial(self._meta_trial_id)
         t.complete(trial_.Measurement(metrics={META_METRIC: reward}))
+        self._meta_trials.append(t)
         self._meta.update(core_lib.CompletedTrials([t]), core_lib.ActiveTrials())
 
     def update(
@@ -118,7 +180,18 @@ class MetaLearningDesigner(core_lib.Designer):
             self._inner.update(completed, all_active)
 
     def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
-        if self._inner is None:
+        state = self.state
+        if state == MetaLearningState.USE_BEST_PARAMS:
+            if not self._locked_best:
+                # Transition: score the in-flight config, lock in the winner.
+                self._finish_round()
+                self._locked_best = True
+                self._start_fixed(self._best_hparams())
+        elif state == MetaLearningState.INITIALIZE:
+            if self._inner is None:
+                self._start_fixed(self._default_hparams())
+        elif self._inner is None or self._current_hparams is None:
+            # Entering TUNE (fresh, or leaving INITIALIZE).
             self._start_round()
         elif self._round_trials >= self.config.tuning_interval:
             self._finish_round()

@@ -413,27 +413,37 @@ class WarperPipeline(OutputWarper):
     """
 
     warpers: Sequence[OutputWarper] = ()
+    # Edge-case fit state: 'normal' | 'constant' (all labels equal; stores
+    # the constant) | 'all_nan'. The sub-warpers are NOT fitted in the edge
+    # modes, so unwarp must invert from this state, not from them.
+    _mode: str = "normal"
+    _constant: float = 0.0
 
     def warp(self, labels: np.ndarray) -> np.ndarray:
         labels = _validate(labels)
         if labels.size == 0:
+            self._mode = "normal"
             return labels
         if np.isfinite(labels).all() and len(np.unique(labels)) == 1:
+            self._mode = "constant"
+            self._constant = float(labels.flat[0])
             return np.zeros_like(labels)
         if np.isnan(labels).all():
+            self._mode = "all_nan"
             return -np.ones_like(labels)
+        self._mode = "normal"
         for w in self.warpers:
             labels = w.warp(labels)
         return labels
 
     def unwarp(self, labels: np.ndarray) -> np.ndarray:
         labels = _validate(labels)
-        uniq = np.unique(labels)
-        if np.isfinite(labels).all() and len(uniq) == 1:
-            if uniq.item() == 0.0:
-                return labels
-            if uniq.item() == -1.0:
-                return np.full_like(labels, np.nan)
+        if self._mode == "constant":
+            # Warped space was 0 = the constant; shift arbitrary inputs
+            # (e.g. GP samples around 0) back by it.
+            return labels + self._constant
+        if self._mode == "all_nan":
+            return np.full_like(labels, np.nan)
         for w in reversed(list(self.warpers)):
             labels = w.unwarp(labels)
         return labels

@@ -371,6 +371,11 @@ class SearchSpaceSelector:
         self._space._insert(self._path, config)
         return SearchSpaceSelector(self._space, self._path + ((config.name, None),))
 
+    def add(self, config: ParameterConfig) -> "SearchSpaceSelector":
+        """Adds a pre-built ParameterConfig at this location (top-level on a
+        root selector; conditional child on a value-selected parameter)."""
+        return self._add(config)
+
     def add_float_param(
         self,
         name: str,
