@@ -183,7 +183,67 @@ class TestEagleDesigner:
         test_runners.RandomMetricsRunner(problem, iters=3, batch_size=4).run_designer(d1)
         d2 = EagleStrategyDesigner(problem, seed=3)
         d2.load(d1.dump())
-        np.testing.assert_array_equal(d2._rewards, d1._rewards)
+        assert set(d2._pool.keys()) == set(d1._pool.keys())
+        for fid in d1._pool:
+            assert d2._pool[fid].reward == d1._pool[fid].reward
+            np.testing.assert_array_equal(d2._pool[fid].x, d1._pool[fid].x)
+
+    def test_many_suggests_before_any_update(self):
+        """More suggests than pool capacity with zero completions must not
+        crash (multi-worker studies hold many active trials)."""
+        from vizier_tpu.designers.eagle_strategy import EagleStrategyDesigner
+
+        problem = bbob_problem(2)
+        d = EagleStrategyDesigner(problem, seed=0)
+        suggestions = d.suggest(d._capacity + 5)
+        assert len(suggestions) == d._capacity + 5
+
+    def test_pool_refills_after_eviction(self):
+        """Evicted flies leave room that random suggestions refill."""
+        from vizier_tpu.designers.eagle_strategy import (
+            EagleStrategyDesigner,
+            FireflyConfig,
+        )
+        from vizier_tpu.algorithms import core as core_lib
+
+        problem = bbob_problem(2)
+        d = EagleStrategyDesigner(
+            problem,
+            seed=0,
+            config=FireflyConfig(penalize_factor=0.01),  # evict fast
+        )
+        tid = 0
+        for rnd in range(10):
+            trials = []
+            for s in d.suggest(4):
+                tid += 1
+                t = s.to_trial(tid)
+                # Constant objective: nothing ever improves → evictions.
+                t.complete(vz.Measurement(metrics={"bbob_eval": 1.0}))
+                trials.append(t)
+            d.update(core_lib.CompletedTrials(trials))
+        # Suggest still issues fresh random flies for the freed slots.
+        assert len(d.suggest(3)) == 3
+
+    def test_nsga2_restore_skips_first_generation(self):
+        from vizier_tpu.designers.evolution import NSGA2Designer
+        from vizier_tpu.algorithms import core as core_lib
+
+        problem = bbob_problem(2)
+        d1 = NSGA2Designer(problem, population_size=8, seed=0)
+        tid = 0
+        for _ in range(3):
+            trials = []
+            for s in d1.suggest(4):
+                tid += 1
+                t = s.to_trial(tid)
+                t.complete(vz.Measurement(metrics={"bbob_eval": float(tid)}))
+                trials.append(t)
+            d1.update(core_lib.CompletedTrials(trials))
+        d2 = NSGA2Designer(problem, population_size=8, seed=0)
+        d2.load(d1.dump())
+        # Restored state implies the random first generation already ran.
+        assert d2._num_suggested >= d2.population_size
 
 
 class TestBOCSAndHarmonica:

@@ -1,0 +1,226 @@
+"""DNASpec ⇄ search-space / DNA ⇄ trial converter tests.
+
+Uses a structural test double of the ``pg.geno`` data model (Space /
+Choices / Float / DNA with the same attribute surface), so the full tree
+walk — nested conditional candidate subspaces, multi-subchoice Choices,
+literal values, floats — is exercised without pyglove installed.
+"""
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.pyglove import converters
+
+
+# -- pg.geno test double -----------------------------------------------------
+
+
+@dataclasses.dataclass
+class Space:
+    elements: Sequence[Any] = ()
+
+
+@dataclasses.dataclass
+class Choices:
+    name: str
+    candidates: Sequence[Space]
+    literal_values: Optional[Sequence[Any]] = None
+    num_choices: int = 1
+    location: str = ""
+
+
+@dataclasses.dataclass
+class Float:
+    name: str
+    min_value: float
+    max_value: float
+    scale: Optional[str] = None
+    location: str = ""
+
+
+@dataclasses.dataclass
+class DNA:
+    value: Any = None
+    children: Sequence["DNA"] = ()
+
+
+def _nas_spec() -> Space:
+    """model ∈ {mlp, cnn}; mlp→(units float, act ∈ {relu,tanh}); cnn→(filters)."""
+    mlp_space = Space(
+        elements=[
+            Float("units", 16.0, 256.0, scale="log"),
+            Choices("act", [Space(), Space()], literal_values=["relu", "tanh"]),
+        ]
+    )
+    cnn_space = Space(elements=[Float("filters", 8.0, 64.0)])
+    return Space(
+        elements=[
+            Choices(
+                "model", [mlp_space, cnn_space], literal_values=["mlp", "cnn"]
+            ),
+            Float("lr", 1e-4, 1e-1, scale="log"),
+        ]
+    )
+
+
+class TestToSearchSpace:
+    def test_conditional_tree(self):
+        space = converters.to_search_space(_nas_spec())
+        assert space.is_conditional
+        names = space.parameter_names()
+        assert "model" in names and "lr" in names
+        # Conditional children exist under candidate-scoped prefixes.
+        assert any("units" in n for n in names)
+        assert any("filters" in n for n in names)
+        model = space.get("model")
+        assert {c for cfg in model.children for c in cfg.matching_parent_values} == {
+            "mlp",
+            "cnn",
+        }
+
+    def test_literals_become_categories(self):
+        space = converters.to_search_space(_nas_spec())
+        # (SearchSpace stores categorical values sorted; membership is the
+        # contract, the converter keeps its own candidate-index order.)
+        assert set(space.get("model").feasible_values) == {"mlp", "cnn"}
+
+    def test_float_scale(self):
+        space = converters.to_search_space(_nas_spec())
+        assert space.get("lr").scale_type == vz.ScaleType.LOG
+
+    def test_multi_subchoice_expands(self):
+        spec = Space(
+            elements=[
+                Choices(
+                    "ops",
+                    [Space(), Space(), Space()],
+                    literal_values=["a", "b", "c"],
+                    num_choices=2,
+                )
+            ]
+        )
+        space = converters.to_search_space(spec)
+        assert set(space.parameter_names()) == {"ops[0]", "ops[1]"}
+
+
+class TestDnaRoundTrip:
+    def test_dna_to_parameters_conditional(self):
+        conv = converters.DNASpecConverter(_nas_spec())
+        dna = DNA(
+            children=[
+                DNA(value=0, children=[DNA(value=64.0), DNA(value=1)]),  # mlp
+                DNA(value=0.01),
+            ]
+        )
+        params = conv.dna_to_parameters(dna)
+        assert params["model"] == "mlp"
+        assert params["model/0/units"] == 64.0
+        assert params["model/0/act"] == "tanh"
+        assert params["lr"] == 0.01
+        # The cnn branch's parameter is absent (inactive subtree).
+        assert not any("filters" in k for k in params)
+
+    def test_parameters_to_dna_values(self):
+        conv = converters.DNASpecConverter(_nas_spec())
+        values = conv.parameters_to_dna_values(
+            {"model": "cnn", "model/1/filters": 32.0, "lr": 0.001}
+        )
+        # [(choice=1, [(32.0, [])]), (0.001, [])]
+        assert values[0][0] == 1
+        assert values[0][1][0][0] == 32.0
+        assert values[1][0] == 0.001
+
+    def test_round_trip_through_suggestion(self):
+        conv = converters.DNASpecConverter(_nas_spec())
+        dna = DNA(
+            children=[
+                DNA(value=1, children=[DNA(value=16.0)]),  # cnn
+                DNA(value=0.05),
+            ]
+        )
+        suggestion = conv.to_trial_suggestion(dna)
+        trial = suggestion.to_trial(1)
+        values = conv.to_dna_values(trial)
+        assert values[0][0] == 1
+        assert values[0][1][0][0] == 16.0
+        assert values[1][0] == pytest.approx(0.05)
+
+    def test_multi_subchoice_round_trip(self):
+        spec = Space(
+            elements=[
+                Choices(
+                    "ops",
+                    [Space(), Space(), Space()],
+                    literal_values=["a", "b", "c"],
+                    num_choices=2,
+                )
+            ]
+        )
+        conv = converters.DNASpecConverter(spec)
+        dna = DNA(children=[DNA(children=[DNA(value=2), DNA(value=0)])])
+        params = conv.dna_to_parameters(dna)
+        assert params == {"ops[0]": "c", "ops[1]": "a"}
+        values = conv.parameters_to_dna_values(params)
+        assert values[0][1][0][0] == 2 and values[0][1][1][0] == 0
+
+    def test_bad_dna_arity_rejected(self):
+        conv = converters.DNASpecConverter(_nas_spec())
+        with pytest.raises(ValueError, match="children"):
+            conv.dna_to_parameters(DNA(children=[DNA(value=0)]))
+
+    def test_unknown_literal_rejected(self):
+        conv = converters.DNASpecConverter(_nas_spec())
+        with pytest.raises(ValueError, match="candidate literal"):
+            conv.parameters_to_dna_values({"model": "transformer", "lr": 0.01})
+
+    def test_missing_decision_rejected(self):
+        conv = converters.DNASpecConverter(_nas_spec())
+        with pytest.raises(ValueError, match="Missing decision"):
+            conv.parameters_to_dna_values({"model": "cnn", "lr": 0.01})
+
+
+class TestDuplicateLiterals:
+    def test_duplicate_primitives_disambiguated(self):
+        spec = Space(
+            elements=[
+                Choices(
+                    "act",
+                    [Space(), Space(elements=[Float("slope", 0.0, 1.0)])],
+                    literal_values=["relu", "relu"],  # equal literals!
+                )
+            ]
+        )
+        space = converters.to_search_space(spec)
+        values = list(space.get("act").feasible_values)
+        assert len(set(values)) == 2
+        conv = converters.DNASpecConverter(spec)
+        # Choice 1 (with the conditional child) round-trips to index 1.
+        params = conv.dna_to_parameters(
+            DNA(children=[DNA(value=1, children=[DNA(value=0.5)])])
+        )
+        rebuilt = conv.parameters_to_dna_values(params)
+        assert rebuilt[0][0] == 1
+        assert rebuilt[0][1][0][0] == 0.5
+
+
+class TestNonPrimitiveLiterals:
+    def test_index_prefixed_categories(self):
+        spec = Space(
+            elements=[
+                Choices(
+                    "layer",
+                    [Space(), Space()],
+                    literal_values=[{"type": "conv"}, {"type": "pool"}],
+                )
+            ]
+        )
+        space = converters.to_search_space(spec)
+        values = list(space.get("layer").feasible_values)
+        assert values[0].startswith("0/") and values[1].startswith("1/")
+        conv = converters.DNASpecConverter(spec)
+        params = conv.dna_to_parameters(DNA(children=[DNA(value=1)]))
+        assert params["layer"] == values[1]
+        assert conv.parameters_to_dna_values(params)[0][0] == 1

@@ -159,6 +159,79 @@ class DataStoreConformance:
         assert len(study.study_spec.metadata) == 1
         assert study.study_spec.metadata[0].string_value == "v2"
 
+    # -- error-path breadth (reference assert*API coverage) -----------------
+
+    def test_create_duplicate_trial_rejected(self, ds):
+        ds.create_study(make_study())
+        ds.create_trial(make_trial(trial_id=1))
+        with pytest.raises(datastore_lib.AlreadyExistsError):
+            ds.create_trial(make_trial(trial_id=1))
+
+    def test_get_missing_trial(self, ds):
+        ds.create_study(make_study())
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.get_trial("owners/o/studies/s/trials/99")
+
+    def test_update_missing_trial(self, ds):
+        ds.create_study(make_study())
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.update_trial(make_trial(trial_id=99))
+
+    def test_delete_missing_trial(self, ds):
+        ds.create_study(make_study())
+        ds.create_trial(make_trial(trial_id=1))
+        ds.delete_trial("owners/o/studies/s/trials/1")
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.delete_trial("owners/o/studies/s/trials/1")  # already deleted
+
+    def test_trial_ops_on_missing_study(self, ds):
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.max_trial_id("owners/o/studies/none")
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.list_trials("owners/o/studies/none")
+
+    def test_trial_pass_by_value(self, ds):
+        ds.create_study(make_study())
+        t = make_trial(trial_id=1)
+        ds.create_trial(t)
+        loaded = ds.get_trial(t.name)
+        assert loaded == t and loaded is not t
+        loaded.state = study_pb2.Trial.INFEASIBLE
+        assert ds.get_trial(t.name).state == study_pb2.Trial.ACTIVE
+
+    def test_create_duplicate_suggestion_op_rejected(self, ds):
+        ds.create_study(make_study())
+        name = resources.SuggestionOperationResource("o", "s", "c", 1).name
+        ds.create_suggestion_operation(vizier_service_pb2.Operation(name=name))
+        with pytest.raises(datastore_lib.AlreadyExistsError):
+            ds.create_suggestion_operation(vizier_service_pb2.Operation(name=name))
+
+    def test_update_missing_suggestion_op(self, ds):
+        ds.create_study(make_study())
+        name = resources.SuggestionOperationResource("o", "s", "c", 7).name
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.update_suggestion_operation(vizier_service_pb2.Operation(name=name))
+
+    def test_get_missing_suggestion_op(self, ds):
+        ds.create_study(make_study())
+        name = resources.SuggestionOperationResource("o", "s", "c", 7).name
+        with pytest.raises(datastore_lib.NotFoundError):
+            ds.get_suggestion_operation(name)
+
+    def test_multi_owner_isolation(self, ds):
+        ds.create_study(make_study(owner="alice", study="s1"))
+        ds.create_study(make_study(owner="bob", study="s1"))
+        ds.create_trial(make_trial(owner="alice", study="s1", trial_id=1))
+        assert len(ds.list_trials("owners/alice/studies/s1")) == 1
+        assert len(ds.list_trials("owners/bob/studies/s1")) == 0
+        assert len(ds.list_studies("owners/alice")) == 1
+
+    def test_list_studies_multiple(self, ds):
+        ds.create_study(make_study(study="s1"))
+        ds.create_study(make_study(study="s2"))
+        names = {s.display_name for s in ds.list_studies("owners/o")}
+        assert names == {"s1", "s2"}
+
     def test_delete_study_cascades(self, ds):
         ds.create_study(make_study())
         ds.create_trial(make_trial(trial_id=1))

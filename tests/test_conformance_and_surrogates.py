@@ -22,6 +22,35 @@ class TestOSSClientConformance(StudyConformance):
         )
 
 
+class TestGrpcClientConformance(StudyConformance):
+    """The same behavioral contract over a REAL localhost gRPC channel.
+
+    In-process-servicer and network transports must be indistinguishable
+    (reference ``client_abc_testing`` is run against both by
+    ``clients_test.py`` / cloud clients).
+    """
+
+    _server = None
+
+    @classmethod
+    def setup_class(cls):
+        from vizier_tpu.service import vizier_server
+
+        cls._server = vizier_server.DefaultVizierServer(host="localhost")
+
+    def setup_method(self):
+        clients_lib.environment_variables.server_endpoint = self._server.endpoint
+
+    def teardown_method(self):
+        clients_lib.environment_variables.server_endpoint = clients_lib.NO_ENDPOINT
+
+    def create_study(self, problem, study_id):
+        config = vz.StudyConfig.from_problem(problem, vz.Algorithm.RANDOM_SEARCH)
+        return clients_lib.Study.from_study_config(
+            config, owner="conformance-grpc", study_id=study_id
+        )
+
+
 class TestTabularSurrogate:
     def _experimenter(self):
         from vizier_tpu.benchmarks.experimenters.surrogates import (

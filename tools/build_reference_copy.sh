@@ -1,0 +1,54 @@
+#!/bin/bash
+# Builds a runnable copy of the read-only reference at /tmp/refvizier:
+#  - copies the tree (the original at /root/reference must stay untouched),
+#  - compiles its protos against the googleapis protos shipped inside
+#    site-packages (no network),
+#  - patches vizier/pyvizier/converters/__init__.py to tolerate the absence
+#    of equinox/tfp (those deps are not in this image and installs are
+#    banned, so the reference's GP stack cannot run; random / grid /
+#    quasi-random / NSGA2 / harmonica / eagle all work).
+# Used by parity_suite.py to measure the reference behaviorally (VERDICT r1
+# item #4 / BASELINE.md: the reference publishes no numbers, so it must be
+# run as its own baseline).
+set -e
+
+REF=${1:-/root/reference}
+DST=${2:-/tmp/refvizier}
+SP=$(python -c "import site; print(site.getsitepackages()[0])")
+
+rm -rf "$DST"
+mkdir -p "$DST"
+cp -r "$REF/vizier" "$DST/"
+
+# google/longrunning ships its proto under a different filename.
+INC=/tmp/protoinc
+mkdir -p "$INC/google/longrunning"
+cp "$SP/google/longrunning/operations_proto.proto" \
+   "$INC/google/longrunning/operations.proto"
+
+cd "$DST/vizier/_src/service"
+protoc -I. -I"$INC" -I"$SP" --python_out=. \
+  key_value.proto study.proto vizier_oss.proto \
+  vizier_service.proto pythia_service.proto
+
+python - << 'EOF'
+import pathlib
+p = pathlib.Path('/tmp/refvizier/vizier/pyvizier/converters/__init__.py')
+src = p.read_text()
+if 'ModuleNotFoundError' not in src:
+    out = []
+    for line in src.splitlines():
+        gated = any(
+            m in line
+            for m in ('jnp_converters', 'padding', 'feature_mapper', 'embedder', 'spatio')
+        )
+        if gated and line.startswith('from'):
+            out.append(
+                f"try:\n    {line}\nexcept ModuleNotFoundError:"
+                "  # equinox/tfp absent in this image\n    pass"
+            )
+        else:
+            out.append(line)
+    p.write_text('\n'.join(out) + '\n')
+print('reference copy ready at /tmp/refvizier')
+EOF

@@ -117,3 +117,116 @@ class StudyConformance(abc.ABC):
         a, b = study.suggest(count=2)
         a.delete()
         assert [t.id for t in study.trials()] == [b.id]
+
+    # -- worker semantics (reference `test_suggest_*`) -----------------------
+
+    def test_suggest_same_worker_reuses_active_trials(self):
+        """A crashed worker re-requesting suggestions gets its trials back."""
+        study = self.create_study(self._problem(), "conf-worker-same")
+        first = study.suggest(count=2, client_id="w1")
+        again = study.suggest(count=2, client_id="w1")
+        assert sorted(t.id for t in first) == sorted(t.id for t in again)
+
+    def test_suggest_different_workers_get_distinct_trials(self):
+        study = self.create_study(self._problem(), "conf-worker-diff")
+        a = study.suggest(count=2, client_id="w1")
+        b = study.suggest(count=2, client_id="w2")
+        assert not set(t.id for t in a) & set(t.id for t in b)
+
+    def test_completed_worker_gets_fresh_trials(self):
+        study = self.create_study(self._problem(), "conf-worker-fresh")
+        (t1,) = study.suggest(count=1, client_id="w1")
+        t1.complete(vz.Measurement(metrics={"obj": 0.5}))
+        (t2,) = study.suggest(count=1, client_id="w1")
+        assert t2.id != t1.id
+
+    # -- completion semantics ------------------------------------------------
+
+    def test_complete_no_measurements_is_infeasible(self):
+        study = self.create_study(self._problem(), "conf-complete-empty")
+        (trial,) = study.suggest(count=1)
+        trial.complete()
+        assert trial.materialize().infeasible
+
+    def test_complete_auto_selects_last_measurement(self):
+        study = self.create_study(self._problem(), "conf-complete-auto")
+        (trial,) = study.suggest(count=1)
+        trial.add_measurement(vz.Measurement(metrics={"obj": 0.1}, steps=1))
+        trial.add_measurement(vz.Measurement(metrics={"obj": 0.8}, steps=2))
+        trial.complete()
+        final = trial.materialize().final_measurement
+        assert final.metrics["obj"].value == 0.8
+
+    def test_measurement_after_completion_fails(self):
+        study = self.create_study(self._problem(), "conf-complete-immutable")
+        (trial,) = study.suggest(count=1)
+        trial.complete(vz.Measurement(metrics={"obj": 0.4}))
+        try:
+            trial.add_measurement(vz.Measurement(metrics={"obj": 0.5}))
+        except Exception:
+            pass
+        else:  # pragma: no cover
+            raise AssertionError("Completed trials must be immutable.")
+
+    def test_double_complete_fails(self):
+        study = self.create_study(self._problem(), "conf-complete-twice")
+        (trial,) = study.suggest(count=1)
+        trial.complete(vz.Measurement(metrics={"obj": 0.4}))
+        try:
+            trial.complete(vz.Measurement(metrics={"obj": 0.9}))
+        except Exception:
+            pass
+        else:  # pragma: no cover
+            raise AssertionError("Second complete() must fail.")
+
+    # -- early stopping ------------------------------------------------------
+
+    def test_stop_trial(self):
+        study = self.create_study(self._problem(), "conf-stop")
+        (trial,) = study.suggest(count=1)
+        trial.stop()
+        assert trial.materialize().status == vz.TrialStatus.STOPPING
+
+    def test_check_early_stopping_returns_bool(self):
+        study = self.create_study(self._problem(), "conf-earlystop")
+        (trial,) = study.suggest(count=1)
+        assert isinstance(trial.check_early_stopping(), bool)
+
+    # -- study lifecycle -----------------------------------------------------
+
+    def test_optimal_trials_on_empty_study(self):
+        study = self.create_study(self._problem(), "conf-optimal-empty")
+        assert len(list(study.optimal_trials())) == 0
+
+    def test_trials_iter_and_get_are_equal(self):
+        study = self.create_study(self._problem(), "conf-iter-get")
+        study.suggest(count=3)
+        for listed in study.trials():
+            direct = study.get_trial(listed.id)
+            assert direct.id == listed.id
+            assert direct.parameters == listed.parameters
+
+    def test_set_state_aborts_study(self):
+        study = self.create_study(self._problem(), "conf-state")
+        study.set_state(vz.StudyState.ABORTED)
+        config_or_state = study.materialize_state()
+        assert config_or_state == vz.StudyState.ABORTED
+
+    def test_delete_study(self):
+        study = self.create_study(self._problem(), "conf-delete-study")
+        study.suggest(count=1)
+        study.delete()
+        try:
+            study.get_trial(1)
+        except Exception:
+            pass
+        else:  # pragma: no cover
+            raise AssertionError("Deleted study must not serve trials.")
+
+    def test_trial_update_metadata(self):
+        study = self.create_study(self._problem(), "conf-trial-md")
+        (trial,) = study.suggest(count=1)
+        md = vz.Metadata()
+        md.ns("worker")["note"] = "t1"
+        trial.update_metadata(md)
+        assert trial.materialize().metadata.ns("worker")["note"] == "t1"

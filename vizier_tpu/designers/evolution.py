@@ -117,6 +117,7 @@ class NSGA2Designer(core_lib.PartiallySerializableDesigner):
         )
         self._enc = self._converter.encoder
         self._rng = np.random.default_rng(self.seed)
+        self._num_suggested = 0
         m = self._converter.metrics.num_metrics
         self._population = Population(
             continuous=np.zeros((0, self._enc.num_continuous)),
@@ -143,7 +144,16 @@ class NSGA2Designer(core_lib.PartiallySerializableDesigner):
         count = count or 1
         out: List[trial_.TrialSuggestion] = []
         pop = self._population
-        evaluated = len(pop) > 0 and np.isfinite(pop.objectives).any()
+        # NSGA-II is generation-based: the whole first generation is random.
+        # Starting crossover after only a few evaluated points collapses the
+        # population prematurely (visible as sub-random ZDT hypervolume).
+        in_first_generation = self._num_suggested < self.population_size
+        evaluated = (
+            not in_first_generation
+            and len(pop) > 0
+            and np.isfinite(pop.objectives).any()
+        )
+        self._num_suggested += count
         for _ in range(count):
             if not evaluated or len(pop) < 2:
                 cont = self._rng.uniform(size=(1, self._enc.num_continuous))
@@ -187,6 +197,7 @@ class NSGA2Designer(core_lib.PartiallySerializableDesigner):
                 "continuous": self._population.continuous,
                 "categorical": self._population.categorical,
                 "objectives": self._population.objectives,
+                "num_suggested": self._num_suggested,
             }
         )
         return md
@@ -203,6 +214,12 @@ class NSGA2Designer(core_lib.PartiallySerializableDesigner):
                 continuous=np.asarray(state["continuous"], dtype=np.float64),
                 categorical=np.asarray(state["categorical"], dtype=np.int32),
                 objectives=np.asarray(state["objectives"], dtype=np.float64),
+            )
+            # Older checkpoints lack num_suggested: a restored evaluated
+            # population implies its generation was already spent — do not
+            # re-run the random first generation after resume.
+            self._num_suggested = int(
+                state.get("num_suggested", len(self._population))
             )
         except (KeyError, ValueError, TypeError) as e:
             raise serializable.DecodeError(f"Bad population state: {e}")

@@ -73,8 +73,22 @@ class DNATrialConverter:
         return {k: v.value for k, v in trial.parameters.items()}
 
 
+def _build_pg_dna(values) -> "pg.DNA":  # pragma: no cover - needs pyglove
+    """Nested (value, children) tuples → pg.DNA tree."""
+
+    def node(v, children):
+        return pg.DNA(v, [node(*c) for c in children])
+
+    return pg.DNA(None, [node(*c) for c in values])
+
+
 class TunerPolicy(policy_lib.Policy):
-    """Hosts a PyGlove DNAGenerator as a Pythia policy."""
+    """Hosts a PyGlove DNAGenerator as a Pythia policy.
+
+    With a structured DNASpec, trials round-trip through
+    ``converters.DNASpecConverter`` (full tree: conditional candidate
+    subspaces, multi-subchoices, floats); dict-DNAs keep the plain encoding.
+    """
 
     def __init__(self, supporter, dna_spec, algorithm):
         if not PYGLOVE_AVAILABLE:
@@ -84,10 +98,28 @@ class TunerPolicy(policy_lib.Policy):
         self._algorithm = algorithm  # a pg.DNAGenerator
         self._algorithm.setup(dna_spec)
         self._fed_ids: set = set()
+        self._tree_converter = None
+        if hasattr(dna_spec, "elements"):
+            from vizier_tpu.pyglove import converters as pg_converters
+
+            self._tree_converter = pg_converters.DNASpecConverter(dna_spec)
 
     @property
     def should_be_cached(self) -> bool:
         return True
+
+    def _trial_to_dna(self, t: vz.Trial) -> "pg.DNA":
+        if self._tree_converter is not None:
+            dna = _build_pg_dna(self._tree_converter.to_dna_values(t))
+        else:
+            dna = pg.DNA(DNATrialConverter.to_decisions(t))  # type: ignore[union-attr]
+        dna.use_spec(self._dna_spec)
+        return dna
+
+    def _dna_to_suggestion(self, dna) -> vz.TrialSuggestion:
+        if self._tree_converter is not None:
+            return self._tree_converter.to_trial_suggestion(dna)
+        return DNATrialConverter.to_suggestion(dna.to_dict())
 
     def suggest(self, request: policy_lib.SuggestRequest) -> policy_lib.SuggestDecision:
         # Feed newly-completed FEASIBLE trials back into the generator.
@@ -95,17 +127,14 @@ class TunerPolicy(policy_lib.Policy):
         for t in completed:
             if t.id in self._fed_ids or t.final_measurement is None or t.infeasible:
                 continue
-            decisions = DNATrialConverter.to_decisions(t)
-            dna = pg.DNA(decisions)  # type: ignore[union-attr]
-            dna.use_spec(self._dna_spec)
             metrics = t.final_measurement.metrics
             metric = metrics.get("reward") or next(iter(metrics.values()))
-            self._algorithm.feedback(dna, metric.value)
+            self._algorithm.feedback(self._trial_to_dna(t), metric.value)
             self._fed_ids.add(t.id)
         suggestions = []
         for _ in range(request.count):
             dna = self._algorithm.propose()
-            suggestions.append(DNATrialConverter.to_suggestion(dna.to_dict()))
+            suggestions.append(self._dna_to_suggestion(dna))
         return policy_lib.SuggestDecision(suggestions=suggestions)
 
 

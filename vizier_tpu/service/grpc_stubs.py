@@ -88,17 +88,42 @@ def add_pythia_servicer_to_server(servicer, server) -> None:
 
 
 class _Stub:
-    """Callable-per-method stub: ``stub.GetStudy(request) -> Study``."""
+    """Callable-per-method stub: ``stub.GetStudy(request) -> Study``.
+
+    Status codes are translated back into the exceptions the in-process
+    servicer raises (NOT_FOUND → datastore NotFoundError, INVALID_ARGUMENT →
+    ValueError), so the network and in-process transports are
+    indistinguishable to callers — the substitutability contract the client
+    conformance suite checks on both.
+    """
 
     def __init__(self, channel: grpc.Channel, service_name: str, methods):
+        from vizier_tpu.service import datastore as datastore_lib
+
+        def bind(callable_):
+            def call(request):
+                try:
+                    return callable_(request)
+                except grpc.RpcError as e:  # pragma: no branch
+                    code = e.code() if hasattr(e, "code") else None
+                    if code == grpc.StatusCode.NOT_FOUND:
+                        raise datastore_lib.NotFoundError(e.details()) from e
+                    if code == grpc.StatusCode.INVALID_ARGUMENT:
+                        raise ValueError(e.details()) from e
+                    raise
+
+            return call
+
         for name, (req_cls, resp_cls) in methods.items():
             setattr(
                 self,
                 name,
-                channel.unary_unary(
-                    f"/{service_name}/{name}",
-                    request_serializer=req_cls.SerializeToString,
-                    response_deserializer=resp_cls.FromString,
+                bind(
+                    channel.unary_unary(
+                        f"/{service_name}/{name}",
+                        request_serializer=req_cls.SerializeToString,
+                        response_deserializer=resp_cls.FromString,
+                    )
                 ),
             )
 
