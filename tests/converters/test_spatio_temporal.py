@@ -1,0 +1,24 @@
+
+
+class TestDenseConverter:
+    def test_interpolated_fixed_grid(self):
+        from vizier_tpu.converters import spatio_temporal as st
+        from vizier_tpu import pyvizier as vz
+        from vizier_tpu.pyvizier import trial as trial_
+
+        metrics = vz.MetricsConfig([vz.MetricInformation(name="loss")])
+        extractor = st.TimedLabelsExtractor(metrics)
+        conv = st.DenseSpatioTemporalConverter(extractor, num_steps=5)
+        t1 = trial_.Trial(id=1, parameters={})
+        for s, v in [(0.0, 0.0), (4.0, 4.0)]:
+            t1.measurements.append(
+                trial_.Measurement(metrics={"loss": v}, steps=s)
+            )
+        t2 = trial_.Trial(id=2, parameters={})  # no measurements
+        values, grid = conv.to_arrays([t1, t2])
+        assert values.shape == (2, 5, 1)
+        import numpy as np
+
+        np.testing.assert_allclose(values[0, :, 0], [0, 1, 2, 3, 4])
+        assert np.isnan(values[1]).all()
+        np.testing.assert_allclose(grid, [0, 1, 2, 3, 4])

@@ -108,6 +108,56 @@ class TestMetaLearningPhases:
         assert len(d._meta_trials) == n_meta
 
 
+class TestHarmonicaStages:
+    def test_staged_fixing_converges(self):
+        from vizier_tpu.designers.harmonica import HarmonicaDesigner
+
+        p = vz.ProblemStatement()
+        for i in range(10):
+            p.search_space.root.add_bool_param(f"b{i}")
+        p.metric_information.append(
+            vz.MetricInformation(
+                name="objective", goal=vz.ObjectiveMetricGoal.MAXIMIZE
+            )
+        )
+        d = HarmonicaDesigner(p, seed=0, samples_per_stage=16, num_fixed_per_stage=2)
+        tid = 0
+        for _ in range(9):
+            trials = []
+            for s in d.suggest(8):
+                tid += 1
+                t = s.to_trial(tid)
+                bits = [
+                    1.0 if str(t.parameters[f"b{i}"].value) == "True" else 0.0
+                    for i in range(10)
+                ]
+                t.complete(
+                    vz.Measurement(
+                        metrics={"objective": 5 * bits[0] + 4 * bits[1] + 0.1 * sum(bits[2:])}
+                    )
+                )
+                trials.append(t)
+            d.update(core_lib.CompletedTrials(trials))
+        # Stages advanced; the dominant variables are fixed to True.
+        assert d._stage >= 2
+        assert d._fixed.get(0) == 1 and d._fixed.get(1) == 1
+
+    def test_stage_budget_not_reached_keeps_sampling(self):
+        from vizier_tpu.designers.harmonica import HarmonicaDesigner
+
+        p = vz.ProblemStatement()
+        for i in range(4):
+            p.search_space.root.add_bool_param(f"b{i}")
+        p.metric_information.append(
+            vz.MetricInformation(
+                name="objective", goal=vz.ObjectiveMetricGoal.MAXIMIZE
+            )
+        )
+        d = HarmonicaDesigner(p, seed=0, samples_per_stage=100)
+        assert len(d.suggest(5)) == 5
+        assert d._stage == 0 and not d._fixed
+
+
 class TestBocsUpgrades:
     def _loop(self, designer, exp, rounds=5, batch=2):
         tid = 0

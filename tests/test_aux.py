@@ -197,12 +197,62 @@ class TestIntegrations:
         assert space.parameter_names() == ["lr", "units", "act", "drop"]
         assert space.get("lr").scale_type == vz.ScaleType.LOG
 
-    def test_raytune_searcher_requires_ray(self):
-        from vizier_tpu.raytune import vizier_search
+    def test_raytune_searcher_full_loop(self, tmp_path):
+        """The ray Searcher behavioral contract runs ray-free against the
+        in-process service: suggest → intermediate results → complete."""
+        from vizier_tpu.raytune.vizier_search import VizierSearch
 
-        if not vizier_search._RAY_AVAILABLE:
-            with pytest.raises(ImportError):
-                vizier_search.VizierSearch({"x": {"type": "uniform", "min": 0, "max": 1}}, metric="m")
+        searcher = VizierSearch(
+            {"x": {"type": "uniform", "min": 0.0, "max": 1.0}},
+            metric="score",
+            mode="max",
+            algorithm="RANDOM_SEARCH",
+            study_id="raytune-loop",
+        )
+        for i in range(4):
+            cfg = searcher.suggest(f"ray_{i}")
+            assert 0.0 <= float(cfg["x"]) <= 1.0
+            searcher.on_trial_result(
+                f"ray_{i}", {"score": 0.1, "training_iteration": 1}
+            )
+            searcher.on_trial_complete(f"ray_{i}", {"score": float(cfg["x"])})
+        searcher.on_trial_complete("ray_err", error=True)  # unknown id: no-op
+        trials = list(searcher._study.trials())
+        assert len(trials) == 4
+        assert all(t.materialize().is_completed for t in trials)
+
+    def test_raytune_searcher_save_restore(self, tmp_path):
+        from vizier_tpu.raytune.vizier_search import VizierSearch
+
+        s1 = VizierSearch(
+            {"x": {"type": "uniform", "min": 0.0, "max": 1.0}},
+            metric="score",
+            algorithm="RANDOM_SEARCH",
+            study_id="raytune-ckpt",
+        )
+        s1.suggest("r1")
+        path = str(tmp_path / "searcher.json")
+        s1.save(path)
+        s2 = VizierSearch(metric="score")
+        s2.restore(path)
+        assert s2._ray_to_vizier == s1._ray_to_vizier
+        # The restored searcher completes the in-flight trial.
+        s2.on_trial_complete("r1", {"score": 0.5})
+        assert s2._study.get_trial(1).materialize().is_completed
+
+    def test_raytune_set_search_properties_late_binding(self):
+        from vizier_tpu.raytune.vizier_search import VizierSearch
+
+        searcher = VizierSearch(study_id="raytune-late")
+        assert searcher.suggest("r0") is None  # not ready yet
+        ok = searcher.set_search_properties(
+            "score", "min", {"y": {"type": "randint", "min": 1, "max": 4}}
+        )
+        assert ok
+        cfg = searcher.suggest("r1")
+        assert 1 <= int(cfg["y"]) <= 4
+        # A second call must refuse (study already bound).
+        assert not searcher.set_search_properties("other", "max", {})
 
     def test_pyglove_dna_converter(self):
         from vizier_tpu.pyglove.backend import DNATrialConverter

@@ -89,3 +89,40 @@ class SparseSpatioTemporalConverter:
             values[i, ~valid] = np.nan
             mask[i] = valid
         return values, mask, grid
+
+
+@dataclasses.dataclass
+class DenseSpatioTemporalConverter:
+    """Interpolated dense curves on a fixed-size grid → [N, T, M].
+
+    Unlike the sparse carry-forward aligner, values are linearly interpolated
+    inside each trial's reported range (and clamped at its ends) on an
+    evenly-spaced grid — the input format for batched curve-regression
+    models (``algorithms/regression.py``): fixed T regardless of each
+    trial's measurement cadence.
+    """
+
+    extractor: TimedLabelsExtractor
+    num_steps: int = 16
+
+    def to_arrays(
+        self, trials: Sequence[trial_.Trial], *, max_position: Optional[float] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        curves = self.extractor.convert(trials)
+        if max_position is None:
+            tops = [c.positions.max() for c in curves if len(c.positions)]
+            max_position = float(max(tops)) if tops else 1.0
+        grid = np.linspace(0.0, max_position, self.num_steps)
+        n = len(trials)
+        m = len(self.extractor.metrics)
+        values = np.full((n, self.num_steps, m), np.nan)
+        for i, c in enumerate(curves):
+            if not len(c.positions):
+                continue
+            order = np.argsort(c.positions)
+            pos, val = c.positions[order], c.values[order]
+            for j in range(m):
+                finite = np.isfinite(val[:, j])
+                if finite.any():
+                    values[i, :, j] = np.interp(grid, pos[finite], val[finite, j])
+        return values, grid

@@ -215,7 +215,10 @@ class EagleStrategyDesigner(core_lib.PartiallySerializableDesigner):
                         perturbation=cfg.perturbation,
                     )
                 elif np.isfinite(reward):
-                    # Pool full: adopt as child of the nearest fly.
+                    # Pool full: adopt into the closest fly ONLY if the trial
+                    # improves on it — the closest parent is not responsible
+                    # for a foreign failure (reference _assign_closest_parent),
+                    # so non-improving orphans must not penalize it.
                     nearest = min(
                         self._pool,
                         key=lambda fid: np.sum(
@@ -223,7 +226,8 @@ class EagleStrategyDesigner(core_lib.PartiallySerializableDesigner):
                         )
                         + np.sum(self._pool[fid].cat != cat[0]),
                     )
-                    self._settle(nearest, cont[0], cat[0], reward)
+                    if reward > self._pool[nearest].reward:
+                        self._settle(nearest, cont[0], cat[0], reward)
                 continue
             self._settle(fly_id, cont[0], cat[0], reward)
 
@@ -232,19 +236,21 @@ class EagleStrategyDesigner(core_lib.PartiallySerializableDesigner):
         cfg = self.config
         fly = self._pool[fly_id]
         if reward > fly.reward:
+            # Perturbation stays put on improvement (the reference only
+            # boosts it when a fly is stuck repeating the same point).
             fly.x = np.asarray(x, dtype=np.float64)
             fly.cat = np.asarray(cat, dtype=np.int32)
             fly.reward = reward
-            fly.perturbation = min(
-                fly.perturbation / cfg.penalize_factor, cfg.max_perturbation
-            )
         else:
             fly.perturbation *= cfg.penalize_factor
             if (
                 fly.perturbation < cfg.perturbation_lower_bound
                 and fly_id != self._best_id()
+                and len(self._pool) >= self._capacity
             ):
-                # Exhausted: evict; the pool refills with a random fly.
+                # Exhausted AND the pool is full: evict to make room for a
+                # fresh random fly. Below capacity the stalled fly is kept —
+                # in studies with few feasible trials it still carries signal.
                 del self._pool[fly_id]
 
     # -- PartiallySerializable --------------------------------------------

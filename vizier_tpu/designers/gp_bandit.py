@@ -530,18 +530,47 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
 
     # -- Predictor ---------------------------------------------------------
 
+    def sample(
+        self,
+        suggestions: Sequence[trial_.TrialSuggestion],
+        rng: Optional[Array] = None,
+        num_samples: int = 1000,
+    ) -> np.ndarray:
+        """UNWARPED posterior samples [S, T] (original metric scale).
+
+        Reference ``VizierGPBandit.sample``: draw in the warped space the GP
+        was trained in, then invert the output-warper pipeline.
+        """
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        if not suggestions:
+            return np.zeros((num_samples, 0))
+        predictive = self._require_predictive()
+        feats = self._encode_suggestions(suggestions)
+        mean, stddev = predictive.predict(feats)
+        eps = jax.random.normal(rng, (num_samples,) + mean.shape, mean.dtype)
+        warped = np.asarray(mean[None] + stddev[None] * eps)  # [S, T]
+        try:
+            return self._warper.unwarp(warped.reshape(-1, 1)).reshape(warped.shape)
+        except (ValueError, NotImplementedError):
+            # Warper not fitted yet (predict before any training labels).
+            return warped
+
     def predict(
         self,
         suggestions: Sequence[trial_.TrialSuggestion],
         rng: Optional[np.random.Generator] = None,
         num_samples: Optional[int] = None,
     ) -> core_lib.Prediction:
-        """Posterior prediction in *warped* label space (all-MAXIMIZE)."""
-        del rng, num_samples
-        predictive = self._require_predictive()
-        feats = self._encode_suggestions(suggestions)
-        mean, stddev = predictive.predict(feats)
-        return core_lib.Prediction(mean=np.asarray(mean), stddev=np.asarray(stddev))
+        """Empirical mean/stddev of UNWARPED posterior samples.
+
+        Parity with the reference predict contract (``gp_bandit.py`` predict
+        → sample → unwarp): values come back in the original metric scale.
+        """
+        samples = self.sample(suggestions, num_samples=num_samples or 1000)
+        return core_lib.Prediction(
+            mean=np.mean(samples, axis=0), stddev=np.std(samples, axis=0)
+        )
 
     def _require_predictive(self) -> gp_lib.EnsemblePredictive:
         if self._last_predictive is None:

@@ -213,11 +213,22 @@ class VizierGaussianProcess:
 
     def precompute_constrained(self, p: Params, data: GPData) -> "GPState":
         """Precompute from already-constrained params (e.g. after a noise
-        override for pure-exploration conditioning, gp_ucb_pe.py)."""
+        override for pure-exploration conditioning, gp_ucb_pe.py).
+
+        Also forms L^-1 explicitly: the acquisition sweep calls predict()
+        thousands of times per suggest, and a precomputed inverse turns each
+        per-query triangular solve (sequential, slow on TPU) into a plain
+        matmul that rides the MXU. One extra O(N^3) solve here is amortized
+        over ~3000 sweep iterations.
+        """
         gram = self._masked_gram(p, data)
         chol = jnp.linalg.cholesky(gram)
         alpha = jax.scipy.linalg.cho_solve((chol, True), data.labels)
-        return GPState(model=self, params=p, data=data, chol=chol, alpha=alpha)
+        eye = jnp.eye(chol.shape[0], dtype=chol.dtype)
+        linv = jax.scipy.linalg.solve_triangular(chol, eye, lower=True)
+        return GPState(
+            model=self, params=p, data=data, chol=chol, alpha=alpha, linv=linv
+        )
 
 
 @flax.struct.dataclass
@@ -229,6 +240,7 @@ class GPState:
     data: GPData
     chol: Array  # [N, N]
     alpha: Array  # [N]
+    linv: Array  # [N, N] = chol^-1 (matmul-only predicts; MXU-friendly)
 
     def predict(
         self, query: kernels.MixedFeatures, *, include_noise: bool = False
@@ -238,7 +250,7 @@ class GPState:
         k_star = model._kernel(p, query, data.features(), data)  # [M, N]
         k_star = jnp.where(data.row_mask[None, :], k_star, 0.0)
         mean = k_star @ self.alpha
-        v = jax.scipy.linalg.solve_triangular(self.chol, k_star.T, lower=True)  # [N, M]
+        v = self.linv @ k_star.T  # [N, M] — pure matmul in the hot loop
         prior_var = p["amplitude"] * p["amplitude"]
         var = prior_var - jnp.sum(v * v, axis=0)
         if include_noise:
@@ -255,7 +267,7 @@ class GPState:
         k_star = model._kernel(p, query, data.features(), data)  # [M, N]
         k_star = jnp.where(data.row_mask[None, :], k_star, 0.0)
         mean = k_star @ self.alpha
-        v = jax.scipy.linalg.solve_triangular(self.chol, k_star.T, lower=True)  # [N, M]
+        v = self.linv @ k_star.T  # [N, M]
         k_qq = model._kernel(p, query, query, data)  # [M, M]
         cov = k_qq - v.T @ v
         # Symmetrize + jitter for downstream Cholesky.

@@ -2,9 +2,10 @@
 
 Parity with ``/root/reference/vizier/_src/raytune/vizier_search.py:32`` and
 ``converters.py``: a ``ray.tune.search.Searcher`` backed by the vizier-tpu
-study service. Ray is not bundled in this image, so the module degrades to a
-clear ImportError at construction time while remaining importable (the
-search-space converter is pure and fully testable without ray).
+study service. The whole behavioral contract (suggest / result / complete /
+save / restore / late property binding) is ray-free and tested against the
+in-process service; ray — absent from this image — is only needed as the
+base class when plugging into a real ``tune.Tuner``.
 """
 
 from __future__ import annotations
@@ -70,38 +71,72 @@ class SearchSpaceConverter:
 
 
 class VizierSearch(_RaySearcher):
-    """ray.tune Searcher delegating suggestions to a vizier-tpu study."""
+    """ray.tune Searcher delegating suggestions to a vizier-tpu study.
+
+    The full ``Searcher`` behavioral contract — ``suggest`` /
+    ``on_trial_result`` / ``on_trial_complete`` / ``save`` / ``restore`` /
+    late ``set_search_properties`` binding — is implemented without any ray
+    dependency (and covered by tests against the in-process service); with
+    ray installed the class plugs straight into ``tune.Tuner`` as its base
+    class becomes ``ray.tune.search.Searcher``.
+    """
 
     def __init__(
         self,
-        param_space: Dict[str, Any],
+        param_space: Optional[Dict[str, Any]] = None,
         *,
-        metric: str,
+        metric: Optional[str] = None,
         mode: str = "max",
         algorithm: str = "DEFAULT",
+        owner: str = "raytune",
+        study_id: Optional[str] = None,
         **kwargs,
     ):
-        if not _RAY_AVAILABLE:
-            raise ImportError(
-                "ray is not installed in this environment; VizierSearch requires "
-                "ray[tune]. The SearchSpaceConverter works standalone."
-            )
-        super().__init__(metric=metric, mode=mode, **kwargs)
+        if _RAY_AVAILABLE:
+            super().__init__(metric=metric, mode=mode, **kwargs)
+        self._metric = metric
+        self._mode = mode
+        self._algorithm = algorithm
+        self._owner = owner
+        self._study_id = study_id
+        self._study = None
+        self._ray_to_vizier: Dict[str, int] = {}
+        if param_space is not None and metric is not None:
+            self._create_study(param_space)
+
+    def _create_study(self, param_space: Dict[str, Any]) -> None:
         goal = (
             vz.ObjectiveMetricGoal.MAXIMIZE
-            if mode == "max"
+            if self._mode == "max"
             else vz.ObjectiveMetricGoal.MINIMIZE
         )
-        config = vz.StudyConfig(algorithm=algorithm)
+        config = vz.StudyConfig(algorithm=self._algorithm)
         config.search_space = SearchSpaceConverter.to_vizier(param_space)
         config.metric_information.append(
-            vz.MetricInformation(name=metric, goal=goal)
+            vz.MetricInformation(name=self._metric, goal=goal)
         )
-        self._study = clients.Study.from_study_config(config, owner="raytune")
-        self._ray_to_vizier: Dict[str, int] = {}
-        self._metric = metric
+        self._study = clients.Study.from_study_config(
+            config, owner=self._owner, study_id=self._study_id
+        )
+
+    def set_search_properties(
+        self, metric: Optional[str], mode: Optional[str], config: Dict, **spec
+    ) -> bool:
+        """Late binding: ray calls this when the Tuner supplies the space."""
+        if self._study is not None:
+            return False
+        if metric:
+            self._metric = metric
+        if mode:
+            self._mode = mode
+        if self._metric is None or not config:
+            return False
+        self._create_study(config)
+        return True
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._study is None:
+            return None  # ray contract: None = not ready / finished
         (trial,) = self._study.suggest(count=1, client_id=trial_id)
         self._ray_to_vizier[trial_id] = trial.id
         return dict(trial.parameters)
@@ -110,7 +145,7 @@ class VizierSearch(_RaySearcher):
         self, trial_id: str, result: Optional[Dict] = None, error: bool = False
     ) -> None:
         uid = self._ray_to_vizier.pop(trial_id, None)
-        if uid is None:
+        if uid is None or self._study is None:
             return
         trial = self._study.get_trial(uid)
         if error or result is None or self._metric not in result:
@@ -122,10 +157,41 @@ class VizierSearch(_RaySearcher):
 
     def on_trial_result(self, trial_id: str, result: Dict) -> None:
         uid = self._ray_to_vizier.get(trial_id)
-        if uid is not None and self._metric in result:
+        if uid is not None and self._study is not None and self._metric in result:
             self._study.get_trial(uid).add_measurement(
                 vz.Measurement(
                     metrics={self._metric: float(result[self._metric])},
                     steps=float(result.get("training_iteration", 0)),
                 )
+            )
+
+    # -- checkpointing (ray Searcher save/restore contract) -----------------
+
+    def save(self, checkpoint_path: str) -> None:
+        """Persists the ray↔vizier trial map + study pointer; study state
+        itself lives in the vizier service (restart-transparent)."""
+        import json
+
+        state = {
+            "ray_to_vizier": self._ray_to_vizier,
+            "study_resource_name": (
+                self._study.resource_name if self._study is not None else None
+            ),
+            "metric": self._metric,
+            "mode": self._mode,
+        }
+        with open(checkpoint_path, "w") as f:
+            json.dump(state, f)
+
+    def restore(self, checkpoint_path: str) -> None:
+        import json
+
+        with open(checkpoint_path) as f:
+            state = json.load(f)
+        self._ray_to_vizier = {k: int(v) for k, v in state["ray_to_vizier"].items()}
+        self._metric = state["metric"]
+        self._mode = state["mode"]
+        if state["study_resource_name"]:
+            self._study = clients.Study.from_resource_name(
+                state["study_resource_name"]
             )
