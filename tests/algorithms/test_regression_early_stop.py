@@ -1,0 +1,109 @@
+"""Regression-rule early stopping: policy behavior + service dispatch."""
+
+import numpy as np
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import early_stopping
+from vizier_tpu.pythia import local_policy_supporters
+from vizier_tpu.pythia import policy as policy_lib
+from vizier_tpu.pyvizier import trial as trial_
+
+
+def _problem():
+    p = vz.ProblemStatement()
+    p.search_space.root.add_float_param("lr", 0.01, 1.0)
+    p.metric_information.append(
+        vz.MetricInformation(name="acc", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return p
+
+
+def _curve_trial(tid, lr, n_steps=10, partial=False):
+    """acc curves saturate at lr/(lr+0.1): larger lr → better final."""
+    t = trial_.Trial(id=tid, parameters={"lr": lr})
+    top = lr / (lr + 0.1)
+    steps = range(1, (4 if partial else n_steps) + 1)
+    for s in steps:
+        t.measurements.append(
+            trial_.Measurement(
+                metrics={"acc": top * (1 - np.exp(-s / 3.0))}, steps=s
+            )
+        )
+    if not partial:
+        t.complete(trial_.Measurement(metrics={"acc": top}, steps=n_steps))
+    return t
+
+
+def _supporter_with_history(num_completed=20, seed=0):
+    supporter = local_policy_supporters.InRamPolicySupporter(_problem())
+    rng = np.random.default_rng(seed)
+    trials = [
+        _curve_trial(i + 1, float(lr))
+        for i, lr in enumerate(rng.uniform(0.02, 0.9, size=num_completed))
+    ]
+    supporter.AddTrials(trials)
+    return supporter
+
+
+class TestRegressionEarlyStopPolicy:
+    def _decide(self, supporter, active_trials):
+        # AddTrials reassigns ids; recover them from the stored copies.
+        supporter.AddTrials(active_trials)
+        stored = supporter.GetTrials()[-len(active_trials):]
+        ids = [t.id for t in stored]
+        policy = early_stopping.RegressionEarlyStopPolicy(
+            supporter=supporter, min_num_trials=10
+        )
+        request = policy_lib.EarlyStopRequest(
+            study_descriptor=supporter.study_descriptor(),
+            trial_ids=ids,
+        )
+        decisions = {d.id: d for d in policy.early_stop(request).decisions}
+        return [decisions[i] for i in ids]
+
+    def test_bad_trajectory_stopped_good_kept(self):
+        supporter = _supporter_with_history()
+        bad = _curve_trial(100, 0.03, partial=True)  # saturates low
+        good = _curve_trial(101, 0.85, partial=True)  # saturates high
+        d_bad, d_good = self._decide(supporter, [bad, good])
+        assert d_bad.should_stop
+        assert not d_good.should_stop
+
+    def test_underfit_keeps_running(self):
+        supporter = _supporter_with_history(num_completed=3)
+        active = _curve_trial(50, 0.05, partial=True)
+        (d,) = self._decide(supporter, [active])
+        assert not d.should_stop
+        assert "Too little" in d.reason
+
+    def test_no_curve_keeps_running(self):
+        supporter = _supporter_with_history()
+        bare = trial_.Trial(id=60, parameters={"lr": 0.5})
+        (d,) = self._decide(supporter, [bare])
+        assert not d.should_stop
+
+
+class TestServiceDispatch:
+    def test_rule_round_trips_and_selects_policy(self):
+        from vizier_tpu.service import proto_converters
+
+        config = vz.StudyConfig.from_problem(_problem(), vz.Algorithm.RANDOM_SEARCH)
+        config.automated_stopping_config = (
+            vz.AutomatedStoppingConfig.regression_stopping_spec(min_num_trials=7)
+        )
+        proto = proto_converters.study_config_to_proto(config)
+        assert proto.early_stopping.rule == "regression"
+        back = proto_converters.study_config_from_proto(proto)
+        assert back.automated_stopping_config.rule == "regression"
+        assert back.automated_stopping_config.min_num_trials == 7
+
+    def test_median_default_for_old_protos(self):
+        from vizier_tpu.service import proto_converters
+        from vizier_tpu.service.protos import study_pb2
+
+        config = vz.StudyConfig.from_problem(_problem(), vz.Algorithm.RANDOM_SEARCH)
+        config.automated_stopping_config = vz.AutomatedStoppingConfig()
+        proto = proto_converters.study_config_to_proto(config)
+        proto.early_stopping.rule = ""  # pre-field serialization
+        back = proto_converters.study_config_from_proto(proto)
+        assert back.automated_stopping_config.rule == "median"

@@ -129,3 +129,103 @@ class MedianEarlyStopPolicy(policy_lib.Policy):
                 )
             )
         return policy_lib.EarlyStopDecisions(decisions=decisions)
+
+
+@dataclasses.dataclass
+class RegressionEarlyStopPolicy(policy_lib.Policy):
+    """Curve-regression stopping rule (reference trial_regression_utils role).
+
+    Trains the gradient-boosted final-objective regressor
+    (``algorithms/regression.py``) on completed trials' curves and stops any
+    ACTIVE trial whose predicted final objective falls below the median
+    completed final — sharper than the median rule once enough curves exist
+    (a trial that starts slow but trends well is kept; one plateauing below
+    the pack is cut even while its current value still looks median-ish).
+    Falls back to keep-running while the regressor is underfit.
+    """
+
+    supporter: supporter_lib.PolicySupporter
+    min_num_trials: int = 10
+
+    def __post_init__(self):
+        # GBM training is the expensive step; cache the fit keyed by the
+        # completed-trial count so repeated CheckTrialEarlyStoppingState
+        # polls between completions reuse it (this policy object itself is
+        # cached per study by the Pythia servicer).
+        self._regressor = None
+        self._trained_on = -1
+
+    @property
+    def should_be_cached(self) -> bool:
+        return True
+
+    def suggest(self, request: policy_lib.SuggestRequest) -> policy_lib.SuggestDecision:
+        raise NotImplementedError("RegressionEarlyStopPolicy only early-stops.")
+
+    def _trained_regressor(self, metric: str, completed):
+        from vizier_tpu.algorithms import regression
+
+        if len(completed) == self._trained_on:
+            return self._regressor
+        regressor = regression.GBMAutoRegressor(
+            metric, min_train_trials=self.min_num_trials
+        )
+        self._regressor = regressor if regressor.train(completed) else None
+        self._trained_on = len(completed)
+        return self._regressor
+
+    def early_stop(
+        self, request: policy_lib.EarlyStopRequest
+    ) -> policy_lib.EarlyStopDecisions:
+        config = request.study_config
+        problem = config.to_problem()
+        metric_info = next(
+            (m for m in problem.metric_information if not m.is_safety_metric), None
+        )
+        if metric_info is None:
+            return policy_lib.EarlyStopDecisions()
+        metric = metric_info.name
+        sign = 1.0 if metric_info.goal.is_maximize else -1.0
+
+        all_trials = self.supporter.GetTrials()
+        completed = [t for t in all_trials if t.is_completed and not t.infeasible]
+        decisions = []
+
+        regressor = (
+            self._trained_regressor(metric, completed)
+            if len(completed) >= self.min_num_trials
+            else None
+        )
+        trained = regressor is not None
+        if trained:
+            finals = [
+                sign * t.final_measurement.metrics[metric].value
+                for t in completed
+                if t.final_measurement and metric in t.final_measurement.metrics
+            ]
+            threshold = float(np.median(finals)) if finals else -np.inf
+        for tid in sorted(request.trial_ids):
+            trial = next((t for t in all_trials if t.id == tid), None)
+            if trial is None:
+                continue
+            if not trained or not trial.measurements:
+                decisions.append(
+                    policy_lib.EarlyStopDecision(
+                        id=tid, reason="Too little curve data.", should_stop=False
+                    )
+                )
+                continue
+            pred = regressor.predict(trial)
+            should = pred is not None and sign * pred < threshold
+            decisions.append(
+                policy_lib.EarlyStopDecision(
+                    id=tid,
+                    reason=(
+                        f"Predicted final {pred:.4g} below completed median."
+                        if should
+                        else "Predicted final at or above completed median."
+                    ),
+                    should_stop=bool(should),
+                )
+            )
+        return policy_lib.EarlyStopDecisions(decisions=decisions)

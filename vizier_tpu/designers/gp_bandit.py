@@ -45,6 +45,16 @@ from vizier_tpu.pyvizier import trial as trial_
 Array = jax.Array
 
 
+def _as_prng_key(rng) -> Array:
+    """Coerces the Predictor contract's rng (numpy Generator | PRNGKey |
+    None) into a jax PRNGKey."""
+    if rng is None:
+        return jax.random.PRNGKey(0)
+    if isinstance(rng, np.random.Generator):
+        return jax.random.PRNGKey(int(rng.integers(0, 2**31 - 1)))
+    return rng
+
+
 @functools.partial(
     jax.jit, static_argnames=("model", "optimizer", "num_restarts", "ensemble_size")
 )
@@ -533,16 +543,16 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     def sample(
         self,
         suggestions: Sequence[trial_.TrialSuggestion],
-        rng: Optional[Array] = None,
+        rng=None,
         num_samples: int = 1000,
     ) -> np.ndarray:
         """UNWARPED posterior samples [S, T] (original metric scale).
 
         Reference ``VizierGPBandit.sample``: draw in the warped space the GP
-        was trained in, then invert the output-warper pipeline.
+        was trained in, then invert the output-warper pipeline. ``rng`` may
+        be a jax PRNGKey OR a numpy Generator (the Predictor base contract).
         """
-        if rng is None:
-            rng = jax.random.PRNGKey(0)
+        rng = _as_prng_key(rng)
         if not suggestions:
             return np.zeros((num_samples, 0))
         predictive = self._require_predictive()
@@ -567,7 +577,7 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         Parity with the reference predict contract (``gp_bandit.py`` predict
         → sample → unwarp): values come back in the original metric scale.
         """
-        samples = self.sample(suggestions, num_samples=num_samples or 1000)
+        samples = self.sample(suggestions, rng=rng, num_samples=num_samples or 1000)
         return core_lib.Prediction(
             mean=np.mean(samples, axis=0), stddev=np.std(samples, axis=0)
         )

@@ -682,12 +682,14 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
     def sample(
         self,
         suggestions: Sequence[trial_.TrialSuggestion],
-        rng: Optional[Array] = None,
+        rng=None,
         num_samples: int = 1000,
     ) -> np.ndarray:
-        """Unwarped posterior samples: [S, T] (single) or [S, T, M] (multi)."""
-        if rng is None:
-            rng = jax.random.PRNGKey(0)
+        """Unwarped posterior samples: [S, T] (single) or [S, T, M] (multi).
+
+        ``rng`` may be a jax PRNGKey or a numpy Generator (Predictor base
+        contract)."""
+        rng = gp_bandit._as_prng_key(rng)
         if not suggestions:
             return np.zeros((num_samples, 0))
         states_me, _ = self._train_states_me()
@@ -711,7 +713,7 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
     def predict(
         self,
         suggestions: Sequence[trial_.TrialSuggestion],
-        rng: Optional[Array] = None,
+        rng=None,
         num_samples: Optional[int] = 1000,
     ) -> core_lib.Prediction:
         """Empirical mean/stddev of unwarped posterior samples."""

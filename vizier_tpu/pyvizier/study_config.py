@@ -62,10 +62,28 @@ class AutomatedStoppingConfig:
 
     use_steps: bool = True
     min_num_trials: int = 5
+    # "median": median curve rule. "regression": gradient-boosted
+    # final-objective prediction from partial curves (algorithms/regression).
+    rule: str = "median"
+
+    def __post_init__(self):
+        if self.rule not in ("median", "regression"):
+            raise ValueError(
+                f"Unknown early-stopping rule {self.rule!r}; "
+                "choices: 'median' | 'regression'."
+            )
 
     @classmethod
     def default_stopping_spec(cls, *, use_steps: bool = True, min_num_trials: int = 5):
         return cls(use_steps=use_steps, min_num_trials=min_num_trials)
+
+    @classmethod
+    def regression_stopping_spec(
+        cls, *, use_steps: bool = True, min_num_trials: int = 10
+    ):
+        return cls(
+            use_steps=use_steps, min_num_trials=min_num_trials, rule="regression"
+        )
 
 
 @dataclasses.dataclass

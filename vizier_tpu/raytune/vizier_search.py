@@ -137,7 +137,10 @@ class VizierSearch(_RaySearcher):
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         if self._study is None:
             return None  # ray contract: None = not ready / finished
-        (trial,) = self._study.suggest(count=1, client_id=trial_id)
+        trials = self._study.suggest(count=1, client_id=trial_id)
+        if not trials:  # exhausted finite space: signal completion, not crash
+            return None
+        (trial,) = trials
         self._ray_to_vizier[trial_id] = trial.id
         return dict(trial.parameters)
 

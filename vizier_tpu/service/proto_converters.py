@@ -337,6 +337,7 @@ def study_config_to_proto(config: vz.StudyConfig) -> study_pb2.StudySpec:
     if config.automated_stopping_config is not None:
         proto.early_stopping.use_steps = config.automated_stopping_config.use_steps
         proto.early_stopping.min_num_trials = config.automated_stopping_config.min_num_trials
+        proto.early_stopping.rule = config.automated_stopping_config.rule
     if config.pythia_endpoint:
         proto.pythia_endpoint = config.pythia_endpoint
     proto.metadata.extend(metadata_to_key_values(config.metadata))
@@ -360,6 +361,7 @@ def study_config_from_proto(proto: study_pb2.StudySpec) -> vz.StudyConfig:
         stopping = vz.AutomatedStoppingConfig(
             use_steps=proto.early_stopping.use_steps,
             min_num_trials=proto.early_stopping.min_num_trials,
+            rule=proto.early_stopping.rule or "median",
         )
     return vz.StudyConfig(
         search_space=space,

@@ -29,6 +29,9 @@ class PythiaServicer:
         self._policy_factory = policy_factory or policy_factory_lib.DefaultPolicyFactory()
         # Cache for policies that declare should_be_cached.
         self._policy_cache = {}
+        # Early-stopping policies cached per study (regression rule holds a
+        # trained GBM; see EarlyStop dispatch).
+        self._stopping_policies = {}
 
     def connect_to_vizier(self, vizier_service) -> None:
         self._vizier = vizier_service
@@ -82,17 +85,32 @@ class PythiaServicer:
         try:
             config = pc.study_config_from_proto(request.study_descriptor.config)
             if config.automated_stopping_config is not None:
-                # Studies with a stopping spec use the median curve rule;
-                # otherwise the algorithm's own policy decides.
+                # Studies with a stopping spec pick their rule (median curve
+                # or curve-regression); otherwise the algorithm's own policy
+                # decides.
                 from vizier_tpu.algorithms import early_stopping
 
-                policy = early_stopping.MedianEarlyStopPolicy(
-                    supporter=service_policy_supporter.ServicePolicySupporter(
-                        request.study_name, self._vizier
-                    ),
-                    use_steps=config.automated_stopping_config.use_steps,
-                    min_num_trials=config.automated_stopping_config.min_num_trials,
-                )
+                stopping = config.automated_stopping_config
+                if stopping.rule == "regression":
+                    # Cached per study: the policy holds a trained GBM that
+                    # repeated polls between completions must reuse.
+                    policy = self._stopping_policies.get(request.study_name)
+                    if policy is None:
+                        policy = early_stopping.RegressionEarlyStopPolicy(
+                            supporter=service_policy_supporter.ServicePolicySupporter(
+                                request.study_name, self._vizier
+                            ),
+                            min_num_trials=stopping.min_num_trials,
+                        )
+                        self._stopping_policies[request.study_name] = policy
+                else:
+                    policy = early_stopping.MedianEarlyStopPolicy(
+                        supporter=service_policy_supporter.ServicePolicySupporter(
+                            request.study_name, self._vizier
+                        ),
+                        use_steps=stopping.use_steps,
+                        min_num_trials=stopping.min_num_trials,
+                    )
             else:
                 policy = self._get_policy(
                     config, request.algorithm or config.algorithm, request.study_name

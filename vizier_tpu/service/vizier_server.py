@@ -62,7 +62,10 @@ class DefaultVizierServer:
 
     def __del__(self):
         try:
-            self._server.stop(None)
+            # grace=0, NOT None: grace=None blocks until every in-flight RPC
+            # completes, which deadlocks interpreter shutdown if a handler
+            # thread is still parked (observed after early-stopping RPCs).
+            self._server.stop(0)
         except Exception:
             pass
 
