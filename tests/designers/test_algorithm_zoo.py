@@ -409,13 +409,67 @@ class TestMetaLearning:
             problem,
             tuning_space=tuning_space,
             inner_factory=inner_factory,
-            config=MetaLearningConfig(tuning_interval=4),
+            config=MetaLearningConfig(tuning_interval=4, tuning_min_num_trials=0),
             seed=0,
         )
         test_runners.RandomMetricsRunner(problem, iters=10, batch_size=1).run_designer(
             designer
         )
         assert len(builds) >= 2  # at least two meta rounds happened
+
+
+class TestEagleMetaLearning:
+    def test_search_space_matches_firefly_config(self):
+        from vizier_tpu.designers import eagle_meta_learning
+        from vizier_tpu.designers.eagle_strategy import FireflyConfig
+
+        space = eagle_meta_learning.meta_eagle_search_space()
+        names = {c.name for c in space.parameters}
+        # Every tunable coefficient must exist on FireflyConfig so the inner
+        # factory can construct it, and defaults must equal the config's.
+        cfg = FireflyConfig()
+        for c in space.parameters:
+            assert hasattr(cfg, c.name)
+            assert c.default_value == pytest.approx(getattr(cfg, c.name))
+            assert c.scale_type == vz.ScaleType.LOG
+        assert "gravity" in names and "perturbation" in names
+
+    def test_preset_runs_and_tunes(self):
+        from vizier_tpu.designers import eagle_meta_learning
+        from vizier_tpu.designers.meta_learning import MetaLearningConfig
+
+        problem = _mixed_problem()
+        designer = eagle_meta_learning.eagle_meta_learning_designer(
+            problem,
+            config=MetaLearningConfig(tuning_interval=3, tuning_min_num_trials=0),
+            seed=0,
+        )
+        trials = test_runners.RandomMetricsRunner(
+            problem, iters=8, batch_size=1
+        ).run_designer(designer)
+        assert len(trials) == 8
+        # At least one meta round was scored with the firefly coefficients.
+        assert designer._meta_trials
+        scored = designer._meta_trials[0].parameters
+        assert "gravity" in scored
+
+    def test_use_best_params_locks_in(self):
+        from vizier_tpu.designers import eagle_meta_learning
+        from vizier_tpu.designers.meta_learning import (
+            MetaLearningConfig,
+            MetaLearningState,
+        )
+
+        problem = _mixed_problem()
+        designer = eagle_meta_learning.eagle_meta_learning_designer(
+            problem,
+            config=MetaLearningConfig(tuning_interval=2, tuning_min_num_trials=0, tuning_max_num_trials=5),
+            seed=1,
+        )
+        test_runners.RandomMetricsRunner(problem, iters=8, batch_size=1).run_designer(
+            designer
+        )
+        assert designer.state == MetaLearningState.USE_BEST_PARAMS
 
 
 class TestUnsafeAsInfeasible:
@@ -505,7 +559,7 @@ class TestReviewRegressions:
             tuning_space=tuning_space,
             inner_factory=lambda p, dummy: RandomDesigner(p.search_space, seed=0),
             meta_factory=lambda p, **kw: MetaRecorder(p.search_space),
-            config=MetaLearningConfig(tuning_interval=3),
+            config=MetaLearningConfig(tuning_interval=3, tuning_min_num_trials=0),
             seed=0,
         )
         test_runners.RandomMetricsRunner(problem, iters=8, batch_size=1).run_designer(

@@ -27,6 +27,14 @@ def __getattr__(name):
         "EnsembleDesigner": ("vizier_tpu.designers.ensemble", "EnsembleDesigner"),
         "ScheduledDesigner": ("vizier_tpu.designers.scheduled_designer", "ScheduledDesigner"),
         "MetaLearningDesigner": ("vizier_tpu.designers.meta_learning", "MetaLearningDesigner"),
+        "eagle_meta_learning_designer": (
+            "vizier_tpu.designers.eagle_meta_learning",
+            "eagle_meta_learning_designer",
+        ),
+        "meta_eagle_search_space": (
+            "vizier_tpu.designers.eagle_meta_learning",
+            "meta_eagle_search_space",
+        ),
         "UnsafeAsInfeasibleDesigner": (
             "vizier_tpu.designers.unsafe_as_infeasible_designer",
             "UnsafeAsInfeasibleDesigner",

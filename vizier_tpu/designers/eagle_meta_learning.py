@@ -1,0 +1,108 @@
+"""Eagle meta-learning preset: tune the firefly hyperparameters online.
+
+Parity with
+``/root/reference/vizier/_src/algorithms/designers/meta_learning/eagle_meta_learning.py:23``:
+a log-scaled search space over the eagle strategy's own coefficients, plus a
+factory that wires it into :class:`MetaLearningDesigner` so the firefly
+coefficients are tuned on the user's objective instead of fixed at defaults.
+
+The tuned set is the reference's: perturbation (+ lower bound), gravity,
+negative gravity, continuous/categorical visibility, categorical
+perturbation factor, pool-size factor. The reference's ``discrete_*`` and
+``pure_categorical_perturbation`` knobs are absent because this rebuild
+routes DISCRETE parameters through the categorical force model and has no
+separate pure-categorical perturbation coefficient. FireflyConfig fields
+outside the reference's tuned set (``max_perturbation``, ``explore_rate``,
+``penalize_factor``, ``max_pool_size``) stay at their defaults, as they do
+in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.designers import eagle_strategy
+from vizier_tpu.designers import meta_learning
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import parameter_config as pc
+
+
+def meta_eagle_search_space() -> pc.SearchSpace:
+    """Search space over the firefly coefficients (log-uniform, ref defaults)."""
+    space = pc.SearchSpace()
+    root = space.root
+    root.add_float_param(
+        "perturbation", 1e-4, 1e2, default_value=1e-1, scale_type=pc.ScaleType.LOG
+    )
+    root.add_float_param(
+        "perturbation_lower_bound",
+        1e-5,
+        1e-1,
+        default_value=1e-3,
+        scale_type=pc.ScaleType.LOG,
+    )
+    root.add_float_param(
+        "gravity", 1e-2, 1e2, default_value=1.0, scale_type=pc.ScaleType.LOG
+    )
+    root.add_float_param(
+        "negative_gravity",
+        2e-4,
+        2.0,
+        default_value=2e-2,
+        scale_type=pc.ScaleType.LOG,
+    )
+    root.add_float_param(
+        "visibility", 3e-2, 3e2, default_value=3.0, scale_type=pc.ScaleType.LOG
+    )
+    root.add_float_param(
+        "categorical_visibility",
+        2e-3,
+        2e1,
+        default_value=2e-1,
+        scale_type=pc.ScaleType.LOG,
+    )
+    root.add_float_param(
+        "categorical_perturbation_factor",
+        2.5e-1,
+        2.5e3,
+        default_value=2.5e1,
+        scale_type=pc.ScaleType.LOG,
+    )
+    root.add_float_param(
+        "pool_size_factor", 1.0, 2.0, default_value=1.2, scale_type=pc.ScaleType.LOG
+    )
+    return space
+
+
+def eagle_designer_factory(
+    problem: base_study_config.ProblemStatement,
+    *,
+    seed: Optional[int] = None,
+    **hyperparams: float,
+) -> eagle_strategy.EagleStrategyDesigner:
+    """Builds an eagle designer from meta-suggested coefficient values."""
+    config = eagle_strategy.FireflyConfig(
+        **{k: float(v) for k, v in hyperparams.items()}
+    )
+    return eagle_strategy.EagleStrategyDesigner(
+        problem=problem, config=config, seed=seed
+    )
+
+
+def eagle_meta_learning_designer(
+    problem: base_study_config.ProblemStatement,
+    *,
+    config: Optional[meta_learning.MetaLearningConfig] = None,
+    meta_factory: Optional[core_lib.DesignerFactory] = None,
+    seed: Optional[int] = None,
+) -> meta_learning.MetaLearningDesigner:
+    """The reference's eagle meta-learning setup as one call."""
+    return meta_learning.MetaLearningDesigner(
+        problem=problem,
+        tuning_space=meta_eagle_search_space(),
+        inner_factory=lambda p, **hp: eagle_designer_factory(p, seed=seed, **hp),
+        meta_factory=meta_factory,
+        config=config or meta_learning.MetaLearningConfig(),
+        seed=seed,
+    )

@@ -34,9 +34,9 @@ class MetaLearningConfig:
     config — further exploration wastes suggestion budget).
     """
 
-    tuning_interval: int = 20  # trials per meta round (num_trials_per_tuning)
+    tuning_interval: int = 100  # trials per meta round (num_trials_per_tuning)
     num_seed_rounds: int = 1
-    tuning_min_num_trials: int = 0  # TUNE starts at this many completed
+    tuning_min_num_trials: int = 3_000  # TUNE starts at this many completed
     tuning_max_num_trials: int = 10_000  # TUNE stops here → USE_BEST_PARAMS
 
 
