@@ -151,6 +151,44 @@ class TestTraining:
         result = lbfgs_lib.LbfgsOptimizer(maxiter=30)(loss_fn, inits)
         assert float(result.best_loss) < float(jnp.min(init_losses))
 
+    def test_lbfgs_rosenbrock_not_stopped_prematurely(self):
+        """ftol early stop must not quit inside Rosenbrock's flat valley."""
+        from vizier_tpu.optimizers.lbfgs import lbfgs_minimize
+
+        def rosen(v):
+            return (1.0 - v[0]) ** 2 + 100.0 * (v[1] - v[0] ** 2) ** 2
+
+        x, f = lbfgs_minimize(rosen, jnp.asarray([-1.2, 1.0]), maxiter=200)
+        assert float(f) < 1e-5
+        np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=1e-2)
+
+    def test_lbfgs_ill_scaled_quadratic(self):
+        """Step-size carryover must still converge when the curvature forces
+        tiny steps early (condition number 1e4) and full steps later."""
+        from vizier_tpu.optimizers.lbfgs import lbfgs_minimize
+
+        scales = jnp.asarray([1.0, 1e2, 1e4])
+
+        def quad(v):
+            return jnp.sum(scales * v**2)
+
+        x, f = lbfgs_minimize(quad, jnp.asarray([3.0, 2.0, 1.0]), maxiter=100)
+        assert float(f) < 1e-6
+
+    def test_lbfgs_condition_1e7_quadratic(self):
+        """Regression: the line-search warm start + ftol stop must not stall
+        a condition-1e7 quadratic far from its optimum (a capped-step
+        cascade once did, stopping at f=100 from f0=1e2^2*1e-2)."""
+        from vizier_tpu.optimizers.lbfgs import lbfgs_minimize
+
+        scales = jnp.asarray([1e-2, 1e5])
+
+        def quad(v):
+            return jnp.sum(scales * v**2)
+
+        x, f = lbfgs_minimize(quad, jnp.asarray([100.0, 1.0]), maxiter=300)
+        assert float(f) < 1e-6, float(f)
+
     def test_best_n_ensemble_shapes(self):
         model = gp_lib.VizierGaussianProcess(num_continuous=1, num_categorical=0)
         data = _make_data(8, 8, dc=1)

@@ -47,8 +47,11 @@ class LBFGSBOptimizer:
 
         def run_one(key: Array) -> Tuple[Array, Array]:
             z0 = jax.random.normal(key, (num_continuous,), dtype=jnp.float32) * 2.0
+            # ftol disabled: acquisition values are <<1, so a relative
+            # ftol would act as a loose absolute threshold and stop the
+            # maximization steps early; this path is cheap (tiny dims).
             z, loss = lbfgs_lib.lbfgs_minimize(
-                unconstrained_loss, z0, maxiter=self.maxiter
+                unconstrained_loss, z0, maxiter=self.maxiter, ftol=0.0
             )
             return jax.nn.sigmoid(z), -loss
 
