@@ -169,3 +169,170 @@ class TestReviewRegressions:
     def test_discrete_log_scale_positivity(self):
         with pytest.raises(ValueError, match="positive"):
             vz.ParameterConfig.factory("d", feasible_values=[0, 1, 10], scale_type=vz.ScaleType.LOG)
+
+
+class TestMerge:
+    def test_double_bounds_envelope(self):
+        a = vz.ParameterConfig.factory("x", bounds=(0.0, 1.0))
+        b = vz.ParameterConfig.factory("x", bounds=(0.5, 2.0))
+        m = vz.ParameterConfig.merge(a, b)
+        assert m.bounds == (0.0, 2.0)
+        assert m.type == vz.ParameterType.DOUBLE
+
+    def test_integer_stays_integer(self):
+        a = vz.ParameterConfig.factory("n", bounds=(1, 5))
+        b = vz.ParameterConfig.factory("n", bounds=(3, 9))
+        m = vz.ParameterConfig.merge(a, b)
+        assert m.type == vz.ParameterType.INTEGER
+        assert m.bounds == (1, 9)
+
+    def test_categorical_union(self):
+        a = vz.ParameterConfig.factory("c", feasible_values=["a", "b"])
+        b = vz.ParameterConfig.factory("c", feasible_values=["b", "z"])
+        m = vz.ParameterConfig.merge(a, b)
+        assert m.feasible_values == ["a", "b", "z"]
+
+    def test_discrete_union(self):
+        a = vz.ParameterConfig.factory("d", feasible_values=[1.0, 2.0])
+        b = vz.ParameterConfig.factory("d", feasible_values=[2.0, 4.0])
+        m = vz.ParameterConfig.merge(a, b)
+        assert m.feasible_values == [1.0, 2.0, 4.0]
+
+    def test_type_conflict_rejected(self):
+        a = vz.ParameterConfig.factory("p", bounds=(0.0, 1.0))
+        b = vz.ParameterConfig.factory("p", feasible_values=["a"])
+        with pytest.raises(ValueError, match="Type conflict"):
+            vz.ParameterConfig.merge(a, b)
+
+    def test_children_rejected(self):
+        s = vz.SearchSpace()
+        sel = s.root.add_categorical_param("c", ["a", "b"])
+        sel.select_values(["a"]).add_float_param("x", 0, 1)
+        flat = vz.ParameterConfig.factory("c", feasible_values=["a", "b"])
+        with pytest.raises(ValueError, match="children"):
+            vz.ParameterConfig.merge(s.get("c"), flat)
+
+
+class TestSubspaceExtraction:
+    def _conditional_space(self):
+        s = vz.SearchSpace()
+        sel = s.root.add_categorical_param("model", ["linear", "dnn"])
+        sel.select_values(["dnn"]).add_float_param("lr", 1e-4, 1e-1)
+        sel.select_values(["dnn"]).add_int_param("layers", 1, 8)
+        sel.select_values(["linear"]).add_float_param("l2", 0.0, 1.0)
+        return s
+
+    def test_subspace_for_value(self):
+        s = self._conditional_space()
+        sub = s.get("model").get_subspace_deepcopy("dnn")
+        names = {c.name for c in sub.parameters}
+        assert names == {"lr", "layers"}
+
+    def test_subspace_other_value(self):
+        s = self._conditional_space()
+        sub = s.get("model").get_subspace_deepcopy("linear")
+        assert {c.name for c in sub.parameters} == {"l2"}
+
+    def test_subspace_is_a_copy(self):
+        s = self._conditional_space()
+        sub = s.get("model").get_subspace_deepcopy("dnn")
+        sub.pop("lr")
+        assert "lr" in {c.name for c in s.get("model").children}
+
+    def test_double_parent_returns_empty(self):
+        c = vz.ParameterConfig.factory("x", bounds=(0.0, 1.0))
+        assert c.get_subspace_deepcopy(0.5).is_empty()
+
+    def test_infeasible_value_rejected(self):
+        s = self._conditional_space()
+        with pytest.raises(Exception, match="feasible"):
+            s.get("model").get_subspace_deepcopy("svm")
+
+
+class TestTraverseAndClone:
+    def test_clone_without_children(self):
+        s = vz.SearchSpace()
+        sel = s.root.add_categorical_param("c", ["a"])
+        sel.select_values(["a"]).add_float_param("x", 0, 1)
+        bare = s.get("c").clone_without_children()
+        assert bare.children == () and s.get("c").children
+
+    def test_traverse_hides_children_but_still_recurses(self):
+        s = vz.SearchSpace()
+        sel = s.root.add_categorical_param("c", ["a"])
+        sel.select_values(["a"]).add_float_param("x", 0, 1)
+        seen = list(s.get("c").traverse(show_children=False))
+        assert [p.name for p in seen] == ["c", "x"]
+        assert all(p.children == () for p in seen)
+
+
+class TestCustomParam:
+    def test_factory_neither_bounds_nor_values_is_custom(self):
+        c = vz.ParameterConfig.factory("blob")
+        assert c.type == vz.ParameterType.CUSTOM
+        assert c.num_feasible_values == float("inf")
+        assert c.contains("anything") and c.contains(42)
+
+    def test_add_custom_param(self):
+        s = vz.SearchSpace()
+        s.root.add_custom_param("payload", default_value="serialized")
+        cfg = s.get("payload")
+        assert cfg.type == vz.ParameterType.CUSTOM
+        assert cfg.first_feasible_value() == "serialized"
+
+    def test_custom_without_default_cannot_seed(self):
+        c = vz.ParameterConfig.factory("blob")
+        with pytest.raises(Exception, match="default"):
+            c.first_feasible_value()
+
+
+class TestMultiDimensionalNames:
+    def test_index_builds_bracketed_name(self):
+        s = vz.SearchSpace()
+        for i in range(3):
+            s.root.add_float_param("rate", 0.0, 1.0, index=i)
+        assert [c.name for c in s.parameters] == ["rate[0]", "rate[1]", "rate[2]"]
+
+    def test_parse_roundtrip(self):
+        parse = vz.SearchSpaceSelector.parse_multi_dimensional_parameter_name
+        assert parse("rate[10]") == ("rate", 10)
+        assert parse("rate") is None
+        assert parse("rate[x]") is None
+
+    def test_negative_index_rejected(self):
+        s = vz.SearchSpace()
+        with pytest.raises(ValueError, match=">= 0"):
+            s.root.add_int_param("n", 0, 5, index=-1)
+
+    def test_index_on_all_builders(self):
+        s = vz.SearchSpace()
+        s.root.add_int_param("n", 0, 5, index=0)
+        s.root.add_discrete_param("d", [1, 2], index=1)
+        s.root.add_categorical_param("c", ["a"], index=2)
+        s.root.add_bool_param("b", index=3)
+        assert {c.name for c in s.parameters} == {"n[0]", "d[1]", "c[2]", "b[3]"}
+
+    def test_merge_preserves_shared_external_type(self):
+        s1, s2 = vz.SearchSpace(), vz.SearchSpace()
+        s1.root.add_bool_param("b")
+        s2.root.add_bool_param("b")
+        m = vz.ParameterConfig.merge(s1.get("b"), s2.get("b"))
+        assert m.external_type == vz.ExternalType.BOOLEAN
+
+    def test_merge_scale_conflict_warns(self):
+        import warnings as w
+
+        a = vz.ParameterConfig.factory("x", bounds=(0.1, 1.0), scale_type=vz.ScaleType.LOG)
+        b = vz.ParameterConfig.factory("x", bounds=(0.1, 2.0), scale_type=vz.ScaleType.LINEAR)
+        with w.catch_warnings(record=True) as caught:
+            w.simplefilter("always")
+            m = vz.ParameterConfig.merge(a, b)
+        assert any("Scale type conflict" in str(c.message) for c in caught)
+        assert m.scale_type == vz.ScaleType.LOG
+
+    def test_subspace_rejects_truncatable_integer_value(self):
+        s = vz.SearchSpace()
+        sel = s.root.add_int_param("n", 1, 8)
+        sel.select_values([2]).add_float_param("x", 0, 1)
+        with pytest.raises(Exception, match="feasible"):
+            s.get("n").get_subspace_deepcopy(2.7)

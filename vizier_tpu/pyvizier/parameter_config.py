@@ -20,6 +20,8 @@ import copy
 import dataclasses
 import enum
 import math
+import re
+import warnings
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 ParameterValueTypes = Union[str, int, float, bool]
@@ -116,10 +118,25 @@ class ParameterConfig:
     ) -> "ParameterConfig":
         if not name:
             raise ValueError("Parameter name must be non-empty.")
-        if (bounds is None) == (feasible_values is None):
+        if bounds is not None and feasible_values is not None:
             raise ValueError(
-                f"{name}: exactly one of bounds / feasible_values must be given "
+                f"{name}: at most one of bounds / feasible_values may be given "
                 f"(bounds={bounds}, feasible_values={feasible_values})."
+            )
+        if bounds is None and feasible_values is None:
+            # Neither ⇒ CUSTOM: an opaque parameter (reference
+            # `parameter_config.py:255` factory semantics). Suggestion
+            # algorithms and encoders REJECT spaces containing it (as in the
+            # reference); it exists for externally-assigned values carried
+            # verbatim through trials.
+            if children:
+                raise ValueError(f"{name}: CUSTOM parameters cannot have children.")
+            return cls(
+                name=name,
+                type=ParameterType.CUSTOM,
+                default_value=default_value,
+                external_type=external_type,
+                fidelity_config=fidelity_config,
             )
         if bounds is not None:
             lo, hi = bounds
@@ -212,6 +229,8 @@ class ParameterConfig:
 
     @property
     def num_feasible_values(self) -> float:
+        if self.type == ParameterType.CUSTOM:
+            return float("inf")
         if self.type == ParameterType.DOUBLE:
             lo, hi = self._bounds  # type: ignore[misc]
             return 1.0 if _is_close(lo, hi) else float("inf")
@@ -277,11 +296,98 @@ class ParameterConfig:
         )
 
     def traverse(self, show_children: bool = True) -> Iterator["ParameterConfig"]:
-        """Pre-order traversal of this config and (optionally) descendants."""
-        yield self
-        if show_children:
-            for child in self.children:
-                yield from child.traverse(show_children=True)
+        """Pre-order DFS over this config and all descendants.
+
+        ``show_children`` controls whether the yielded configs carry their
+        ``children`` (reference ``traverse`` semantics); descendants are
+        visited either way.
+        """
+        yield self if show_children else self.clone_without_children()
+        for child in self.children:
+            yield from child.traverse(show_children)
+
+    def clone_without_children(self) -> "ParameterConfig":
+        return dataclasses.replace(self, children=())
+
+    @classmethod
+    def merge(
+        cls, one: "ParameterConfig", other: "ParameterConfig"
+    ) -> "ParameterConfig":
+        """Union of two childless configs of the same type.
+
+        CATEGORICAL/DISCRETE merge to the union of feasible values;
+        DOUBLE/INTEGER to the envelope of the bounds (reference
+        ``parameter_config.py:540``). Used when combining search spaces
+        from related studies (e.g. transfer-learning priors).
+        """
+        if one.children or other.children:
+            raise ValueError(
+                f"Cannot merge parameters with children: {one.name}, {other.name}."
+            )
+        if one.type != other.type:
+            raise ValueError(
+                f"Type conflict merging {one.name}: {one.type} vs {other.type}."
+            )
+        if one.scale_type != other.scale_type:
+            warnings.warn(
+                f"Scale type conflict merging {one.name}: keeping "
+                f"{one.scale_type} over {other.scale_type}.",
+                stacklevel=2,
+            )
+        # external_type survives only when unambiguous; defaults and fidelity
+        # configs are dropped (reference merge rebuilds from values/bounds).
+        external = (
+            one.external_type
+            if one.external_type == other.external_type
+            else ExternalType.INTERNAL
+        )
+        if one.type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+            values = sorted(set(one.feasible_values) | set(other.feasible_values))
+            return cls.factory(
+                name=one.name,
+                feasible_values=values,
+                scale_type=one.scale_type,
+                external_type=external,
+            )
+        if one.type in (ParameterType.INTEGER, ParameterType.DOUBLE):
+            lo = min(one.bounds[0], other.bounds[0])
+            hi = max(one.bounds[1], other.bounds[1])
+            if one.type == ParameterType.INTEGER:
+                lo, hi = int(lo), int(hi)
+            return cls.factory(
+                name=one.name,
+                bounds=(lo, hi),
+                scale_type=one.scale_type,
+                external_type=external,
+            )
+        raise ValueError(f"Cannot merge {one.type} parameter {one.name}.")
+
+    def get_subspace_deepcopy(self, value: ParameterValueTypes) -> "SearchSpace":
+        """The conditional subspace active when this parameter takes ``value``.
+
+        Returns an empty space for DOUBLE (continuous parents cannot have
+        children) and validates feasibility otherwise (reference
+        ``parameter_config.py:696``).
+        """
+        if self.type == ParameterType.DOUBLE:
+            return SearchSpace()
+        # Validate the RAW value before casting: cast_value truncates (e.g.
+        # int(2.7) == 2), which would silently select a different subspace.
+        if not self.contains(value):
+            raise InvalidParameterError(
+                f"{self.name}: {value!r} is not a feasible value."
+            )
+        value = self.cast_value(value)
+        space = SearchSpace()
+        space.parameters = [
+            copy.deepcopy(child)
+            for child in self.children
+            if any(
+                parent_value_matches(value, pv)
+                for pv in child.matching_parent_values
+            )
+        ]
+        return space
 
     def add_children(
         self, new_children: Sequence[Tuple[Sequence[ParameterValueTypes], "ParameterConfig"]]
@@ -317,6 +423,10 @@ class ParameterConfig:
     def first_feasible_value(self) -> ParameterValueTypes:
         if self.default_value is not None:
             return self.default_value
+        if self.type == ParameterType.CUSTOM:
+            raise InvalidParameterError(
+                f"{self.name}: CUSTOM parameter has no default value to seed with."
+            )
         if self.type == ParameterType.DOUBLE:
             lo, hi = self.bounds
             return (lo + hi) / 2.0
@@ -376,6 +486,26 @@ class SearchSpaceSelector:
         root selector; conditional child on a value-selected parameter)."""
         return self._add(config)
 
+    @staticmethod
+    def _indexed_name(name: str, index: Optional[int]) -> str:
+        """``('rate', 0) -> 'rate[0]'`` multi-dimensional naming (reference
+        ``_get_parameter_names_to_create``); ``index=None`` is a no-op."""
+        if index is None:
+            return name
+        if index < 0:
+            raise ValueError(f"{name}: index must be >= 0, got {index}.")
+        return f"{name}[{index}]"
+
+    @classmethod
+    def parse_multi_dimensional_parameter_name(
+        cls, name: str
+    ) -> Optional[Tuple[str, int]]:
+        """``'rate[10]' -> ('rate', 10)``; None when not multi-dimensional."""
+        match = re.fullmatch(r"(?P<name>[^()]*)\[(?P<index>\d+)\]", name)
+        if match is None:
+            return None
+        return match.group("name"), int(match.group("index"))
+
     def add_float_param(
         self,
         name: str,
@@ -384,10 +514,11 @@ class SearchSpaceSelector:
         *,
         default_value: Optional[float] = None,
         scale_type: Optional[ScaleType] = ScaleType.LINEAR,
+        index: Optional[int] = None,
     ) -> "SearchSpaceSelector":
         return self._add(
             ParameterConfig.factory(
-                name,
+                self._indexed_name(name, index),
                 bounds=(float(min_value), float(max_value)),
                 scale_type=scale_type,
                 default_value=default_value,
@@ -402,12 +533,13 @@ class SearchSpaceSelector:
         *,
         default_value: Optional[int] = None,
         scale_type: Optional[ScaleType] = None,
+        index: Optional[int] = None,
     ) -> "SearchSpaceSelector":
         if int(min_value) != min_value or int(max_value) != max_value:
             raise ValueError(f"{name}: integer bounds required, got {(min_value, max_value)}.")
         return self._add(
             ParameterConfig.factory(
-                name,
+                self._indexed_name(name, index),
                 bounds=(int(min_value), int(max_value)),
                 scale_type=scale_type,
                 default_value=default_value,
@@ -422,13 +554,14 @@ class SearchSpaceSelector:
         default_value: Optional[Union[int, float]] = None,
         scale_type: Optional[ScaleType] = ScaleType.LINEAR,
         auto_cast: bool = True,
+        index: Optional[int] = None,
     ) -> "SearchSpaceSelector":
         external = ExternalType.INTERNAL
         if auto_cast and all(isinstance(v, int) or float(v).is_integer() for v in feasible_values):
             external = ExternalType.INTEGER
         return self._add(
             ParameterConfig.factory(
-                name,
+                self._indexed_name(name, index),
                 feasible_values=list(feasible_values),
                 scale_type=scale_type,
                 default_value=default_value,
@@ -442,26 +575,39 @@ class SearchSpaceSelector:
         feasible_values: Sequence[str],
         *,
         default_value: Optional[str] = None,
+        index: Optional[int] = None,
     ) -> "SearchSpaceSelector":
         return self._add(
             ParameterConfig.factory(
-                name,
+                self._indexed_name(name, index),
                 feasible_values=list(feasible_values),
                 default_value=default_value,
             )
         )
 
     def add_bool_param(
-        self, name: str, *, default_value: Optional[bool] = None
+        self,
+        name: str,
+        *,
+        default_value: Optional[bool] = None,
+        index: Optional[int] = None,
     ) -> "SearchSpaceSelector":
         default = None if default_value is None else ("True" if default_value else "False")
         return self._add(
             ParameterConfig.factory(
-                name,
+                self._indexed_name(name, index),
                 feasible_values=["False", "True"],
                 default_value=default,
                 external_type=ExternalType.BOOLEAN,
             )
+        )
+
+    def add_custom_param(
+        self, name: str, *, default_value: Optional[ParameterValueTypes] = None
+    ) -> "SearchSpaceSelector":
+        """An opaque CUSTOM parameter: carried through trials, never modeled."""
+        return self._add(
+            ParameterConfig.factory(name, default_value=default_value)
         )
 
 
