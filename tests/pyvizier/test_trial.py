@@ -189,3 +189,40 @@ class TestReviewRegressions:
         t = vz.Trial(id=1, is_requested=True)
         t.stop("why")
         assert t.status == vz.TrialStatus.STOPPING
+
+
+class TestReferenceConveniences:
+    def test_as_float_dict(self):
+        m = vz.Measurement(metrics={"a": 1.5, "b": vz.Metric(value=2.0)})
+        assert m.as_float_dict() == {"a": 1.5, "b": 2.0}
+
+    def test_final_measurement_or_die(self):
+        t = vz.Trial(id=1)
+        with pytest.raises(ValueError, match="no final measurement"):
+            _ = t.final_measurement_or_die
+        t.complete(vz.Measurement(metrics={"obj": 3.0}))
+        assert t.final_measurement_or_die.metrics["obj"].value == 3.0
+
+
+class TestMetricTypes:
+    def test_metric_type_enum(self):
+        obj = vz.MetricInformation(name="o", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        safe = vz.MetricInformation(name="s", safety_threshold=0.5)
+        assert obj.type == vz.MetricType.OBJECTIVE and obj.type.is_objective
+        assert safe.type == vz.MetricType.SAFETY and safe.type.is_safety
+        assert obj.type == "OBJECTIVE"  # str-compat preserved
+
+    def test_of_type_and_exclude_type(self):
+        cfg = vz.MetricsConfig([
+            vz.MetricInformation(name="o1"),
+            vz.MetricInformation(name="s1", safety_threshold=0.0),
+            vz.MetricInformation(name="o2"),
+        ])
+        assert {m.name for m in cfg.of_type(vz.MetricType.OBJECTIVE)} == {"o1", "o2"}
+        assert {m.name for m in cfg.exclude_type("SAFETY")} == {"o1", "o2"}
+        assert {m.name for m in cfg.of_type(["SAFETY"])} == {"s1"}
+
+    def test_range(self):
+        m = vz.MetricInformation(name="o", min_value=-1.0, max_value=3.0)
+        assert m.range == 4.0
+        assert vz.MetricInformation(name="u").range == float("inf")

@@ -6,6 +6,7 @@ Mirrors the reference facade ``/root/reference/vizier/pyvizier/__init__.py``.
 from vizier_tpu.pyvizier.base_study_config import (
     MetricInformation,
     MetricsConfig,
+    MetricType,
     ObjectiveMetricGoal,
     ProblemStatement,
 )
@@ -56,6 +57,7 @@ __all__ = [
     "MetadataValue",
     "Metric",
     "MetricInformation",
+    "MetricType",
     "MetricsConfig",
     "Namespace",
     "ObjectiveMetricGoal",

@@ -33,6 +33,28 @@ class ObjectiveMetricGoal(enum.Enum):
         return self == ObjectiveMetricGoal.MINIMIZE
 
 
+class MetricType(str, enum.Enum):
+    """OBJECTIVE (optimized) vs SAFETY (soft constraint) — reference
+    ``base_study_config.py:71``. str-valued so ``m.type == "SAFETY"``
+    comparisons keep working."""
+
+    OBJECTIVE = "OBJECTIVE"
+    SAFETY = "SAFETY"
+
+    # Keep str()/f-string output identical to the plain strings the old
+    # `type` property returned ("OBJECTIVE", not "MetricType.OBJECTIVE").
+    __str__ = str.__str__
+    __format__ = str.__format__
+
+    @property
+    def is_safety(self) -> bool:
+        return self == MetricType.SAFETY
+
+    @property
+    def is_objective(self) -> bool:
+        return self == MetricType.OBJECTIVE
+
+
 @dataclasses.dataclass(frozen=True)
 class MetricInformation:
     """Configuration of one reported metric.
@@ -60,12 +82,21 @@ class MetricInformation:
             raise ValueError(f"{self.name}: safe-trials fraction must be in [0,1], got {frac}")
 
     @property
-    def type(self) -> str:
-        return "SAFETY" if self.safety_threshold is not None else "OBJECTIVE"
+    def type(self) -> MetricType:
+        return (
+            MetricType.SAFETY
+            if self.safety_threshold is not None
+            else MetricType.OBJECTIVE
+        )
 
     @property
     def is_safety_metric(self) -> bool:
         return self.safety_threshold is not None
+
+    @property
+    def range(self) -> float:
+        """max_value - min_value; can be infinite."""
+        return self.max_value - self.min_value
 
     def min_value_or(self, default_fn: Callable[[], float] = lambda: -math.inf) -> float:
         return self.min_value if math.isfinite(self.min_value) else default_fn()
@@ -116,8 +147,21 @@ class MetricsConfig(collections.abc.Collection):
                 return m
         raise KeyError(f"No metric named {name!r}.")
 
-    def of_type(self, metric_type: str) -> "MetricsConfig":
-        return MetricsConfig(m for m in self._metrics if m.type == metric_type)
+    def of_type(
+        self, include: Union[str, MetricType, Iterable[Union[str, MetricType]]]
+    ) -> "MetricsConfig":
+        if isinstance(include, (str, MetricType)):
+            include = (include,)
+        wanted = {MetricType(i) for i in include}
+        return MetricsConfig(m for m in self._metrics if m.type in wanted)
+
+    def exclude_type(
+        self, exclude: Union[str, MetricType, Iterable[Union[str, MetricType]]]
+    ) -> "MetricsConfig":
+        if isinstance(exclude, (str, MetricType)):
+            exclude = (exclude,)
+        unwanted = {MetricType(e) for e in exclude}
+        return MetricsConfig(m for m in self._metrics if m.type not in unwanted)
 
     def item(self) -> MetricInformation:
         """The unique objective metric; raises unless single-objective."""

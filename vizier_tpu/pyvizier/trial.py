@@ -179,6 +179,10 @@ class Measurement:
         if self.steps < 0:
             raise ValueError("steps must be >= 0.")
 
+    def as_float_dict(self) -> Dict[str, float]:
+        """Metric name → value (reference ``Measurement.as_float_dict``)."""
+        return {name: m.value for name, m in self.metrics.items()}
+
 
 @dataclasses.dataclass
 class TrialSuggestion:
@@ -231,6 +235,14 @@ class Trial:
     @property
     def infeasible(self) -> bool:
         return self.infeasibility_reason is not None
+
+    @property
+    def final_measurement_or_die(self) -> Measurement:
+        """The final measurement, raising if the trial has none (reference
+        ``Trial.final_measurement_or_die``)."""
+        if self.final_measurement is None:
+            raise ValueError(f"Trial {self.id} has no final measurement.")
+        return self.final_measurement
 
     @property
     def status(self) -> TrialStatus:
