@@ -108,3 +108,47 @@ class TestMetadata:
         md.ns("c")["k"] = "v"
         subs = {tuple(n) for n in md.subnamespaces(("a",))}
         assert subs == {("a",), ("a", "b")}
+
+
+class TestTypedAccess:
+    def test_get_with_cls(self):
+        m = common.Metadata({"int": "60", "float": "1.2"})
+        assert m.get("int", cls=int) == 60
+        assert m.get("float", cls=float) == 1.2
+        assert m.get("missing", 7, cls=int) == 7
+
+    def test_get_unconvertible_returns_default(self):
+        m = common.Metadata({"word": "abc"})
+        assert m.get("word", None, cls=int) is None
+
+    def test_get_or_error(self):
+        m = common.Metadata({"key": "value", "n": "3"})
+        assert m.get_or_error("key") == "value"
+        assert m.get_or_error("n", cls=int) == 3
+        with pytest.raises(KeyError):
+            m.get_or_error("badkey")
+
+    def test_items_by_cls(self):
+        m = common.Metadata({"a": "x", "b": 1.5, "c": "y"})
+        assert dict(m.items_by_cls(cls=str)) == {"a": "x", "c": "y"}
+        assert dict(m.items_by_cls(cls=float)) == {"b": 1.5}
+
+    def test_current_ns(self):
+        m = common.Metadata()
+        sub = m.ns("alg").ns("state")
+        assert sub.current_ns() == common.Namespace(["alg", "state"])
+        assert sub.current_ns().encode() == ":alg:state"
+
+    def test_bare_get_preserves_stored_types(self):
+        m = common.Metadata({"f": 1.5, "b": b"\x08\x01", "s": "x"})
+        assert m.get("f") == 1.5 and isinstance(m.get("f"), float)
+        assert m.get("b") == b"\x08\x01" and isinstance(m.get("b"), bytes)
+        assert m.get("s") == "x"
+
+    def test_get_any_proto_with_nonproto_cls_returns_default(self):
+        class FakeAny:
+            def Unpack(self, message):  # pragma: no cover - guard path
+                raise AssertionError("must not be called for non-proto cls")
+
+        m = common.Metadata({"a": FakeAny()})
+        assert m.get("a", "DEFAULT", cls=str) == "DEFAULT"

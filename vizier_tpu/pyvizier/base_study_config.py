@@ -147,20 +147,24 @@ class MetricsConfig(collections.abc.Collection):
                 return m
         raise KeyError(f"No metric named {name!r}.")
 
+    @staticmethod
+    def _type_set(
+        types: Union[str, MetricType, Iterable[Union[str, MetricType]]]
+    ) -> set:
+        if isinstance(types, (str, MetricType)):
+            types = (types,)
+        return {MetricType(t) for t in types}
+
     def of_type(
         self, include: Union[str, MetricType, Iterable[Union[str, MetricType]]]
     ) -> "MetricsConfig":
-        if isinstance(include, (str, MetricType)):
-            include = (include,)
-        wanted = {MetricType(i) for i in include}
+        wanted = self._type_set(include)
         return MetricsConfig(m for m in self._metrics if m.type in wanted)
 
     def exclude_type(
         self, exclude: Union[str, MetricType, Iterable[Union[str, MetricType]]]
     ) -> "MetricsConfig":
-        if isinstance(exclude, (str, MetricType)):
-            exclude = (exclude,)
-        unwanted = {MetricType(e) for e in exclude}
+        unwanted = self._type_set(exclude)
         return MetricsConfig(m for m in self._metrics if m.type not in unwanted)
 
     def item(self) -> MetricInformation:

@@ -128,8 +128,49 @@ class _NamespaceView(MutableMapping[str, MetadataValue]):
     def __contains__(self, key: object) -> bool:
         return key in self._metadata._stores.get(self._ns, {})
 
-    def get(self, key: str, default: Any = None) -> Any:
-        return self._metadata._stores.get(self._ns, {}).get(key, default)
+    def get(
+        self, key: str, default: Any = None, *, cls: Optional[type] = None
+    ) -> Any:
+        """The value for ``key``, or ``default`` if absent or unconvertible.
+
+        Bare ``get(key)`` returns whatever was stored (str, float, bytes,
+        proto — unchanged). Passing ``cls`` requests typed access (reference
+        ``Metadata.get`` contract): values already of type ``cls`` pass
+        through, packed ``Any`` protos unpack into a ``cls()`` message, and
+        anything else converts via ``cls(value)`` — e.g.
+        ``get('restarts', cls=int)`` parses a stored ``"4"``.
+        """
+        store = self._metadata._stores.get(self._ns, {})
+        if key not in store:
+            return default
+        try:
+            return self._coerce(store[key], cls)
+        except (TypeError, ValueError):
+            return default
+
+    @staticmethod
+    def _coerce(value: MetadataValue, cls: Optional[type]) -> Any:
+        if cls is None or isinstance(value, cls):
+            return value
+        if hasattr(value, "Unpack"):  # packed protobuf Any
+            if not hasattr(cls, "DESCRIPTOR"):
+                raise TypeError(f"Cannot unpack Any proto to non-proto {cls}.")
+            message = cls()
+            if not value.Unpack(message):
+                raise TypeError(f"Cannot unpack Any proto to {cls}.")
+            return message
+        return cls(value)
+
+    def get_or_error(self, key: str, *, cls: Optional[type] = None) -> Any:
+        """Like ``[]``, with optional ``cls`` coercion; KeyError when absent
+        (reference ``Metadata.get_or_error``)."""
+        return self._coerce(self._metadata._stores.get(self._ns, {})[key], cls)
+
+    def items_by_cls(self, *, cls: type) -> Iterator[Tuple[str, Any]]:
+        """(key, value) pairs in this namespace whose value is a ``cls``."""
+        for key, value in self._metadata._stores.get(self._ns, {}).items():
+            if isinstance(value, cls):
+                yield key, value
 
     def update(self, *args, **kwargs) -> None:
         self._store().update(*args, **kwargs)
@@ -139,6 +180,9 @@ class _NamespaceView(MutableMapping[str, MetadataValue]):
 
     @property
     def namespace(self) -> Namespace:
+        return self._ns
+
+    def current_ns(self) -> Namespace:  # reference-compat alias
         return self._ns
 
 
