@@ -365,3 +365,72 @@ class TestPlumbing:
         samples = d.sample(s, rng=jax.random.PRNGKey(1), num_samples=32)
         assert samples.shape == (32, 1)
         assert 900.0 < np.median(samples) < 1100.0
+
+
+class TestPriorAcquisition:
+    def _problem(self):
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", 0.0, 1.0)
+        p.search_space.root.add_float_param("y", 0.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        return p
+
+    def _run(self, designer, n=6):
+        tid = 0
+        rng = np.random.default_rng(0)
+        for _ in range(n):
+            (s,) = designer.suggest(1)
+            tid += 1
+            t = s.to_trial(tid)
+            t.complete(
+                vz.Measurement(
+                    metrics={"obj": float(rng.normal())}
+                )
+            )
+            designer.update(core_lib.CompletedTrials([t]), core_lib.ActiveTrials())
+        return designer
+
+    def test_prior_steers_suggestions(self):
+        from vizier_tpu.designers.gp_ucb_pe import UCBPEConfig, VizierGPUCBPEBandit
+
+        def corner_prior(query):
+            # Overwhelming preference for the (1, 1) corner in scaled space.
+            return -1e4 * jnp.sum((query.continuous - 1.0) ** 2, axis=-1)
+
+        problem = self._problem()
+        designer = VizierGPUCBPEBandit(
+            problem,
+            config=UCBPEConfig(ucb_coefficient=1.8),
+            num_seed_trials=1,
+            rng_seed=0,
+            prior_acquisition=corner_prior,
+        )
+        self._run(designer, n=5)
+        # Post-seed suggestions must hug the preferred corner.
+        (s,) = designer.suggest(1)
+        assert s.parameters["x"].value > 0.85, s.parameters.as_dict()
+        assert s.parameters["y"].value > 0.85, s.parameters.as_dict()
+
+    def test_prior_with_set_acquisition(self):
+        from vizier_tpu.designers.gp_ucb_pe import UCBPEConfig, VizierGPUCBPEBandit
+
+        def corner_prior(query):
+            return -1e4 * jnp.sum((query.continuous - 1.0) ** 2, axis=-1)
+
+        problem = self._problem()
+        designer = VizierGPUCBPEBandit(
+            problem,
+            config=UCBPEConfig(
+                optimize_set_acquisition_for_exploration=True
+            ),
+            num_seed_trials=1,
+            rng_seed=0,
+            prior_acquisition=corner_prior,
+        )
+        self._run(designer, n=3)
+        batch = designer.suggest(3)
+        assert len(batch) == 3
+        for s in batch:
+            assert s.parameters["x"].value > 0.8, s.parameters.as_dict()

@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -203,7 +203,8 @@ def _scalarize_penalty(penalty: Array, mode: str) -> Array:
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "model", "vec_opt", "count", "config", "use_trust_region", "mesh"
+        "model", "vec_opt", "count", "config", "use_trust_region", "mesh",
+        "prior_acquisition",
     ),
 )
 def _suggest_batch(
@@ -222,6 +223,7 @@ def _suggest_batch(
     config: UCBPEConfig,
     use_trust_region: bool = True,
     mesh=None,  # jax.sharding.Mesh: shard the per-pick sweep's eagle pools
+    prior_acquisition=None,  # Callable[[MixedFeatures], [Q]-array] user prior
 ) -> Tuple[vectorized_lib.VectorizedOptimizerResult, dict]:
     """The greedy batch: per pick, UCB-or-PE with pending-point conditioning."""
     dc = all_data.continuous.shape[-1]
@@ -286,6 +288,10 @@ def _suggest_batch(
                     penalty, config.multimetric_promising_region_penalty_type
                 )
             value = jnp.where(use_ucb, ucb_score, pe_score)
+            if prior_acquisition is not None:
+                # Additive user prior over the space (reference adds it to
+                # both the UCB and PE scores, `gp_ucb_pe.py:377,419`).
+                value = value + prior_acquisition(query)
             if trust is not None:
                 value = value - trust.penalty(query)
             return value
@@ -348,7 +354,10 @@ def _suggest_batch(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "vec_opt", "q", "config", "use_trust_region"),
+    static_argnames=(
+        "model", "vec_opt", "q", "config", "use_trust_region",
+        "prior_acquisition",
+    ),
 )
 def _suggest_set_pe(
     model: gp_lib.VizierGaussianProcess,
@@ -359,6 +368,7 @@ def _suggest_set_pe(
     q: int,
     config: UCBPEConfig,
     use_trust_region: bool = True,
+    prior_acquisition=None,  # Callable[[MixedFeatures], [Q]-array] user prior
 ) -> Tuple[vectorized_lib.VectorizedOptimizerResult, dict]:
     """Joint exploration batch: maximize log-det of the set's posterior cov.
 
@@ -410,6 +420,8 @@ def _suggest_set_pe(
             value = logdet + config.cb_violation_penalty_coefficient * jnp.sum(
                 jnp.minimum(explore_ucb - threshold, 0.0)
             )
+            if prior_acquisition is not None:
+                value = value + jnp.sum(prior_acquisition(query))
             if trust is not None:
                 value = value - jnp.sum(trust.penalty(query))
             return value
@@ -446,6 +458,13 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
 
     config: UCBPEConfig = UCBPEConfig()
     num_seed_trials: int = 1  # reference default: center point first
+    # Optional additive acquisition prior (reference `prior_acquisition`,
+    # gp_ucb_pe.py:299): called with the candidate MixedFeatures batch,
+    # returns a [Q] score added to both the UCB and PE acquisitions. Must be
+    # a jax-traceable callable; it is baked into the jitted suggest program,
+    # so use one stable callable per designer (a fresh lambda per call would
+    # retrace).
+    prior_acquisition: Optional[Callable[[kernels.MixedFeatures], Array]] = None
 
     def __post_init__(self):
         super().__post_init__()
@@ -593,6 +612,7 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             self.config,
             self.use_trust_region,
             self._mesh,
+            self.prior_acquisition,
         )
         return self._decode_ucb_pe(batch, aux, count)
 
@@ -608,7 +628,7 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
                 labels_mn, labels_mask, ref_point,
                 self._prior_features(datas[0]), self._next_rng(),
                 first_has_new, has_completed, 1, self.config,
-                self.use_trust_region, self._mesh,
+                self.use_trust_region, self._mesh, self.prior_acquisition,
             )
             suggestions.extend(self._decode_ucb_pe(first, aux1, 1))
             all_data = _append_row(
@@ -641,6 +661,7 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             q,
             self.config,
             self.use_trust_region,
+            self.prior_acquisition,
         )
         suggestions.extend(self._decode_ucb_pe(result, aux, q))
         return suggestions
