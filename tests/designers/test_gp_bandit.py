@@ -372,3 +372,34 @@ class TestJointQEIBatch:
         )
         trials = test_runners.RandomMetricsRunner(p, iters=3, batch_size=1).run_designer(d)
         assert len(trials) == 3
+
+
+class TestReferencePointHelpers:
+    def test_best_worst_and_reference(self):
+        from vizier_tpu.designers.gp import acquisitions as acq
+
+        labels = jnp.asarray([[0.0, 1.0, 2.0, 99.0], [-1.0, 0.0, 3.0, 99.0]])
+        mask = jnp.asarray([True, True, True, False])
+        np.testing.assert_allclose(acq.get_best_labels(labels, mask), [2.0, 3.0])
+        np.testing.assert_allclose(acq.get_worst_labels(labels, mask), [0.0, -1.0])
+        # nadir - 0.1 * max(range, 1)
+        np.testing.assert_allclose(
+            acq.get_reference_point(labels, mask), [-0.2, -1.4]
+        )
+
+    def test_reference_point_zero_span_floor(self):
+        from vizier_tpu.designers.gp import acquisitions as acq
+
+        labels = jnp.zeros((2, 3))
+        mask = jnp.ones((3,), bool)
+        # All-equal labels: ref must sit strictly below the nadir.
+        np.testing.assert_allclose(
+            acq.get_reference_point(labels, mask), [-0.1, -0.1]
+        )
+
+    def test_reference_point_no_valid_rows(self):
+        from vizier_tpu.designers.gp import acquisitions as acq
+
+        labels = jnp.zeros((2, 3))
+        mask = jnp.zeros((3,), bool)
+        assert np.all(np.isfinite(acq.get_reference_point(labels, mask)))

@@ -33,6 +33,34 @@ def _norm_cdf(z: Array) -> Array:
     return 0.5 * (1.0 + jax.scipy.special.erf(z / jnp.sqrt(2.0)))
 
 
+def get_best_labels(labels: Array, mask: Array) -> Array:
+    """Per-metric maxima over valid rows; labels ``[..., N]``, mask ``[N]``."""
+    return jnp.max(jnp.where(mask, labels, -jnp.inf), axis=-1)
+
+
+def get_worst_labels(labels: Array, mask: Array) -> Array:
+    """Per-metric minima over valid rows; labels ``[..., N]``, mask ``[N]``."""
+    return jnp.min(jnp.where(mask, labels, jnp.inf), axis=-1)
+
+
+def get_reference_point(labels: Array, mask: Array, scale: float = 0.1) -> Array:
+    """Hypervolume reference point: nadir − scale·range.
+
+    [Ishibuchi2011] find 0.1 a robust scaling of the nadir offset (reference
+    ``acquisitions.py:132``). With no valid rows the point falls back to 0
+    so downstream scalarizations stay finite.
+    """
+    best = get_best_labels(labels, mask)
+    worst = get_worst_labels(labels, mask)
+    # Floor the span at 1.0 (warped labels are ~N(0,1) scale): with all-equal
+    # labels a ref point AT the nadir would clamp every hypervolume
+    # scalarization to a flat 0, leaving the acquisition optimizer nothing
+    # to discriminate on.
+    span = jnp.maximum(best - worst, 1.0)
+    ref = worst - scale * span
+    return jnp.where(jnp.isfinite(ref), ref, 0.0)
+
+
 class Acquisition(Protocol):
     def __call__(self, mean: Array, stddev: Array, best_label: Array) -> Array:
         ...

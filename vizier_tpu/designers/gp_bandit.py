@@ -477,7 +477,14 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
                     types.ModelData(features, self._padded_labels(warped, n_pad))
                 )
             )
-            refs.append(float(np.min(warped)) - 0.1)
+            refs.append(
+                float(
+                    acquisitions.get_reference_point(
+                        jnp.asarray(warped, jnp.float32),
+                        jnp.ones(len(warped), bool),
+                    )
+                )
+            )
         batched = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *datas)
         states = _train_gp_per_metric(
             self._model, self._ard, batched, self._next_rng(), self.ard_restarts

@@ -578,11 +578,8 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
 
         labels_mn = jnp.stack([d.labels for d in datas])  # [M, N1]
         labels_mask = datas[0].row_mask
-        # Reference point: nadir − 0.01·range of the warped labels.
-        lab_valid = jnp.where(labels_mask[None, :], labels_mn, jnp.nan)
-        lo = jnp.nan_to_num(jnp.nanmin(lab_valid, axis=-1), nan=0.0)
-        hi = jnp.nan_to_num(jnp.nanmax(lab_valid, axis=-1), nan=0.0)
-        ref_point = lo - 0.01 * jnp.maximum(hi - lo, 1e-6)
+        # Reference point: nadir − 0.1·range (Ishibuchi2011, shared helper).
+        ref_point = acquisitions.get_reference_point(labels_mn, labels_mask)
 
         first_has_new = jnp.asarray(self._has_new_completed_trials())
         has_completed = jnp.asarray(bool(self._trials))
