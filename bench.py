@@ -52,25 +52,6 @@ def main() -> None:
     # runs the full-size benchmark (SCALE unset) on TPU.
     scale = float(os.environ.get("VIZIER_BENCH_SCALE", "1.0"))
 
-    # Pre-flight the fused Pallas kernel on this backend; fall back to the
-    # jnp path (VIZIER_DISABLE_PALLAS) rather than failing the benchmark if
-    # the runtime cannot compile it.
-    if os.environ.get("VIZIER_DISABLE_PALLAS") is None:
-        try:
-            from vizier_tpu.ops import matern_pallas
-
-            if matern_pallas.is_tpu_backend():
-                import jax.numpy as jnp
-
-                _progress("pallas pre-flight: compiling probe kernel")
-                probe = matern_pallas.matern52_ard_continuous_pallas(
-                    jnp.zeros((8, 4)), jnp.zeros((8, 4)), jnp.ones(4), jnp.asarray(1.0)
-                )
-                jax.block_until_ready(probe)
-                _progress("pallas pre-flight: ok")
-        except Exception as e:  # pragma: no cover - hardware-specific
-            _progress(f"pallas pre-flight failed ({type(e).__name__}); using jnp path")
-            os.environ["VIZIER_DISABLE_PALLAS"] = "1"
     num_trials, dim = max(int(1000 * scale), 16), 20
     n_pad = 1 << (num_trials - 1).bit_length()  # next power-of-2 bucket
     batch_count = 25  # suggestion batch (reference default batch)
@@ -139,51 +120,6 @@ def main() -> None:
         _progress(f"repeat {i}/{repeats}: {times[-1]:.1f} ms")
     p50 = float(np.percentile(times, 50))
 
-    # Prove-or-cut microbenchmark for the fused Pallas Matern kernel
-    # (VERDICT r1 #6): time fused vs jnp at the bench gram shape. Reported as
-    # extra keys on the same JSON line; >1 means the Pallas kernel wins.
-    pallas_ratio = None
-    try:
-        from vizier_tpu.ops import matern_pallas
-
-        if (
-            matern_pallas.is_tpu_backend()
-            and os.environ.get("VIZIER_DISABLE_PALLAS") is None
-        ):
-            import jax.numpy as jnp
-
-            _progress("pallas microbench: fused vs jnp Matern at gram shape")
-            xq = jnp.asarray(x[: min(num_trials, 1024)])
-            inv_ls = jnp.ones((dim,))
-            amp = jnp.asarray(1.0)
-
-            fused = jax.jit(
-                lambda a, b: matern_pallas.matern52_ard_continuous_pallas(
-                    a, b, inv_ls, amp
-                )
-            )
-            plain = jax.jit(
-                lambda a, b: matern_pallas._jnp_reference(a, b, inv_ls, amp)
-            )
-
-            def time_it(fn):
-                jax.block_until_ready(fn(xq, xq))  # compile
-                t0 = time.perf_counter()
-                for _ in range(20):
-                    out = fn(xq, xq)
-                jax.block_until_ready(out)
-                return (time.perf_counter() - t0) / 20.0
-
-            t_plain = time_it(plain)
-            t_fused = time_it(fused)
-            pallas_ratio = round(t_plain / max(t_fused, 1e-9), 3)
-            _progress(
-                f"pallas microbench: jnp {t_plain * 1e3:.2f} ms, "
-                f"fused {t_fused * 1e3:.2f} ms, speedup x{pallas_ratio}"
-            )
-    except Exception as e:  # pragma: no cover - hardware-specific
-        _progress(f"pallas microbench skipped ({type(e).__name__})")
-
     target_ms = 1000.0
     if scale == 1.0:
         # Stable id for longitudinal tracking across rounds.
@@ -196,8 +132,6 @@ def main() -> None:
         "unit": "ms",
         "vs_baseline": round(target_ms / p50, 3),
     }
-    if pallas_ratio is not None:
-        line["pallas_matern_speedup_vs_jnp"] = pallas_ratio
     print(json.dumps(line))
 
 

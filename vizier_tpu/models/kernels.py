@@ -3,9 +3,11 @@
 TPU-first replacement for the reference's TFP kernel stack
 (``FeatureScaledWithCategorical`` over Matern-5/2,
 ``/root/reference/vizier/_src/jax/models/tuned_gp_models.py:132-220``):
-pure jax.numpy, batched [N, D] x [M, D] → [N, M], MXU-friendly (the squared
-distance is computed via the ||a||² - 2a·b + ||b||² expansion so the inner
-product rides the systolic array in one matmul).
+pure jax.numpy, batched [N, D] x [M, D] → [N, M]. The squared distance
+uses the exact-difference form for typical dims (D ≤ 64) — XLA fuses the
+broadcast-subtract-square-reduce into one pass, and f32 stays accurate
+enough for the downstream Cholesky — and switches to the MXU
+||a||² - 2a·b + ||b||² matmul expansion only for wide feature spaces.
 
 Categorical features are integer category indices; the ARD distance adds
 (mismatch / lengthscale²) per categorical dimension (the exact-match kernel
@@ -80,19 +82,6 @@ class MixedFeatures(NamedTuple):
     categorical: Array  # [N, Ds] int
 
 
-# Route continuous-only cross-kernels through the fused Pallas TPU kernel
-# when the problem is big enough to pay off. Kill switch:
-# VIZIER_DISABLE_PALLAS=1 forces the jnp path (e.g. if a TPU runtime lacks
-# Mosaic support).
-_PALLAS_MIN_ELEMENTS = 128 * 128
-
-
-def _pallas_enabled() -> bool:
-    import os
-
-    return os.environ.get("VIZIER_DISABLE_PALLAS", "0") != "1"
-
-
 def matern52_ard(
     f1: MixedFeatures,
     f2: MixedFeatures,
@@ -105,26 +94,13 @@ def matern52_ard(
 ) -> Array:
     """Full mixed-feature ARD Matern-5/2 kernel matrix [N, M].
 
-    On TPU backends, continuous-only kernels above ``_PALLAS_MIN_ELEMENTS``
-    output elements use the fused Pallas kernel (``ops.matern_pallas``) —
-    no [N, M, D] intermediate in HBM.
+    XLA fuses the exact-difference distance (broadcast-subtract-square-
+    reduce over D) into a single pass — no [N, M, D] intermediate reaches
+    HBM. A hand-written Pallas kernel for this op was measured at
+    0.4-0.93x the XLA-fused path on TPU v5e across 512..16k point counts
+    (round 2) and removed: the op is bandwidth/dispatch-bound and the
+    compiler already schedules it optimally.
     """
-    if (
-        f1.categorical.shape[-1] == 0
-        and f1.continuous.shape[0] * f2.continuous.shape[0] >= _PALLAS_MIN_ELEMENTS
-        and _pallas_enabled()
-    ):
-        from vizier_tpu.ops import matern_pallas
-
-        if matern_pallas.is_tpu_backend():
-            inv = 1.0 / continuous_length_scales
-            if continuous_dim_mask is not None:
-                inv = jnp.where(continuous_dim_mask, inv, 0.0)
-            # custom-vjp wrapper: pallas forward, differentiable backward
-            # (the ARD likelihood takes gradients through this Gram).
-            return matern_pallas.matern52_ard_continuous_fused(
-                f1.continuous, f2.continuous, inv, amplitude
-            )
     sq = scaled_sq_distance_continuous(
         f1.continuous, f2.continuous, continuous_length_scales, dim_mask=continuous_dim_mask
     )
