@@ -1,0 +1,251 @@
+"""Tests for the round-2 inventory gap fills: classic experimenters,
+exploration/simple-regret scores, random_sample, Context/ProblemAndTrials,
+optimizer test utils, and the raytune run_tune plumbing."""
+
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import random_sample
+from vizier_tpu.benchmarks.analyzers import (
+    compute_average_marginal_parameter_entropy,
+    compute_parameter_entropy,
+    t_test_mean_score,
+)
+from vizier_tpu.benchmarks.experimenters.synthetic import classic
+
+
+def _run(experimenter, parameters_list):
+    trials = [
+        vz.Trial(id=i + 1, parameters=p) for i, p in enumerate(parameters_list)
+    ]
+    experimenter.evaluate(trials)
+    return trials
+
+
+class TestClassicExperimenters:
+    def test_branin_optima(self):
+        exptr = classic.Branin2DExperimenter()
+        trials = _run(
+            exptr,
+            [
+                {"x1": -np.pi, "x2": 12.275},
+                {"x1": np.pi, "x2": 2.275},
+                {"x1": 9.42478, "x2": 2.475},
+                {"x1": 0.0, "x2": 0.0},
+            ],
+        )
+        for t in trials[:3]:
+            assert t.final_measurement.metrics["value"].value == pytest.approx(
+                0.397887, abs=1e-4
+            )
+        assert trials[3].final_measurement.metrics["value"].value > 10.0
+
+    def test_hartmann3_optimum(self):
+        exptr = classic.HartmannExperimenter.from_3d()
+        (t,) = _run(exptr, [{"x1": 0.114614, "x2": 0.555649, "x3": 0.852547}])
+        assert t.final_measurement.metrics["value"].value == pytest.approx(
+            -3.86278, abs=1e-4
+        )
+        assert len(exptr.problem_statement().search_space.parameters) == 3
+
+    def test_hartmann6_optimum(self):
+        exptr = classic.HartmannExperimenter.from_6d()
+        opt = {
+            "x1": 0.20169, "x2": 0.150011, "x3": 0.476874,
+            "x4": 0.275332, "x5": 0.311652, "x6": 0.6573,
+        }
+        (t,) = _run(exptr, [opt])
+        assert t.final_measurement.metrics["value"].value == pytest.approx(
+            -3.32237, abs=1e-4
+        )
+
+    def test_fixed_multiarm(self):
+        exptr = classic.FixedMultiArmExperimenter({"a": 0.1, "b": 0.9})
+        problem = exptr.problem_statement()
+        assert problem.metric_information.item().goal.is_maximize
+        trials = _run(exptr, [{"arm": "a"}, {"arm": "b"}])
+        assert trials[0].final_measurement.metrics["reward"].value == 0.1
+        assert trials[1].final_measurement.metrics["reward"].value == 0.9
+
+    def test_bernoulli_multiarm_statistics(self):
+        exptr = classic.BernoulliMultiArmExperimenter({"a": 0.0, "b": 1.0}, seed=7)
+        trials = _run(exptr, [{"arm": "a"}, {"arm": "b"}] * 20)
+        rewards_a = [
+            t.final_measurement.metrics["reward"].value
+            for t in trials
+            if t.parameters.get_value("arm") == "a"
+        ]
+        rewards_b = [
+            t.final_measurement.metrics["reward"].value
+            for t in trials
+            if t.parameters.get_value("arm") == "b"
+        ]
+        assert set(rewards_a) == {0.0} and set(rewards_b) == {1.0}
+
+
+class TestExplorationScore:
+    def _config(self, kind):
+        space = vz.SearchSpace()
+        if kind == "double":
+            space.root.add_float_param("p", 0.0, 1.0)
+        elif kind == "int":
+            space.root.add_int_param("p", 0, 9)
+        else:
+            space.root.add_categorical_param("p", ["a", "b", "c"])
+        return space.parameters[0]
+
+    def test_uniform_beats_constant(self):
+        config = self._config("double")
+        rng = np.random.default_rng(0)
+        uniform = [vz.ParameterValue(float(v)) for v in rng.uniform(size=200)]
+        constant = [vz.ParameterValue(0.5)] * 200
+        assert compute_parameter_entropy(config, uniform) > compute_parameter_entropy(
+            config, constant
+        )
+
+    def test_categorical_entropy(self):
+        config = self._config("cat")
+        balanced = [vz.ParameterValue(v) for v in ["a", "b", "c"] * 30]
+        skewed = [vz.ParameterValue("a")] * 90
+        assert compute_parameter_entropy(config, balanced) == pytest.approx(
+            np.log(3), abs=1e-6
+        )
+        assert compute_parameter_entropy(config, skewed) == 0.0
+
+    def test_out_of_bounds_raises(self):
+        config = self._config("double")
+        with pytest.raises(ValueError):
+            compute_parameter_entropy(config, [vz.ParameterValue(2.0)])
+
+    def test_average_marginal_entropy(self):
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_categorical_param("p", ["a", "b"])
+        trials = [
+            vz.Trial(id=i + 1, parameters={"p": "a" if i % 2 else "b"})
+            for i in range(40)
+        ]
+        study = vz.ProblemAndTrials(problem=problem, trials=trials)
+        results = {"algo": {"spec": {0: study}}}
+        assert compute_average_marginal_parameter_entropy(results) == pytest.approx(
+            np.log(2), abs=1e-6
+        )
+        assert compute_average_marginal_parameter_entropy({}) == 0.0
+
+
+class TestSimpleRegretScore:
+    def test_better_candidate_scores_low(self):
+        rng = np.random.default_rng(0)
+        baseline = rng.normal(0.0, 0.1, size=20)
+        candidate = rng.normal(1.0, 0.1, size=20)
+        p_better = t_test_mean_score(
+            baseline, candidate, vz.ObjectiveMetricGoal.MAXIMIZE
+        )
+        p_worse = t_test_mean_score(
+            candidate, baseline, vz.ObjectiveMetricGoal.MAXIMIZE
+        )
+        assert p_better < 0.01 < p_worse
+
+    def test_minimize_flips_direction(self):
+        baseline = [1.0, 1.1, 0.9, 1.05]
+        candidate = [0.1, 0.2, 0.15, 0.12]
+        p = t_test_mean_score(baseline, candidate, vz.ObjectiveMetricGoal.MINIMIZE)
+        assert p < 0.01
+
+    def test_single_candidate_uses_one_sample_test(self):
+        baseline = [0.0, 0.1, -0.1, 0.05, -0.02]
+        p = t_test_mean_score([*baseline], [5.0], vz.ObjectiveMetricGoal.MAXIMIZE)
+        assert p < 0.01
+
+
+class TestRandomSample:
+    def test_sample_parameters_in_space(self):
+        space = vz.SearchSpace()
+        space.root.add_float_param("f", -1.0, 1.0)
+        space.root.add_int_param("i", 0, 5)
+        space.root.add_discrete_param("d", [0.1, 0.5, 2.5])
+        space.root.add_categorical_param("c", ["x", "y"])
+        rng = np.random.default_rng(0)
+        for _ in range(25):
+            params = random_sample.sample_parameters(rng, space)
+            space.assert_contains(params)
+
+    def test_discrete_snaps_to_closest(self):
+        assert random_sample.get_closest_element([0.0, 1.0, 10.0], 0.9) == 1.0
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            assert random_sample.sample_discrete(rng, [1.0, 2.0, 7.0]) in {
+                1.0, 2.0, 7.0,
+            }
+
+    def test_bernoulli_and_shuffle(self):
+        rng = np.random.default_rng(0)
+        assert random_sample.sample_bernoulli(rng, 1.0, "yes", "no") == "yes"
+        assert random_sample.sample_bernoulli(rng, 0.0, "yes", "no") == "no"
+        items = list(range(10))
+        shuffled = random_sample.shuffle_list(rng, list(items))
+        assert sorted(shuffled) == items
+
+
+class TestContextAndStudy:
+    def test_context_validation(self):
+        ctx = vz.Context(
+            description="ctx",
+            parameters={"p": vz.ParameterValue(1.0)},
+            related_links={"doc": "http://x"},
+        )
+        assert ctx.parameters["p"].value == 1.0
+        with pytest.raises(TypeError):
+            vz.Context(parameters={"p": 1.0})
+        with pytest.raises(TypeError):
+            vz.Context(description=3)
+
+    def test_problem_and_trials_copies_list(self):
+        problem = vz.ProblemStatement()
+        trials = (vz.Trial(id=1),)
+        study = vz.ProblemAndTrials(problem=problem, trials=trials)
+        assert isinstance(study.trials, list) and len(study.trials) == 1
+
+
+class TestOptimizerTestUtils:
+    def test_designer_as_optimizer_passes(self):
+        from vizier_tpu.designers.random import RandomDesigner
+        from vizier_tpu.optimizers.lbfgsb_optimizer import DesignerAsOptimizer
+        from vizier_tpu.testing import optimizer_test_utils
+
+        space = vz.SearchSpace()
+        space.root.add_float_param("x", 0.0, 1.0)
+        space.root.add_categorical_param("c", ["a", "b"])
+        opt = DesignerAsOptimizer(
+            designer_factory=lambda p: RandomDesigner(p.search_space, seed=1),
+            num_rounds=3,
+            batch_size=5,
+        )
+        optimizer_test_utils.assert_passes_on_random_single_metric_function(
+            space, opt, np_random_seed=1
+        )
+        optimizer_test_utils.assert_passes_on_random_multi_metric_function(
+            space, opt, np_random_seed=1
+        )
+
+
+class TestRunTunePlumbing:
+    def test_param_space_and_objective(self):
+        from vizier_tpu.raytune import run_tune
+
+        exptr = classic.Branin2DExperimenter()
+        space = run_tune.experimenter_param_space(exptr)
+        assert space["x1"] == {"type": "uniform", "min": -5.0, "max": 10.0}
+        objective = run_tune.experimenter_objective(exptr)
+        result = objective({"x1": np.pi, "x2": 2.275})
+        assert result["value"] == pytest.approx(0.397887, abs=1e-4)
+
+    def test_ray_gated_entry_points_raise(self):
+        from vizier_tpu.raytune import run_tune
+
+        if run_tune._RAY_AVAILABLE:
+            pytest.skip("ray installed")
+        with pytest.raises(ImportError):
+            run_tune.run_tune_bbob("sphere", 2)
+        with pytest.raises(ImportError):
+            run_tune.run_tune_distributed([], lambda: None)

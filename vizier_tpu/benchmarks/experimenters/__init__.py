@@ -15,6 +15,12 @@ from vizier_tpu.benchmarks.experimenters.surrogates import (
     NASBench201Handler,
     TabularSurrogateExperimenter,
 )
+from vizier_tpu.benchmarks.experimenters.synthetic.classic import (
+    BernoulliMultiArmExperimenter,
+    Branin2DExperimenter,
+    FixedMultiArmExperimenter,
+    HartmannExperimenter,
+)
 from vizier_tpu.benchmarks.experimenters.wrappers import (
     DiscretizingExperimenter,
     InfeasibleExperimenter,

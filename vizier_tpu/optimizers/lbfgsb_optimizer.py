@@ -80,48 +80,89 @@ class DesignerAsOptimizer:
 
     def optimize(
         self,
-        score_fn,  # list[TrialSuggestion] -> list[float]
+        score_fn,  # list[TrialSuggestion] -> list[float] | {metric: [N] or [N,1]}
         problem,
         *,
         count: int = 1,
     ):
+        """Runs a mini-study of the score function driven by the designer.
+
+        ``score_fn`` may return a plain sequence of floats (scored against a
+        synthetic MAXIMIZE "acquisition" metric, the common single-
+        acquisition path) or — matching the reference's
+        ``BatchTrialScoreFunction`` (``optimizers/base.py:34``) — a mapping
+        of metric name to an [N] / [N, 1] array, in which case the caller's
+        own metric goals rank the results (Pareto front for multi-metric).
+        """
+        import numpy as np
+
         from vizier_tpu.algorithms import core as core_lib
         from vizier_tpu.pyvizier import base_study_config
+        from vizier_tpu.pyvizier import multimetric
         from vizier_tpu.pyvizier import trial as trial_
 
-        # The designer optimizes a synthetic always-MAXIMIZE acquisition
-        # metric over the caller's search space — the caller's own metric
-        # goals must not flip the acquisition's sign.
-        metric_name = "acquisition"
-        inner_problem = base_study_config.ProblemStatement(
-            search_space=problem.search_space,
-            metric_information=base_study_config.MetricsConfig(
-                [
-                    base_study_config.MetricInformation(
-                        name=metric_name,
-                        goal=base_study_config.ObjectiveMetricGoal.MAXIMIZE,
-                    )
-                ]
-            ),
-        )
+        try:
+            dict_scores = isinstance(score_fn([]), dict)
+        except Exception:
+            # score_fn can't take an empty batch; reference-style dict fns
+            # are the norm when the caller's problem carries metric configs.
+            dict_scores = bool(problem.metric_information)
+        if dict_scores and problem.metric_information:
+            metric_goals = {
+                m.name: m.goal for m in problem.metric_information
+            }
+            inner_problem = problem
+        else:
+            # Single synthetic always-MAXIMIZE acquisition metric over the
+            # caller's search space — the caller's own metric goals must
+            # not flip the acquisition's sign.
+            metric_goals = {
+                "acquisition": base_study_config.ObjectiveMetricGoal.MAXIMIZE
+            }
+            inner_problem = base_study_config.ProblemStatement(
+                search_space=problem.search_space,
+                metric_information=base_study_config.MetricsConfig(
+                    [
+                        base_study_config.MetricInformation(
+                            name="acquisition",
+                            goal=base_study_config.ObjectiveMetricGoal.MAXIMIZE,
+                        )
+                    ]
+                ),
+            )
         designer = self.designer_factory(inner_problem)
-        del problem  # everything below uses inner_problem's metric
-        scored = []
+        scored = []  # (metrics_dict, suggestion)
         next_id = 1
         for _ in range(self.num_rounds):
             suggestions = designer.suggest(self.batch_size)
             if not suggestions:
                 break
             values = score_fn(suggestions)
+            if dict_scores:
+                per_trial = [
+                    {k: float(np.asarray(v[i]).reshape(())) for k, v in values.items()}
+                    for i in range(len(suggestions))
+                ]
+            else:
+                per_trial = [{"acquisition": float(v)} for v in values]
             completed = []
-            for s, v in zip(suggestions, values):
+            for s, metrics in zip(suggestions, per_trial):
                 t = s.to_trial(next_id)
                 next_id += 1
-                t.complete(
-                    trial_.Measurement(metrics={metric_name: float(v)})
-                )
+                t.complete(trial_.Measurement(metrics=metrics))
                 completed.append(t)
-                scored.append((float(v), s))
+                scored.append((metrics, s))
             designer.update(core_lib.CompletedTrials(completed), core_lib.ActiveTrials())
-        scored.sort(key=lambda pair: -pair[0])
-        return [s for _, s in scored[:count]]
+        names = list(metric_goals)
+        if len(names) == 1:
+            sign = 1.0 if metric_goals[names[0]].is_maximize else -1.0
+            scored.sort(key=lambda pair: -sign * pair[0][names[0]])
+            return [s for _, s in scored[:count]]
+        # Multi-metric: maximize-oriented Pareto rank, best ranks first.
+        signs = np.asarray(
+            [1.0 if metric_goals[n].is_maximize else -1.0 for n in names]
+        )
+        points = np.asarray([[m[n] for n in names] for m, _ in scored]) * signs
+        ranks = multimetric.ParetoOptimalAlgorithm().pareto_rank(points)
+        order = np.argsort(ranks, kind="stable")
+        return [scored[i][1] for i in order[:count]]

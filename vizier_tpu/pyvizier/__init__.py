@@ -22,7 +22,13 @@ from vizier_tpu.pyvizier.parameter_config import (
     SearchSpace,
     SearchSpaceSelector,
 )
-from vizier_tpu.pyvizier.study import StudyDescriptor, StudyState, StudyStateInfo
+from vizier_tpu.pyvizier.context import Context
+from vizier_tpu.pyvizier.study import (
+    ProblemAndTrials,
+    StudyDescriptor,
+    StudyState,
+    StudyStateInfo,
+)
 from vizier_tpu.pyvizier.study_config import (
     Algorithm,
     AutomatedStoppingConfig,

@@ -31,3 +31,18 @@ class StudyDescriptor:
     config: sc.StudyConfig
     guid: str = ""
     max_trial_id: int = 0
+
+
+@dataclasses.dataclass
+class ProblemAndTrials:
+    """Container pairing a problem statement with its trials.
+
+    Parity with ``/root/reference/vizier/_src/pyvizier/shared/study.py:25``;
+    the unit benchmark pipelines pass around (analyzers, state dumps).
+    """
+
+    problem: "base_study_config.ProblemStatement"  # noqa: F821 (kept unimported to avoid a cycle)
+    trials: list = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self.trials = list(self.trials)
