@@ -398,9 +398,12 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     def _decode_result(
         self, result: vectorized_lib.VectorizedOptimizerResult, count: int, *, kind: str
     ) -> List[trial_.TrialSuggestion]:
-        cont = np.asarray(result.features.continuous)[:count]
-        cat = np.asarray(result.features.categorical)[:count]
-        scores = np.asarray(result.scores)[:count]
+        # One batched device->host fetch (separate np.asarray calls are one
+        # blocking round trip each — costly on tunneled TPU links).
+        cont, cat, scores = jax.device_get(
+            (result.features.continuous, result.features.categorical, result.scores)
+        )
+        cont, cat, scores = cont[:count], cat[:count], scores[:count]
         suggestions = []
         for row_cont, row_cat, score in zip(cont, cat, scores):
             params = self._converter.to_parameters(

@@ -667,14 +667,24 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         self, result: vectorized_lib.VectorizedOptimizerResult, aux: dict, count: int
     ) -> List[trial_.TrialSuggestion]:
         conv = self._converter
-        cont = np.asarray(result.features.continuous)[:count]
-        cat = np.asarray(result.features.categorical)[:count]
-        scores = np.asarray(result.scores)[:count]
-        mean = np.asarray(aux["mean"])
-        stddev = np.asarray(aux["stddev"])
-        stddev_all = np.asarray(aux["stddev_from_all"])
-        use_ucb = np.asarray(aux["use_ucb"])
-        trust_radius = float(np.asarray(aux["trust_radius"]))
+        # ONE device->host fetch for everything this decode needs: each
+        # separate np.asarray on a device array is a blocking round trip
+        # (~75 ms over a tunneled TPU; 8 of them dominated suggest latency).
+        fetched = jax.device_get(
+            (
+                result.features.continuous,
+                result.features.categorical,
+                result.scores,
+                aux["mean"],
+                aux["stddev"],
+                aux["stddev_from_all"],
+                aux["use_ucb"],
+                aux["trust_radius"],
+            )
+        )
+        cont, cat, scores = fetched[0][:count], fetched[1][:count], fetched[2][:count]
+        mean, stddev, stddev_all, use_ucb = fetched[3:7]
+        trust_radius = float(fetched[7])
         suggestions = []
         for i in range(count):
             params = conv.to_parameters(
