@@ -26,7 +26,40 @@ def _progress(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _probe_backend(timeout_s: float = 180.0) -> bool:
+    """True iff `import jax; jax.devices()` completes in a subprocess.
+
+    A dead TPU tunnel makes backend *initialization* hang forever (round-1
+    failure mode: rc 124, no number at all). Probing in a killable
+    subprocess lets the benchmark fall back to CPU and still print an
+    honest JSON line instead of timing out silently.
+    """
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
+    backend_tag = None
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" not in platforms.split(","):
+        _progress("probe: checking the accelerator backend is alive (<=180s)")
+        if not _probe_backend():
+            _progress("probe: backend init hung/failed -> CPU fallback")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            backend_tag = "cpu_fallback_tpu_unreachable"
+            # Full budget on CPU risks the driver's timeout; shrink unless
+            # the caller pinned a scale explicitly.
+            os.environ.setdefault("VIZIER_BENCH_SCALE", "0.25")
+
     _progress("init: importing jax + applying platform env")
     # Round-1 lesson: without the config-level platform pin, the image's TPU
     # sitecustomize makes `JAX_PLATFORMS=cpu python bench.py` hang in
@@ -185,6 +218,8 @@ def main() -> None:
         "vs_baseline": round(target_ms / p50, 3),
         "e2e_default_designer_suggest_p50_ms": round(e2e_p50, 1),
     }
+    if backend_tag:
+        line["backend"] = backend_tag
     print(json.dumps(line))
 
 
