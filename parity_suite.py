@@ -481,6 +481,23 @@ def main() -> None:
 
     run_mo()
 
+    # -- Config 5: NASBench-201 cell space (BASELINE.md's NAS config) ------
+    # The real dataset isn't bundled in this image; the handler's synthetic
+    # table preserves the pipeline (6-op categorical cells -> snap-to-table
+    # accuracy) so the full tabular NAS benchmark path is measured e2e.
+    # (BASELINE names this config "via PyGlove"; pyglove itself is absent,
+    # so the same space runs through the designer path instead.)
+    from vizier_tpu.benchmarks.experimenters import surrogates
+
+    run_config(
+        "nasbench201_synthetic",
+        surrogates.NASBench201Handler().make_synthetic_experimenter(seed=0),
+        num_trials=max(int(80 * s), 20),
+        batch=5,
+        seeds=(1, 2),
+        skip=("my-gp-ucb", "ref-quasirandom"),  # UCB-PE covers the GP side
+    )
+
     report["elapsed_secs"] = round(time.time() - t_start, 1)
     report["all_pass"] = all(
         v.get("pass", True)

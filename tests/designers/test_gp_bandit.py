@@ -89,6 +89,31 @@ class TestTrustRegion:
         assert float(tr.penalty(near)[0]) == 0.0
         assert float(tr.penalty(far)[0]) > 0.0
 
+    def test_categorical_mismatch_not_penalized(self):
+        """Unobserved categorical combos must stay explorable (reference
+        min_linf_distance excludes categorical dims from the L-inf norm —
+        a mismatch would otherwise forbid every new cell)."""
+        tr = acquisitions.TrustRegion(
+            observed_continuous=jnp.asarray([[0.5]], jnp.float32),
+            observed_cat=jnp.asarray([[0, 0, 0]], jnp.int32),
+            row_mask=jnp.asarray([True]),
+        )
+        new_cell = kernels.MixedFeatures(
+            jnp.asarray([[0.5]], jnp.float32), jnp.asarray([[4, 2, 3]], jnp.int32)
+        )
+        assert float(tr.penalty(new_cell)[0]) == 0.0
+
+    def test_pure_categorical_space_all_trusted(self):
+        tr = acquisitions.TrustRegion(
+            observed_continuous=jnp.zeros((2, 0), jnp.float32),
+            observed_cat=jnp.asarray([[0, 0], [1, 1]], jnp.int32),
+            row_mask=jnp.asarray([True, True]),
+        )
+        q = kernels.MixedFeatures(
+            jnp.zeros((3, 0), jnp.float32), jnp.asarray([[4, 4], [2, 0], [3, 1]], jnp.int32)
+        )
+        assert np.all(np.asarray(tr.penalty(q)) == 0.0)
+
     def test_no_observations_no_penalty(self):
         tr = acquisitions.TrustRegion(
             observed_continuous=jnp.zeros((4, 2), jnp.float32),

@@ -148,6 +148,43 @@ class TestBatchDiversity:
         # No two suggestions collapse onto the same point.
         assert (gaps > 1e-3).all(), xs
 
+    def test_pure_categorical_batch_explores_new_cells(self):
+        """Regression: the trust region must not fence the batch onto
+        observed categorical cells (it once put every unobserved combo at
+        L-inf 1.0 > radius, collapsing all picks onto one observed cell)."""
+        p = vz.ProblemStatement()
+        for i in range(4):
+            p.search_space.root.add_categorical_param(
+                f"op{i}", ["a", "b", "c", "d"]
+            )
+        p.metric_information.append(
+            vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        d = _designer(p, max_acquisition_evaluations=800)
+        rng = np.random.default_rng(0)
+        trials = []
+        for i in range(6):
+            cell = {f"op{j}": "abcd"[rng.integers(4)] for j in range(4)}
+            t = vz.Trial(id=i + 1, parameters=cell)
+            t.complete(
+                vz.Measurement(
+                    metrics={"obj": float(sum(v == "a" for v in cell.values()))}
+                )
+            )
+            trials.append(t)
+        observed = {
+            tuple(str(t.parameters.get_value(f"op{j}")) for j in range(4))
+            for t in trials
+        }
+        d.update(core_lib.CompletedTrials(trials))
+        suggested = {
+            tuple(str(s.parameters[f"op{j}"].value) for j in range(4))
+            for s in d.suggest(4)
+        }
+        # The batch is diverse AND reaches outside the observed cells.
+        assert len(suggested) > 1, suggested
+        assert suggested - observed, (suggested, observed)
+
     def test_pending_active_trials_are_avoided(self):
         """A pending point deflates stddev around itself → PE goes elsewhere."""
         p = _single_metric_problem()

@@ -169,37 +169,42 @@ class NASBench201Handler:
         )
 
     def make_synthetic_experimenter(
-        self, *, num_rows: int = 1024, seed: int = 0
+        self, *, num_rows: Optional[int] = None, seed: int = 0
     ) -> base.Experimenter:
         """NASBench-201-STYLE surrogate over a synthetic accuracy table.
 
         Not real NASBench data (none is bundled in this image): a
         deterministic structured objective over the same 6-op categorical
-        cell space — op quality + pairwise interactions + noise — so the
-        full tabular-benchmark pipeline (suggest → snap-to-table → accuracy)
+        cell space — op quality + pairwise interactions — so the full
+        tabular-benchmark pipeline (suggest → snap-to-table → accuracy)
         runs end to end without the dataset.
+
+        Like the real NASBench-201, EVERY architecture is tabulated (all
+        5^6 = 15625 cells) by default, so no suggestion can fall outside
+        the table; ``num_rows`` subsamples for cheap tests (off-table
+        suggestions then complete infeasible, the exact-match contract).
         """
         rng = np.random.default_rng(seed)
         n_ops = len(self.OPS)
         quality = rng.normal(size=(6, n_ops))
         pair = rng.normal(scale=0.3, size=(6, 6, n_ops, n_ops))
-        rows: List[Dict] = []
-        ys: List[float] = []
-        seen = set()
-        while len(rows) < num_rows:
-            idx = tuple(rng.integers(0, n_ops, size=6))
-            if idx in seen:
-                continue
-            seen.add(idx)
-            score = sum(quality[i, idx[i]] for i in range(6))
-            for i in range(6):
-                for j in range(i + 1, 6):
-                    score += pair[i, j, idx[i], idx[j]]
-            acc = 100.0 / (1.0 + np.exp(-score / 4.0))  # accuracy-like range
-            rows.append({f"op{i}": self.OPS[idx[i]] for i in range(6)})
-            ys.append(float(acc))
+        all_idx = np.stack(
+            np.meshgrid(*[np.arange(n_ops)] * 6, indexing="ij"), axis=-1
+        ).reshape(-1, 6)  # [5^6, 6]
+        score = quality[np.arange(6)[None, :], all_idx].sum(axis=1)
+        for i in range(6):
+            for j in range(i + 1, 6):
+                score = score + pair[i, j, all_idx[:, i], all_idx[:, j]]
+        accs = 100.0 / (1.0 + np.exp(-score / 4.0))  # accuracy-like range
+        if num_rows is not None and num_rows < len(all_idx):
+            keep = rng.choice(len(all_idx), size=num_rows, replace=False)
+            all_idx, accs = all_idx[keep], accs[keep]
+        rows: List[Dict] = [
+            {f"op{i}": self.OPS[idx[i]] for i in range(6)} for idx in all_idx
+        ]
         return TabularSurrogateExperimenter(
-            self.problem_statement(), rows, ys, metric_name="accuracy"
+            self.problem_statement(), rows, [float(a) for a in accs],
+            metric_name="accuracy",
         )
 
 

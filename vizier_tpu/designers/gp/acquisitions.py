@@ -220,15 +220,21 @@ class TrustRegion:
         return jnp.minimum(self.min_radius + grow * 0.05, 1.0)
 
     def linf_distance(self, query: kernels.MixedFeatures) -> Array:
-        """[M] distance to the nearest valid observed point (L∞, mismatches=1)."""
-        qc, qs = query.continuous, query.categorical
+        """[M] distance to the nearest valid observed point (L∞).
+
+        CONTINUOUS dims only: the reference's ``min_linf_distance``
+        (``acquisitions.py:758``) deliberately excludes categorical
+        features from the trust-region distance — a mismatch would put
+        every unobserved category at L∞ = 1 > radius, and the penalty
+        would forbid exploring new categorical combinations outright (on a
+        pure-categorical space the argmax then collapses onto observed
+        cells).
+        """
+        qc = query.continuous
+        if qc.shape[-1] == 0:
+            return jnp.zeros(qc.shape[0], jnp.float32)
         dc = jnp.abs(qc[:, None, :] - self.observed_continuous[None, :, :])  # [M,N,Dc]
-        if qs.shape[-1]:
-            ds = (qs[:, None, :] != self.observed_cat[None, :, :]).astype(qc.dtype)
-            full = jnp.concatenate([dc, ds], axis=-1)
-        else:
-            full = dc
-        linf = jnp.max(full, axis=-1)  # [M, N]
+        linf = jnp.max(dc, axis=-1)  # [M, N]
         linf = jnp.where(self.row_mask[None, :], linf, jnp.inf)
         dist = jnp.min(linf, axis=-1)
         # No observations at all -> everything is trusted.

@@ -115,15 +115,24 @@ class VizierGaussianProcess:
                 )
             )
         if self.num_categorical:
+            # Weak prior centered at ls ~ 0.71, matching the reference's
+            # categorical length_scale_squared regularizer
+            # 0.01*log(ls^2/0.5)^2 over bounds ls in [0.1, 10]
+            # (`tuned_gp_models.py:183-193`). A tight categorical prior is
+            # destructive: at ls ~ 0.3 a single category mismatch puts
+            # cells ~11 scaled units apart, zeroing all cross-cell
+            # correlation — the GP then sees every unobserved cell as
+            # prior-mean, the UCB-PE promising region collapses onto
+            # observed cells, and batch exploration dies.
             specs.append(
                 params_lib.ParameterSpec(
                     "categorical_length_scales",
                     (self.num_categorical,),
-                    sc(0.005, 100.0),
-                    0.05,
-                    2.0,
-                    prior_mu=float(np.log(0.3)),
-                    prior_sigma=1.0,
+                    sc(0.05, 100.0),
+                    0.1,
+                    10.0,
+                    prior_mu=float(np.log(np.sqrt(0.5))),
+                    prior_sigma=3.5,
                 )
             )
         if self.use_input_warping and self.num_continuous:
