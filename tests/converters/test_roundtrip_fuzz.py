@@ -1,0 +1,123 @@
+"""Converter round-trip fuzz over randomly generated flat search spaces.
+
+Reference analog: ``converters/core_test.py``'s per-type round-trip checks,
+generalized into a property test — for arbitrary mixes of DOUBLE (linear/
+log/reverse-log), INTEGER, DISCRETE, and CATEGORICAL parameters,
+encode → decode must reproduce every trial's parameters exactly (exact for
+discrete types, to float tolerance for doubles), and the encoded matrices
+must stay inside the scaled unit ranges the GP stack assumes.
+"""
+
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import random_sample
+from vizier_tpu.converters import core as converters
+
+
+def _random_space(rng: np.random.Generator, num_params: int) -> vz.SearchSpace:
+    space = vz.SearchSpace()
+    root = space.root
+    for i in range(num_params):
+        kind = rng.integers(0, 4)
+        name = f"p{i}"
+        if kind == 0:
+            lo = float(rng.uniform(-10, 0))
+            hi = lo + float(rng.uniform(0.5, 20))
+            scale = rng.choice(
+                [vz.ScaleType.LINEAR, vz.ScaleType.LOG, vz.ScaleType.REVERSE_LOG]
+            )
+            if scale != vz.ScaleType.LINEAR:
+                lo = float(rng.uniform(1e-4, 1.0))
+                hi = lo * float(rng.uniform(10.0, 1e4))
+            root.add_float_param(name, lo, hi, scale_type=scale)
+        elif kind == 1:
+            lo = int(rng.integers(-20, 10))
+            hi = lo + int(rng.integers(1, 30))
+            root.add_int_param(name, lo, hi)
+        elif kind == 2:
+            num = int(rng.integers(2, 6))
+            vals = sorted(float(v) for v in rng.uniform(-5, 5, size=num))
+            root.add_discrete_param(name, vals)
+        else:
+            num = int(rng.integers(2, 6))
+            root.add_categorical_param(name, [f"c{j}" for j in range(num)])
+    return space
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_encode_decode_roundtrip(seed):
+    rng = np.random.default_rng(seed)
+    space = _random_space(rng, num_params=int(rng.integers(2, 7)))
+    enc = converters.SearchSpaceEncoder(space)
+    trials = [
+        vz.Trial(id=i + 1, parameters=random_sample.sample_parameters(rng, space))
+        for i in range(17)
+    ]
+    cont, cat = enc.encode(trials)
+
+    assert cont.shape == (17, enc.num_continuous)
+    assert cat.shape == (17, enc.num_categorical)
+    # Scaled continuous features live in [0, 1] (the GP's assumed range).
+    if enc.num_continuous:
+        assert cont.min() >= -1e-9 and cont.max() <= 1.0 + 1e-9
+
+    decoded = enc.decode(cont, cat)
+    assert len(decoded) == len(trials)
+    for t, params in zip(trials, decoded):
+        for config in space.parameters:
+            orig = t.parameters.get_value(config.name)
+            back = params.get_value(config.name)
+            if config.type == vz.ParameterType.DOUBLE:
+                lo, hi = config.bounds
+                assert back == pytest.approx(orig, abs=1e-4 * (hi - lo) + 1e-9)
+            else:
+                assert back == orig, (config.name, config.type, orig, back)
+        space.assert_contains(params)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_decode_arbitrary_unit_rows_stay_in_space(seed):
+    """Any point of the unit model space must decode to a feasible trial."""
+    rng = np.random.default_rng(100 + seed)
+    space = _random_space(rng, num_params=4)
+    enc = converters.SearchSpaceEncoder(space)
+    cont = rng.uniform(size=(25, enc.num_continuous))
+    sizes = enc.category_sizes
+    cat = np.stack(
+        [rng.integers(0, s, size=25) for s in sizes], axis=-1
+    ) if sizes else np.zeros((25, 0), np.int32)
+    for params in enc.decode(cont, cat):
+        space.assert_contains(params)
+
+
+def test_max_discrete_indices_moves_small_ints_to_categorical():
+    space = vz.SearchSpace()
+    space.root.add_int_param("small", 0, 3)      # 4 values <= threshold
+    space.root.add_int_param("large", 0, 100)    # stays continuous
+    space.root.add_discrete_param("disc", [0.1, 0.7])
+    enc = converters.SearchSpaceEncoder(space, max_discrete_indices=5)
+    assert enc.num_categorical == 2  # small + disc
+    assert enc.num_continuous == 1   # large
+    t = vz.Trial(id=1, parameters={"small": 2, "large": 40, "disc": 0.7})
+    cont, cat = enc.encode([t])
+    (params,) = enc.decode(cont, cat)
+    assert params.get_value("small") == 2
+    assert params.get_value("large") == 40
+    assert params.get_value("disc") == 0.7
+
+
+def test_log_scaling_is_monotone_and_covers_unit_interval():
+    space = vz.SearchSpace()
+    space.root.add_float_param("lr", 1e-5, 1.0, scale_type=vz.ScaleType.LOG)
+    enc = converters.SearchSpaceEncoder(space)
+    raws = [1e-5, 1e-4, 1e-2, 1.0]
+    trials = [vz.Trial(id=i + 1, parameters={"lr": v}) for i, v in enumerate(raws)]
+    cont, _ = enc.encode(trials)
+    col = cont[:, 0]
+    assert col[0] == pytest.approx(0.0, abs=1e-6)
+    assert col[-1] == pytest.approx(1.0, abs=1e-6)
+    assert np.all(np.diff(col) > 0)
+    # Equal log-space steps must land equally spaced in scaled space.
+    assert col[1] - col[0] == pytest.approx(0.2, abs=1e-3)
