@@ -1,0 +1,141 @@
+"""Behavioral tests for the vectorized eagle (firefly) strategy.
+
+Reference analog: ``optimizers/eagle_strategy_test.py`` — attraction
+toward better flies, perturbation penalization/decay, exhausted-fly
+re-seeding (never the best), categorical mutation validity, prior-feature
+pool seeding, and end-to-end optimization quality vs random search under
+an equal evaluation budget.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vizier_tpu.models import kernels
+from vizier_tpu.optimizers import eagle as eagle_lib
+from vizier_tpu.optimizers import vectorized as vectorized_lib
+
+
+def _strategy(dc=2, sizes=(), **cfg):
+    config = eagle_lib.EagleStrategyConfig(**cfg) if cfg else eagle_lib.EagleStrategyConfig()
+    return eagle_lib.VectorizedEagleStrategy(
+        num_continuous=dc, category_sizes=sizes, config=config
+    )
+
+
+class TestPoolDynamics:
+    def test_attraction_moves_unseen_gap_toward_better_fly(self):
+        """A low-reward fly's proposal drifts toward the high-reward fly."""
+        s = _strategy(dc=2, pool_size=2, perturbation=0.0)
+        state = eagle_lib.EagleState(
+            features=jnp.asarray([[0.2, 0.2], [0.8, 0.8]], jnp.float32),
+            categorical=jnp.zeros((2, 0), jnp.int32),
+            rewards=jnp.asarray([0.0, 10.0], jnp.float32),
+            perturbations=jnp.zeros((2,), jnp.float32),
+        )
+        proposal = s.suggest(state, jax.random.PRNGKey(0))
+        moved = np.asarray(proposal.continuous)
+        # Fly 0 (worse) moves toward fly 1; fly 1 barely moves toward fly 0.
+        assert moved[0, 0] > 0.2 and moved[0, 1] > 0.2
+        dist0 = np.linalg.norm(moved[0] - np.array([0.8, 0.8]))
+        assert dist0 < np.linalg.norm([0.6, 0.6])
+
+    def test_unimproved_fly_perturbation_decays(self):
+        s = _strategy(dc=2, pool_size=4)
+        rng = jax.random.PRNGKey(1)
+        state = s.init_state(rng)
+        cands = s.suggest(state, rng)
+        worse = jnp.full((4,), -jnp.inf)  # nobody improves (rewards were -inf... use second round)
+        state = s.update(state, rng, cands, jnp.zeros((4,)))  # first: all improve
+        p0 = np.asarray(state.perturbations).copy()
+        cands = s.suggest(state, jax.random.PRNGKey(2))
+        state = s.update(state, jax.random.PRNGKey(3), cands, worse)
+        p1 = np.asarray(state.perturbations)
+        np.testing.assert_allclose(p1, p0 * s.config.penalize_factor, rtol=1e-5)
+
+    def test_exhausted_fly_reseeds_but_best_survives(self):
+        s = _strategy(dc=2, pool_size=3)
+        rng = jax.random.PRNGKey(0)
+        state = eagle_lib.EagleState(
+            features=jnp.asarray([[0.1, 0.1], [0.5, 0.5], [0.9, 0.9]], jnp.float32),
+            categorical=jnp.zeros((3, 0), jnp.int32),
+            rewards=jnp.asarray([1.0, 5.0, 2.0], jnp.float32),
+            # Below the lower bound after one penalization.
+            perturbations=jnp.full((3,), 1e-5, jnp.float32),
+        )
+        cands = kernels.MixedFeatures(state.features, state.categorical)
+        new = s.update(state, rng, cands, jnp.asarray([-1.0, -1.0, -1.0]))
+        rewards = np.asarray(new.rewards)
+        # Best fly (index 1) keeps its reward; the others were re-seeded.
+        assert rewards[1] == 5.0
+        assert rewards[0] == -np.inf and rewards[2] == -np.inf
+        assert np.asarray(new.perturbations)[0] == pytest.approx(
+            s.config.perturbation
+        )
+
+    def test_categorical_proposals_always_valid(self):
+        sizes = (3, 5, 2)
+        s = _strategy(dc=1, sizes=sizes)
+        rng = jax.random.PRNGKey(0)
+        state = s.init_state(rng)
+        state = state.replace(rewards=jnp.arange(s.config.pool_size, dtype=jnp.float32))
+        for i in range(5):
+            prop = s.suggest(state, jax.random.PRNGKey(i))
+            cat = np.asarray(prop.categorical)
+            for d, size in enumerate(sizes):
+                assert cat[:, d].min() >= 0 and cat[:, d].max() < size
+            cont = np.asarray(prop.continuous)
+            assert cont.min() >= 0.0 and cont.max() <= 1.0
+
+    def test_prior_features_seed_pool_head(self):
+        s = _strategy(dc=2, sizes=(4,))
+        prior = kernels.MixedFeatures(
+            jnp.asarray([[0.25, 0.75]], jnp.float32), jnp.asarray([[2]], jnp.int32)
+        )
+        state = s.init_state(jax.random.PRNGKey(0), prior_features=prior)
+        np.testing.assert_allclose(
+            np.asarray(state.features)[0], [0.25, 0.75], atol=1e-6
+        )
+        assert int(np.asarray(state.categorical)[0, 0]) == 2
+
+
+class TestOptimizationQuality:
+    def test_beats_random_search_at_equal_budget(self):
+        """Eagle must beat pure random sampling on a smooth 6-D bowl."""
+        dc = 6
+        target = jnp.asarray([0.3, 0.7, 0.5, 0.2, 0.9, 0.4])
+
+        def score(feats: kernels.MixedFeatures):
+            return -jnp.sum((feats.continuous - target[None, :]) ** 2, axis=-1)
+
+        budget = 4000
+        eagle_opt = vectorized_lib.VectorizedOptimizer(
+            _strategy(dc=dc), max_evaluations=budget
+        )
+        res = eagle_opt(score, jax.random.PRNGKey(0), count=1)
+        eagle_best = float(res.scores[0])
+
+        rand = jax.random.uniform(jax.random.PRNGKey(0), (budget, dc))
+        rand_best = float(
+            jnp.max(score(kernels.MixedFeatures(rand, jnp.zeros((budget, 0), jnp.int32))))
+        )
+        assert eagle_best > rand_best
+        assert eagle_best > -1e-3  # essentially at the optimum
+
+    def test_mixed_space_finds_categorical_optimum(self):
+        sizes = (4, 4)
+
+        def score(feats: kernels.MixedFeatures):
+            cat_bonus = jnp.sum((feats.categorical == 2).astype(jnp.float32), axis=-1)
+            return cat_bonus - jnp.sum((feats.continuous - 0.5) ** 2, axis=-1)
+
+        opt = vectorized_lib.VectorizedOptimizer(
+            _strategy(dc=2, sizes=sizes), max_evaluations=3000
+        )
+        res = opt(score, jax.random.PRNGKey(1), count=1)
+        assert np.asarray(res.features.categorical)[0].tolist() == [2, 2]
+        np.testing.assert_allclose(
+            np.asarray(res.features.continuous)[0], 0.5, atol=0.05
+        )
