@@ -7,10 +7,21 @@ from vizier_tpu.benchmarks.analyzers.convergence_curve import (
     SimpleRegretComparator,
     WinRateComparator,
 )
+from vizier_tpu.benchmarks.analyzers.exploration_score import (
+    compute_average_marginal_parameter_entropy,
+    compute_parameter_entropy,
+)
+from vizier_tpu.benchmarks.analyzers.simple_regret_score import t_test_mean_score
 from vizier_tpu.benchmarks.experimenters.base import (
     Experimenter,
     NumpyExperimenter,
     bbob_problem,
+)
+from vizier_tpu.benchmarks.experimenters.synthetic.classic import (
+    BernoulliMultiArmExperimenter,
+    Branin2DExperimenter,
+    FixedMultiArmExperimenter,
+    HartmannExperimenter,
 )
 from vizier_tpu.benchmarks.runners.benchmark_runner import (
     AddPriorTrials,
