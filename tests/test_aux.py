@@ -337,3 +337,41 @@ class TestRound1Additions:
 
         assert hasattr(GradientFreeOptimizer, "optimize")
         assert hasattr(BranchSelector, "select_branches")
+
+
+class TestValidatorsAndAssertions:
+    def test_validators(self):
+        import pytest as _pytest
+
+        from vizier_tpu.utils import validators as v
+
+        v.assert_not_empty("xs", [1])
+        v.assert_not_negative("n", 0)
+        v.assert_between("p", 0.5, 0.0, 1.0)
+        v.assert_re_fullmatch("id", "abc_1", r"[a-z_0-9]+")
+        v.assert_shape("m", np.zeros((3, 2)), (3, None))
+        for bad in (
+            lambda: v.assert_not_empty("xs", []),
+            lambda: v.assert_not_negative("n", -1),
+            lambda: v.assert_not_none("x", None),
+            lambda: v.assert_between("p", 2.0, 0.0, 1.0),
+            lambda: v.assert_re_fullmatch("id", "A!", r"[a-z]+"),
+            lambda: v.assert_shape("m", np.zeros((3, 2)), (2, 2)),
+        ):
+            with _pytest.raises(ValueError):
+                bad()
+
+    def test_arraytree_allclose(self):
+        import pytest as _pytest
+
+        from vizier_tpu.testing import numpy_assertions as na
+
+        na.assert_arraytree_allclose(
+            {"a": np.ones(3), "b": {"c": 2.0, "s": "x"}},
+            {"a": np.ones(3), "b": {"c": 2.0, "s": "x"}},
+        )
+        with _pytest.raises(AssertionError):
+            na.assert_arraytree_allclose({"a": np.ones(3)}, {"a": np.zeros(3)})
+        na.assert_pytree_allclose((np.ones(2), [3.0]), (np.ones(2), [3.0]))
+        with _pytest.raises(AssertionError):
+            na.assert_pytree_allclose((np.ones(2),), (np.ones(2), [3.0]))

@@ -1,0 +1,38 @@
+"""Nested-structure numpy assertions for tests.
+
+Parity with ``/root/reference/vizier/testing/numpy_assertions.py:23``
+(``assert_arraytree_allclose``), extended to arbitrary pytrees (our
+params/GPState containers are flax structs, not plain dicts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+
+def assert_arraytree_allclose(d1: Mapping[str, Any], d2: Mapping[str, Any], **kwargs) -> None:
+    """Compares two (nested) dictionaries of arrays/scalars."""
+    np.testing.assert_equal(sorted(d1.keys()), sorted(d2.keys()))
+    for k, v in d1.items():
+        if isinstance(v, dict):
+            assert_arraytree_allclose(v, d2[k], **kwargs)
+        else:
+            try:
+                np.testing.assert_allclose(v, d2[k], err_msg=f"key={k!r}", **kwargs)
+            except TypeError:
+                np.testing.assert_equal(v, d2[k], err_msg=f"key={k!r}")
+
+
+def assert_pytree_allclose(t1: Any, t2: Any, **kwargs) -> None:
+    """Compares two arbitrary pytrees (same treedef, allclose leaves)."""
+    l1, d1 = jax.tree_util.tree_flatten(t1)
+    l2, d2 = jax.tree_util.tree_flatten(t2)
+    if d1 != d2:
+        raise AssertionError(f"Tree structures differ:\n  {d1}\n  {d2}")
+    for i, (a, b) in enumerate(zip(l1, l2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), err_msg=f"leaf {i}", **kwargs
+        )
