@@ -47,6 +47,27 @@ def _probe_backend(timeout_s: float = 180.0) -> bool:
         return False
 
 
+def _arm_watchdog(budget_s: float) -> None:
+    """Hard-exits with a stack dump if the benchmark wedges mid-run.
+
+    The CPU-fallback probe only covers backend *init*; a tunnel that dies
+    mid-run would otherwise hang a device call until the driver's timeout
+    with zero diagnostics. The watchdog leaves a traceback on stderr and a
+    prompt non-zero exit instead.
+    """
+    import faulthandler
+    import threading
+
+    def fire():
+        _progress(f"WATCHDOG: no completion after {budget_s:.0f}s; dumping stacks")
+        faulthandler.dump_traceback(file=sys.stderr)
+        os._exit(3)
+
+    t = threading.Timer(budget_s, fire)
+    t.daemon = True
+    t.start()
+
+
 def main() -> None:
     backend_tag = None
     platforms = os.environ.get("JAX_PLATFORMS", "")
@@ -59,6 +80,9 @@ def main() -> None:
             # Full budget on CPU risks the driver's timeout; shrink unless
             # the caller pinned a scale explicitly.
             os.environ.setdefault("VIZIER_BENCH_SCALE", "0.25")
+    # A CPU-fallback run is legitimately slower; give it a longer leash.
+    default_watchdog = 900.0 if backend_tag else 540.0
+    _arm_watchdog(float(os.environ.get("VIZIER_BENCH_WATCHDOG_S", default_watchdog)))
 
     _progress("init: importing jax + applying platform env")
     # Round-1 lesson: without the config-level platform pin, the image's TPU
