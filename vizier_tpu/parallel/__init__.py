@@ -50,6 +50,39 @@ def create_mesh(
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Mesh:
+    """Joins a multi-host JAX cluster and returns the global device mesh.
+
+    The reference's distributed story is gRPC-only (one CPU host per
+    Pythia call); this is the scale-out path it never had: each host runs
+    one process, ``jax.distributed.initialize`` wires the cluster over
+    DCN, and the returned 1-D mesh spans every chip of every host. All
+    sharded entry points in this module take that mesh unchanged — the
+    parallel axes (restarts / ensemble / pools) are communication-free,
+    so cross-host traffic is one final top-k gather; everything else
+    rides ICI within each host's slice.
+
+    On TPU pods the arguments are auto-detected from the runtime
+    environment and may be omitted.
+    """
+    if jax.process_count() == 1 and coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif jax.process_count() == 1 and coordinator_address is None:
+        try:
+            jax.distributed.initialize()  # TPU-pod auto-detection
+        except Exception:
+            pass  # single-host: fall through to a local mesh
+    return create_mesh()
+
+
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
 
