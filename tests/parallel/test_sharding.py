@@ -120,3 +120,16 @@ class TestShardedAcquisition:
         cont = np.asarray(result.features.continuous)
         assert cont.shape == (2, 2)
         assert np.isfinite(np.asarray(result.scores)).all()
+
+
+class TestMultihostInit:
+    def test_single_host_returns_full_mesh(self):
+        mesh = parallel.initialize_multihost()
+        assert len(mesh.devices.flat) == len(jax.devices())
+        # Sharded train accepts the returned mesh unchanged.
+        model = gp_lib.VizierGaussianProcess(num_continuous=2, num_categorical=0)
+        states = parallel.train_gp_sharded(
+            model, lbfgs_lib.AdamOptimizer(maxiter=5), _data(),
+            jax.random.PRNGKey(0), num_restarts=8, ensemble_size=1, mesh=mesh,
+        )
+        assert np.isfinite(np.asarray(states.chol)).all()
