@@ -1,0 +1,130 @@
+"""Tests for MultiMetricCurveConverter, RestartingCurveConverter, and
+build_convergence_curve (reference convergence_curve.py:464,516,1108)."""
+
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.benchmarks.analyzers import convergence_curve as cc
+
+
+def _trial(i, metrics):
+    t = vz.Trial(id=i, parameters={"x": 0.5})
+    t.complete(vz.Measurement(metrics=metrics))
+    return t
+
+
+class TestMultiMetricCurveConverter:
+    def test_single_objective_routes_to_convergence(self):
+        config = vz.MetricsConfig(
+            [vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)]
+        )
+        conv = cc.MultiMetricCurveConverter.from_metrics_config(config)
+        assert isinstance(conv.converter, cc.ConvergenceCurveConverter)
+        curve = conv.convert([_trial(i + 1, {"obj": float(v)}) for i, v in enumerate([1, 3, 2])])
+        np.testing.assert_allclose(curve.ys[0], [1, 3, 3])
+
+    def test_multi_objective_routes_to_hypervolume(self):
+        config = vz.MetricsConfig(
+            [
+                vz.MetricInformation(name="f1", goal=vz.ObjectiveMetricGoal.MAXIMIZE),
+                vz.MetricInformation(name="f2", goal=vz.ObjectiveMetricGoal.MAXIMIZE),
+            ]
+        )
+        conv = cc.MultiMetricCurveConverter.from_metrics_config(
+            config, reference_point=np.zeros(2)
+        )
+        assert isinstance(conv.converter, cc.HypervolumeCurveConverter)
+        trials = [
+            _trial(1, {"f1": 1.0, "f2": 0.2}),
+            _trial(2, {"f1": 0.2, "f2": 1.0}),
+        ]
+        curve = conv.convert(trials)
+        assert curve.ys.shape == (1, 2)
+        assert curve.ys[0, 1] >= curve.ys[0, 0] - 1e-9
+
+    def test_unsafe_trials_are_warped_out(self):
+        config = vz.MetricsConfig(
+            [
+                vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE),
+                vz.MetricInformation(
+                    name="safe",
+                    goal=vz.ObjectiveMetricGoal.MAXIMIZE,
+                    safety_threshold=0.5,
+                ),
+            ]
+        )
+        conv = cc.MultiMetricCurveConverter.from_metrics_config(config)
+        trials = [
+            _trial(1, {"obj": 1.0, "safe": 0.9}),
+            _trial(2, {"obj": 100.0, "safe": 0.1}),  # unsafe: must not count
+        ]
+        curve = conv.convert(trials)
+        np.testing.assert_allclose(curve.ys[0], [1.0, 1.0])
+        # The caller's trials are untouched (conversion deep-copies).
+        assert trials[1].infeasibility_reason is None
+
+    def test_empty_trials_raise(self):
+        config = vz.MetricsConfig(
+            [vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)]
+        )
+        conv = cc.MultiMetricCurveConverter.from_metrics_config(config)
+        with pytest.raises(ValueError):
+            conv.convert([])
+
+
+class TestRestartingCurveConverter:
+    class _CountingFactory:
+        def __init__(self):
+            self.builds = 0
+            self.metric = vz.MetricInformation(
+                name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE
+            )
+
+        def __call__(self):
+            self.builds += 1
+            return cc.ConvergenceCurveConverter(self.metric)
+
+    def test_restarts_at_rate_crossings(self):
+        factory = self._CountingFactory()
+        conv = cc.RestartingCurveConverter(
+            factory, restart_min_trials=0, restart_rate=2.0
+        )
+        next_id = 1
+        for batch in range(6):
+            trials = [_trial(next_id + j, {"obj": float(next_id + j)}) for j in range(3)]
+            next_id += 3
+            curve = conv.convert(trials)
+            assert curve.ys.shape[1] == 3  # tail slice covers only the batch
+            # Best-so-far of the latest batch is always its own max.
+            assert curve.ys[0, -1] == float(next_id - 1)
+        # 18 trials at rate 2 -> restarts after crossing 4,8,16 -> >1 build.
+        assert factory.builds >= 3
+
+    def test_replay_preserves_best_so_far(self):
+        factory = self._CountingFactory()
+        conv = cc.RestartingCurveConverter(
+            factory, restart_min_trials=0, restart_rate=2.0
+        )
+        conv.convert([_trial(1, {"obj": 10.0})])
+        conv.convert([_trial(2, {"obj": 1.0})])
+        # The full history feeds every call: best-so-far keeps 10 across
+        # batches and converter rebuilds.
+        curve = conv.convert([_trial(3, {"obj": 2.0})])
+        assert curve.ys[0, -1] == 10.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            cc.RestartingCurveConverter(lambda: None, restart_min_trials=-1)
+        with pytest.raises(ValueError):
+            cc.RestartingCurveConverter(lambda: None, restart_rate=0.5)
+
+
+class TestBuildConvergenceCurve:
+    def test_first_reaching_indices(self):
+        out = cc.build_convergence_curve([1.0, 2.0, 3.0], [0.5, 1.5, 2.5])
+        assert out == [1.0, 2.0, float("inf")]
+
+    def test_identical_curves_are_diagonal(self):
+        curve = [1.0, 2.0, 3.0]
+        assert cc.build_convergence_curve(curve, curve) == [0.0, 1.0, 2.0]
