@@ -1,3 +1,11 @@
+"""Spatio-temporal converter tests."""
+
+import numpy as np
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.converters import spatio_temporal
+
+
 
 
 class TestDenseConverter:
@@ -22,3 +30,67 @@ class TestDenseConverter:
         np.testing.assert_allclose(values[0, :, 0], [0, 1, 2, 3, 4])
         assert np.isnan(values[1]).all()
         np.testing.assert_allclose(grid, [0, 1, 2, 3, 4])
+
+
+class TestRoundTwoAdditions:
+    def _trial_with_curve(self, i, values, metric="obj"):
+        t = vz.Trial(id=i, parameters={"x": 0.5})
+        for step, v in enumerate(values):
+            t.measurements.append(
+                vz.Measurement(metrics={metric: float(v)}, steps=step + 1)
+            )
+        return t
+
+    def _metrics(self, goal=vz.ObjectiveMetricGoal.MAXIMIZE):
+        return vz.MetricsConfig([vz.MetricInformation(name="obj", goal=goal)])
+
+    def test_cummax_mode_is_goal_aware(self):
+        t = self._trial_with_curve(1, [1.0, 3.0, 2.0])
+        ext = spatio_temporal.TimedLabelsExtractor(self._metrics(), value_mode="cummax")
+        np.testing.assert_allclose(
+            ext.convert_trial(t).values[:, 0], [1.0, 3.0, 3.0]
+        )
+        ext_min = spatio_temporal.TimedLabelsExtractor(
+            self._metrics(vz.ObjectiveMetricGoal.MINIMIZE), value_mode="cummax"
+        )
+        np.testing.assert_allclose(
+            ext_min.convert_trial(t).values[:, 0], [1.0, 1.0, 1.0]
+        )
+
+    def test_invalid_value_mode_raises(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            spatio_temporal.TimedLabelsExtractor(self._metrics(), value_mode="bogus")
+
+    def test_extract_all_timestamps_and_normalize(self):
+        trials = [
+            self._trial_with_curve(1, [1.0, 2.0]),
+            self._trial_with_curve(2, [5.0, 6.0, 7.0]),
+        ]
+        ext = spatio_temporal.TimedLabelsExtractor(self._metrics())
+        stamps = ext.extract_all_timestamps(trials)
+        np.testing.assert_allclose(stamps, [1.0, 2.0, 3.0])
+        np.testing.assert_allclose(ext.to_timestamps(stamps), [1 / 3, 2 / 3, 1.0])
+
+    def test_dense_to_xty(self):
+        space = vz.SearchSpace()
+        space.root.add_float_param("x", 0.0, 1.0)
+        space.root.add_categorical_param("c", ["a", "b"])
+        trials = []
+        for i in range(3):
+            t = vz.Trial(id=i + 1, parameters={"x": 0.25 * i, "c": "a"})
+            for step in range(4):
+                t.measurements.append(
+                    vz.Measurement(metrics={"obj": float(step + i)}, steps=step + 1)
+                )
+            trials.append(t)
+        conv = spatio_temporal.DenseSpatioTemporalConverter(
+            spatio_temporal.TimedLabelsExtractor(self._metrics()), num_steps=8
+        )
+        x, t_stamps, y = conv.to_xty(trials, space)
+        assert x.shape == (3, 2) and y.shape == (3, 8, 1)
+        assert t_stamps.shape == (8,)
+        assert t_stamps[-1] == 1.0 and np.all(np.diff(t_stamps) > 0)
+        # Curves are monotone per construction; interpolation keeps them so.
+        assert np.all(np.diff(y[:, :, 0], axis=1) >= -1e-9)

@@ -27,10 +27,21 @@ class TimedLabels:
 
 @dataclasses.dataclass
 class TimedLabelsExtractor:
-    """Extracts per-trial measurement curves for the configured metrics."""
+    """Extracts per-trial measurement curves for the configured metrics.
+
+    ``value_mode='cummax'`` converts each metric's curve to its running
+    best (goal-aware: running min for MINIMIZE metrics) — the monotone form
+    curve-extrapolation early-stopping models expect (reference
+    ``TimedLabelsExtractor._cummax_fn``, ``spatio_temporal.py:104``).
+    """
 
     metrics: base_study_config.MetricsConfig
     use_steps: bool = True
+    value_mode: str = "raw"  # 'raw' | 'cummax'
+
+    def __post_init__(self):
+        if self.value_mode not in ("raw", "cummax"):
+            raise ValueError(f"Unknown value_mode {self.value_mode!r}.")
 
     def convert_trial(self, trial: trial_.Trial) -> TimedLabels:
         names = [m.name for m in self.metrics]
@@ -44,13 +55,38 @@ class TimedLabelsExtractor:
                     for n in names
                 ]
             )
+        values = np.asarray(rows, dtype=np.float64).reshape(len(rows), len(names))
+        if self.value_mode == "cummax" and len(rows):
+            for j, info in enumerate(self.metrics):
+                col = values[:, j]
+                if info.goal.is_maximize:
+                    values[:, j] = np.fmax.accumulate(col)
+                else:
+                    values[:, j] = np.fmin.accumulate(col)
         return TimedLabels(
             positions=np.asarray(positions, dtype=np.float64),
-            values=np.asarray(rows, dtype=np.float64).reshape(len(rows), len(names)),
+            values=values,
         )
 
     def convert(self, trials: Sequence[trial_.Trial]) -> List[TimedLabels]:
         return [self.convert_trial(t) for t in trials]
+
+    def extract_all_timestamps(
+        self, trials: Sequence[trial_.Trial]
+    ) -> np.ndarray:
+        """Sorted union of every trial's measurement positions."""
+        curves = self.convert(trials)
+        parts = [c.positions for c in curves if len(c.positions)]
+        return np.unique(np.concatenate(parts)) if parts else np.zeros(0)
+
+    def to_timestamps(
+        self, positions: np.ndarray, *, max_position: Optional[float] = None
+    ) -> np.ndarray:
+        """Normalizes raw positions into [0, 1] (for temporal kernels)."""
+        positions = np.asarray(positions, dtype=np.float64)
+        if max_position is None:
+            max_position = float(positions.max()) if positions.size else 1.0
+        return positions / max(max_position, 1e-12)
 
 
 @dataclasses.dataclass
@@ -126,3 +162,26 @@ class DenseSpatioTemporalConverter:
                 if finite.any():
                     values[i, :, j] = np.interp(grid, pos[finite], val[finite, j])
         return values, grid
+
+    def to_xty(
+        self,
+        trials: Sequence[trial_.Trial],
+        search_space,
+        *,
+        max_position: Optional[float] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(X [N, D], t [T], Y [N, T, M]): the spatio-temporal model input.
+
+        Reference ``DenseSpatioTemporalConverter.to_xty``
+        (``spatio_temporal.py:481``): spatial features via the standard
+        search-space encoding (continuous block + categorical indices
+        appended as float columns), timestamps normalized to [0, 1].
+        """
+        from vizier_tpu.converters import core as converters_core
+
+        enc = converters_core.SearchSpaceEncoder(search_space)
+        cont, cat = enc.encode(trials)
+        x = np.concatenate([cont, cat.astype(np.float64)], axis=1)
+        y, grid = self.to_arrays(trials, max_position=max_position)
+        t = self.extractor.to_timestamps(grid, max_position=max_position)
+        return x, t, y
