@@ -118,6 +118,8 @@ class TestRestartingCurveConverter:
             cc.RestartingCurveConverter(lambda: None, restart_min_trials=-1)
         with pytest.raises(ValueError):
             cc.RestartingCurveConverter(lambda: None, restart_rate=0.5)
+        with pytest.raises(ValueError):
+            cc.RestartingCurveConverter(lambda: None, restart_rate=1.0)
 
 
 class TestBuildConvergenceCurve:

@@ -367,12 +367,17 @@ class MultiMetricCurveConverter:
     def convert(self, trials: Sequence[trial_.Trial]) -> ConvergenceCurve:
         if not trials:
             raise ValueError("No trials provided.")
+        if not any(m.is_safety_metric for m in self.metrics_config):
+            return self.converter.convert(list(trials))
         import copy as _copy
 
         from vizier_tpu.pyvizier import multimetric
 
         checker = multimetric.SafetyChecker(self.metrics_config)
-        warped = checker.warp_unsafe_trials(_copy.deepcopy(list(trials)))
+        # Deep-copy only what warping may mutate (the unsafe trials).
+        warped = [
+            t if checker.is_safe(t) else checker.warp_unsafe_trials([_copy.deepcopy(t)])[0]
+        for t in trials]
         return self.converter.convert(warped)
 
 
@@ -394,8 +399,8 @@ class RestartingCurveConverter:
                  restart_rate: float = 2.0):
         if restart_min_trials < 0:
             raise ValueError("restart_min_trials must be >= 0.")
-        if restart_rate < 1.0:
-            raise ValueError("restart_rate must be >= 1.")
+        if restart_rate <= 1.0:
+            raise ValueError("restart_rate must be > 1.")
         self._factory = converter_factory
         self._restart_min_trials = restart_min_trials
         self._restart_rate = restart_rate

@@ -68,18 +68,26 @@ def initialize_multihost(
 
     On TPU pods the arguments are auto-detected from the runtime
     environment and may be omitted.
+
+    MUST run before any JAX call that initializes the XLA backend
+    (including ``jax.devices()``): ``jax.distributed.initialize`` refuses
+    to run afterwards. Initialization state is checked via
+    ``jax.distributed.is_initialized`` — never by touching devices.
     """
-    if jax.process_count() == 1 and coordinator_address is not None:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    elif jax.process_count() == 1 and coordinator_address is None:
-        try:
-            jax.distributed.initialize()  # TPU-pod auto-detection
-        except Exception:
-            pass  # single-host: fall through to a local mesh
+    if not jax.distributed.is_initialized():
+        if coordinator_address is not None:
+            # Explicit cluster spec: failures must propagate — a silently
+            # absent cluster would shard per-host and corrupt results.
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            try:
+                jax.distributed.initialize()  # TPU-pod auto-detection
+            except Exception:
+                pass  # plain single host: fall through to a local mesh
     return create_mesh()
 
 
