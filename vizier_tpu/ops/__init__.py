@@ -1,0 +1,11 @@
+"""Batched XLA numerical ops (Pareto domination, hypervolume)."""
+
+from vizier_tpu.ops.pareto import (
+    crowding_distance,
+    cum_hypervolume_origin,
+    domination_matrix,
+    hypervolume,
+    is_frontier,
+    nondomination_layers,
+    pareto_rank,
+)

@@ -1,0 +1,22 @@
+"""ARD optimizers and vectorized acquisition optimizers."""
+
+from vizier_tpu.optimizers.base import BranchSelector, GradientFreeOptimizer
+from vizier_tpu.optimizers.eagle import (
+    EagleState,
+    EagleStrategyConfig,
+    VectorizedEagleStrategy,
+)
+from vizier_tpu.optimizers.lbfgs import (
+    DEFAULT_RANDOM_RESTARTS,
+    AdamOptimizer,
+    LbfgsOptimizer,
+    OptimizeResult,
+)
+from vizier_tpu.optimizers.lbfgsb_optimizer import DesignerAsOptimizer, LBFGSBOptimizer
+from vizier_tpu.optimizers.vectorized import (
+    RandomVectorizedStrategy,
+    VectorizedOptimizer,
+    VectorizedOptimizerResult,
+    VectorizedStrategy,
+    optimize_random,
+)

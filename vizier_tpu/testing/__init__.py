@@ -1,0 +1,16 @@
+"""Shared test fixtures, runners, and statistical comparison testers."""
+
+from vizier_tpu.testing.comparator_runner import (
+    EfficiencyComparisonTester,
+    FailedComparisonTestError,
+    SimpleRegretComparisonTester,
+)
+from vizier_tpu.testing.numpy_assertions import (
+    assert_arraytree_allclose,
+    assert_pytree_allclose,
+)
+from vizier_tpu.testing.simplekd_runner import (
+    ConvergenceTestError,
+    SimpleKDConvergenceTester,
+)
+from vizier_tpu.testing.test_runners import RandomMetricsRunner
