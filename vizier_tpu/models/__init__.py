@@ -1,0 +1,17 @@
+"""JAX stochastic-process models: GP, kernels, warpers, transfer learning."""
+
+from vizier_tpu.models.gp import (
+    EnsemblePredictive,
+    GPData,
+    GPState,
+    VizierGaussianProcess,
+)
+from vizier_tpu.models.kernels import MixedFeatures, matern52, matern52_ard
+from vizier_tpu.models.multitask_gp import MultiTaskGaussianProcess
+from vizier_tpu.models.output_warpers import (
+    WarperPipeline,
+    create_default_warper,
+    create_warp_outliers_warper,
+)
+from vizier_tpu.models.params import ParameterCollection, ParameterSpec, SoftClip
+from vizier_tpu.models.stacked_residual import StackedResidualGP

@@ -1,0 +1,14 @@
+"""Utilities: profiling, JSON codecs, serializable ABCs, validators."""
+
+from vizier_tpu.utils.json_utils import NumpyDecoder, NumpyEncoder
+from vizier_tpu.utils.profiler import (
+    collect_events,
+    record_runtime,
+    record_tracing,
+    timeit,
+)
+from vizier_tpu.utils.serializable import (
+    DecodeError,
+    PartiallySerializable,
+    Serializable,
+)
