@@ -1,0 +1,40 @@
+"""Ship gate: every vizier_tpu module must import.
+
+Walks the whole package with pkgutil so a facade typo (like round 2's
+``NumpyDecoder`` import of a nonexistent name) can never again make the
+package unimportable without failing CI at collection time.
+
+Modules gated on libraries absent from the image (pyglove, ray) are allowed
+to raise ImportError mentioning that library only.
+"""
+
+from __future__ import annotations
+
+import importlib
+import pkgutil
+
+import pytest
+
+import vizier_tpu
+
+# Modules whose import is honestly gated on a library absent from the image.
+_GATED_OK = ("pyglove", "ray")
+
+
+def _iter_module_names():
+    yield "vizier_tpu"
+    for mod in pkgutil.walk_packages(vizier_tpu.__path__, prefix="vizier_tpu."):
+        yield mod.name
+
+
+@pytest.mark.parametrize("name", sorted(set(_iter_module_names())))
+def test_module_imports(name: str) -> None:
+    try:
+        importlib.import_module(name)
+    except ImportError as e:
+        # Only a failure to import the gated library ITSELF is skippable —
+        # matching on the message would also match e.g. "PaddedArray".
+        missing = (getattr(e, "name", None) or "").split(".")[0]
+        if missing in _GATED_OK:
+            pytest.skip(f"gated on absent library: {e}")
+        raise

@@ -48,6 +48,7 @@ from vizier_tpu.designers import gp_bandit
 from vizier_tpu.designers.gp import acquisitions
 from vizier_tpu.models import gp as gp_lib
 from vizier_tpu.models import kernels
+from vizier_tpu.models import multitask_gp as mtgp
 from vizier_tpu.models import output_warpers
 from vizier_tpu.optimizers import eagle as eagle_lib
 from vizier_tpu.optimizers import vectorized as vectorized_lib
@@ -55,6 +56,10 @@ from vizier_tpu.pyvizier import base_study_config
 from vizier_tpu.pyvizier import trial as trial_
 
 Array = jax.Array
+
+# Re-export: `UCBPEConfig(multitask_type=MultiTaskType.SEPARABLE)` matches
+# the reference's `UCBPEConfig.multitask_type` (gp_ucb_pe.py:130-134).
+MultiTaskType = mtgp.MultiTaskType
 
 _PE_NOISE_STDDEV = 1e-5  # noise floor for the all-predictive in high noise
 
@@ -86,6 +91,11 @@ class UCBPEConfig:
     multimetric_promising_region_penalty_type: str = "average"
     # Random HV-scalarization directions for multimetric UCB.
     num_scalarizations: int = 1000
+    # Multimetric GP structure (reference ``UCBPEConfig.multitask_type``,
+    # ``gp_ucb_pe.py:130-134``): INDEPENDENT trains one GP per metric;
+    # SEPARABLE trains a single GP with a learned task-covariance B over a
+    # B ⊗ Kx Kronecker Gram, sharing statistical strength across metrics.
+    multitask_type: mtgp.MultiTaskType = mtgp.MultiTaskType.INDEPENDENT
 
     def __post_init__(self):
         if self.multimetric_promising_region_penalty_type not in (
@@ -97,6 +107,11 @@ class UCBPEConfig:
                 "multimetric_promising_region_penalty_type must be one of "
                 "'union' | 'intersection' | 'average', got "
                 f"{self.multimetric_promising_region_penalty_type!r}."
+            )
+        if not isinstance(self.multitask_type, mtgp.MultiTaskType):
+            raise ValueError(
+                f"multitask_type must be a MultiTaskType, got "
+                f"{self.multitask_type!r}."
             )
 
 
@@ -115,21 +130,46 @@ def _mixture_predict(
     return mean, jnp.sqrt(var)
 
 
+def _mt_mixture_predict(
+    states: "mtgp.MultiTaskGPState", query: kernels.MixedFeatures
+) -> Tuple[Array, Array]:
+    """Moment-matched mixture over the ensemble axis for a multitask state.
+
+    ``states``: MultiTaskGPState pytree with leading axis [E]; its
+    ``predict`` is per-task already. Returns ([M, Q] mean, [M, Q] stddev) —
+    the same contract as :func:`_mixture_predict`.
+    """
+    means, stddevs = jax.vmap(lambda s: s.predict(query))(states)  # [E, M, Q]
+    mean = jnp.mean(means, axis=0)
+    second = jnp.mean(stddevs**2 + means**2, axis=0)
+    var = jnp.maximum(second - mean**2, 1e-12)
+    return mean, jnp.sqrt(var)
+
+
 def _pe_conditioning(
-    states_completed: gp_lib.GPState,  # [M, E]
-    all_data: gp_lib.GPData,
+    states_completed,  # GPState [M, E] or MultiTaskGPState [E]
+    all_data,  # GPData or MultiTaskData
     config: UCBPEConfig,
+    *,
+    mixture=None,
+    base_data=None,
+    snr=None,
 ) -> Tuple[dict, Array, Array]:
     """(pe_params, noise_is_high, threshold[M]): shared UCB-PE conditioning.
 
     - High-noise detection: all ensemble members' signal/noise variance
       ratios below the config threshold → the all-points predictive gets a
       near-zero noise floor so pending points fully deflate local stddev.
+      ``snr`` overrides the default scalar amplitude²/noise² ratio (the
+      multitask path scales signal by the learned task covariance diag).
     - Promising-region threshold: completed-posterior mean at the
       argmax-UCB point among observed + pending features, per metric.
     """
-    params = states_completed.params  # constrained, [M, E] leaves
-    snr = (params["amplitude"] / params["noise_stddev"]) ** 2
+    mixture = mixture or _mixture_predict
+    base = base_data(all_data) if base_data is not None else all_data
+    params = states_completed.params  # constrained, [M, E] (or [E]) leaves
+    if snr is None:
+        snr = (params["amplitude"] / params["noise_stddev"]) ** 2
     noise_is_high = jnp.all(snr < config.signal_to_noise_threshold) & (
         config.signal_to_noise_threshold > 0.0
     )
@@ -137,10 +177,10 @@ def _pe_conditioning(
     pe_params["noise_stddev"] = jnp.where(
         noise_is_high, _PE_NOISE_STDDEV, params["noise_stddev"]
     )
-    all_pts = all_data.features()
-    mean_at, std_at = _mixture_predict(states_completed, all_pts)  # [M, N2]
+    all_pts = base.features()
+    mean_at, std_at = mixture(states_completed, all_pts)  # [M, N2]
     ucb_at = jnp.where(
-        all_data.row_mask[None, :],
+        base.row_mask[None, :],
         mean_at + config.ucb_coefficient * std_at,
         -jnp.inf,
     )
@@ -162,6 +202,19 @@ def _append_row(
         row_mask=data.row_mask.at[idx].set(True),
         cont_dim_mask=data.cont_dim_mask,
         cat_dim_mask=data.cat_dim_mask,
+    )
+
+
+def _append_row_mt(
+    data: "mtgp.MultiTaskData", x: kernels.MixedFeatures
+) -> "mtgp.MultiTaskData":
+    """Multitask pending-point append: every task observes the new row."""
+    fd = data.features_data
+    idx = jnp.sum(fd.row_mask.astype(jnp.int32))
+    return mtgp.MultiTaskData(
+        features_data=_append_row(fd, x),
+        task_labels=data.task_labels,
+        task_mask=data.task_mask.at[:, idx].set(True),
     )
 
 
@@ -208,10 +261,10 @@ def _scalarize_penalty(penalty: Array, mode: str) -> Array:
     ),
 )
 def _suggest_batch(
-    model: gp_lib.VizierGaussianProcess,
+    model,  # VizierGaussianProcess or MultiTaskGaussianProcess (static)
     vec_opt: vectorized_lib.VectorizedOptimizer,
-    states_completed: gp_lib.GPState,  # leading axes [M, E]
-    all_data: gp_lib.GPData,  # completed+active rows valid; labels 0
+    states_completed,  # GPState [M, E], or MultiTaskGPState [E]
+    all_data,  # GPData/MultiTaskData: completed+active rows valid; labels 0
     labels_mn: Array,  # [M, N1] warped labels of the completed data
     labels_mask: Array,  # [N1]
     ref_point: Array,  # [M]
@@ -226,12 +279,42 @@ def _suggest_batch(
     prior_acquisition=None,  # Callable[[MixedFeatures], [Q]-array] user prior
 ) -> Tuple[vectorized_lib.VectorizedOptimizerResult, dict]:
     """The greedy batch: per pick, UCB-or-PE with pending-point conditioning."""
-    dc = all_data.continuous.shape[-1]
-    ds = all_data.categorical.shape[-1]
+    # Static dispatch: the multitask (SEPARABLE) path swaps the posterior
+    # ops; every acquisition formula below is shared between the two.
+    is_mt = isinstance(model, mtgp.MultiTaskGaussianProcess)
+    if is_mt:
+        mixture = _mt_mixture_predict
+        base_data = lambda d: d.features_data  # noqa: E731
+        append = _append_row_mt
+        recondition = lambda p, d: jax.vmap(  # noqa: E731
+            lambda q: model.precompute_constrained(q, d)
+        )(p)
+        # Per-task signal variance is amplitude² · B[m,m] (what the MT
+        # posterior uses as prior variance), not amplitude² alone.
+        mt_p = states_completed.params  # [E] leaves
+        b_diag = jax.vmap(lambda q: jnp.diagonal(model._task_cov(q)))(mt_p)
+        mt_snr = (
+            (mt_p["amplitude"][:, None] ** 2)
+            * b_diag
+            / (mt_p["noise_stddev"][:, None] ** 2)
+        )  # [E, M]
+    else:
+        mixture = _mixture_predict
+        base_data = lambda d: d  # noqa: E731
+        append = _append_row
+        recondition = lambda p, d: jax.vmap(  # noqa: E731
+            jax.vmap(lambda q: model.precompute_constrained(q, d))
+        )(p)
+        mt_snr = None
+
+    dc = base_data(all_data).continuous.shape[-1]
+    ds = base_data(all_data).categorical.shape[-1]
     num_metrics = labels_mn.shape[0]
 
     trust = (
-        acquisitions.TrustRegion.from_data(all_data) if use_trust_region else None
+        acquisitions.TrustRegion.from_data(base_data(all_data))
+        if use_trust_region
+        else None
     )
 
     def pick(b, carry):
@@ -240,12 +323,11 @@ def _suggest_batch(
 
         # Shared conditioning, recomputed on the grown pending set.
         pe_params, noise_is_high, threshold = _pe_conditioning(
-            states_completed, all_data, config
+            states_completed, all_data, config,
+            mixture=mixture, base_data=base_data, snr=mt_snr,
         )
         # Re-condition the all-points posterior on the grown pending set.
-        states_all = jax.vmap(
-            jax.vmap(lambda p: model.precompute_constrained(p, all_data))
-        )(pe_params)
+        states_all = recondition(pe_params, all_data)
 
         # Pick-level UCB/PE decision (reference `_suggest_one` logic).
         pe_p = jnp.where(
@@ -268,8 +350,8 @@ def _suggest_batch(
         weights = weights / jnp.linalg.norm(weights, axis=-1, keepdims=True)
 
         def score_fn(query: kernels.MixedFeatures) -> Array:
-            mean_c, std_c = _mixture_predict(states_completed, query)  # [M, Q]
-            _, std_all = _mixture_predict(states_all, query)  # [M, Q]
+            mean_c, std_c = mixture(states_completed, query)  # [M, Q]
+            _, std_all = mixture(states_all, query)  # [M, Q]
             ucb_vals = mean_c + config.ucb_coefficient * std_all
             if num_metrics == 1:
                 ucb_score = ucb_vals[0]
@@ -310,9 +392,9 @@ def _suggest_batch(
         x = kernels.MixedFeatures(
             result.features.continuous[:1], result.features.categorical[:1]
         )
-        mean_x, std_x = _mixture_predict(states_completed, x)  # [M, 1]
-        _, std_all_x = _mixture_predict(states_all, x)
-        all_data = _append_row(all_data, x)
+        mean_x, std_x = mixture(states_completed, x)  # [M, 1]
+        _, std_all_x = mixture(states_all, x)
+        all_data = append(all_data, x)
         out_cont = out_cont.at[b].set(x.continuous[0])
         out_cat = out_cat.at[b].set(x.categorical[0])
         out_scores = out_scores.at[b].set(result.scores[0])
@@ -332,8 +414,8 @@ def _suggest_batch(
     )
     init = (
         all_data,
-        jnp.zeros((count, dc), all_data.continuous.dtype),
-        jnp.zeros((count, ds), all_data.categorical.dtype),
+        jnp.zeros((count, dc), base_data(all_data).continuous.dtype),
+        jnp.zeros((count, ds), base_data(all_data).categorical.dtype),
         jnp.zeros((count,), jnp.float32),
         init_aux,
         rng,
@@ -452,6 +534,37 @@ def _suggest_set_pe(
     )
 
 
+def _train_mt_gp(
+    model: mtgp.MultiTaskGaussianProcess,
+    optimizer,
+    data: mtgp.MultiTaskData,
+    rng: Array,
+    num_restarts: int,
+    ensemble_size: int,
+) -> mtgp.MultiTaskGPState:
+    """Joint multitask ARD: restarts → L-BFGS → top-k posteriors ([E])."""
+    coll = model.param_collection()
+    inits = coll.batch_random_init_unconstrained(rng, num_restarts)
+    loss_fn = lambda p: model.neg_log_likelihood(p, data)  # noqa: E731
+    result = optimizer(loss_fn, inits, best_n=ensemble_size)
+    return jax.vmap(lambda p: model.precompute(p, data))(result.params)
+
+
+class _MetricZeroMTPredictive:
+    """Duck-typed ``.predict`` over the FIRST metric of a multitask state.
+
+    Mirrors what the independent path exposes via ``EnsemblePredictive``
+    (metric 0 only) so ``predict``/``sample`` keep one contract.
+    """
+
+    def __init__(self, states: mtgp.MultiTaskGPState):
+        self._states = states
+
+    def predict(self, query: kernels.MixedFeatures) -> Tuple[Array, Array]:
+        mean, std = _mt_mixture_predict(self._states, query)
+        return mean[0], std[0]
+
+
 @dataclasses.dataclass
 class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
     """GP-UCB-PE batch designer (service DEFAULT)."""
@@ -521,7 +634,7 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         raw = conv.metrics.encode(self._trials)  # [N, M_all], all-MAXIMIZE
         features, n_pad = self._padded_features(self._trials)
         ensemble = max(self.ensemble_size, 1)
-        datas, states_list = [], []
+        datas = []
         self._metric_warpers = []
         self._warpers_fitted = raw.shape[0] > 0
         for j in self._objective_indices():
@@ -532,13 +645,51 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
                 types.ModelData(features, self._padded_labels(warped, n_pad))
             )
             datas.append(data)
-            # Mesh-aware: restarts shard over devices when a mesh is present.
-            states_list.append(self._train(data, self._next_rng(), ensemble))
+        if self._use_multitask(len(datas)):
+            # One joint GP: learned task covariance over a B ⊗ Kx Gram.
+            mt_model = self._mt_model(len(datas))
+            mt_data = mtgp.MultiTaskData.from_gp_datas(tuple(datas))
+            if self._mesh is None:
+                states = _train_mt_gp(
+                    mt_model, self._ard, mt_data, self._next_rng(),
+                    self.ard_restarts, ensemble,
+                )
+            else:
+                # Same restart sharding as the independent path — the
+                # sharded trainer is model-agnostic (duck-typed
+                # param_collection / neg_log_likelihood / precompute).
+                from vizier_tpu import parallel
+
+                ndev = self._mesh_size()
+                restarts = -(-self.ard_restarts // ndev) * ndev
+                states = parallel.train_gp_sharded(
+                    mt_model, self._ard, mt_data, self._next_rng(),
+                    restarts, ensemble, self._mesh,
+                )
+            self._cached_states = (states, datas)
+            return self._cached_states
+        # Mesh-aware: restarts shard over devices when a mesh is present.
+        states_list = [
+            self._train(data, self._next_rng(), ensemble) for data in datas
+        ]
         states_me = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *states_list
         )
         self._cached_states = (states_me, datas)
         return self._cached_states
+
+    def _use_multitask(self, num_metrics: int) -> bool:
+        return (
+            self.config.multitask_type is mtgp.MultiTaskType.SEPARABLE
+            and num_metrics > 1
+        )
+
+    def _mt_model(self, num_metrics: int) -> mtgp.MultiTaskGaussianProcess:
+        return mtgp.MultiTaskGaussianProcess(
+            num_continuous=self._model.num_continuous,
+            num_categorical=self._model.num_categorical,
+            num_tasks=num_metrics,
+        )
 
     def _all_points_data(self, count: int) -> gp_lib.GPData:
         """GPData over completed+active rows with capacity for the picks."""
@@ -565,9 +716,13 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             return self._suggest_with_priors(count)
 
         states_me, datas = self._train_states_me()
-        self._last_predictive = gp_lib.EnsemblePredictive(
-            jax.tree_util.tree_map(lambda a: a[0], states_me)
-        )
+        is_mt = isinstance(states_me, mtgp.MultiTaskGPState)
+        if is_mt:
+            self._last_predictive = _MetricZeroMTPredictive(states_me)
+        else:
+            self._last_predictive = gp_lib.EnsemblePredictive(
+                jax.tree_util.tree_map(lambda a: a[0], states_me)
+            )
         all_data = self._all_points_data(count)
         num_metrics = len(datas)
         if num_metrics > 1 and self.config.optimize_set_acquisition_for_exploration:
@@ -593,8 +748,19 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
                 first_has_new, has_completed, datas,
             )
 
+        if is_mt:
+            model = self._mt_model(num_metrics)
+            all_data = mtgp.MultiTaskData(
+                features_data=all_data,
+                task_labels=jnp.zeros(
+                    (num_metrics,) + all_data.labels.shape, jnp.float32
+                ),
+                task_mask=jnp.tile(all_data.row_mask[None, :], (num_metrics, 1)),
+            )
+        else:
+            model = self._model
         batch, aux = _suggest_batch(
-            self._model,
+            model,
             self._vec_opt,
             states_me,
             all_data,
@@ -722,7 +888,10 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             return np.zeros((num_samples, 0))
         states_me, _ = self._train_states_me()
         feats = self._encode_suggestions(suggestions)
-        mean, stddev = _mixture_predict(states_me, feats)  # [M, T]
+        if isinstance(states_me, mtgp.MultiTaskGPState):
+            mean, stddev = _mt_mixture_predict(states_me, feats)  # [M, T]
+        else:
+            mean, stddev = _mixture_predict(states_me, feats)  # [M, T]
         eps = jax.random.normal(rng, (num_samples,) + mean.shape, mean.dtype)
         warped = np.asarray(mean[None] + stddev[None] * eps)  # [S, M, T]
         if not self._warpers_fitted:

@@ -128,7 +128,13 @@ class MultiTaskGaussianProcess:
     def precompute(
         self, unconstrained: params_lib.Params, data: MultiTaskData
     ) -> "MultiTaskGPState":
-        p = self.param_collection().constrain(unconstrained)
+        return self.precompute_constrained(
+            self.param_collection().constrain(unconstrained), data
+        )
+
+    def precompute_constrained(
+        self, p: params_lib.Params, data: MultiTaskData
+    ) -> "MultiTaskGPState":
         gram = self._joint_gram(p, data)
         y = jnp.where(data.task_mask, data.task_labels, 0.0).reshape(-1)
         chol = jnp.linalg.cholesky(gram)

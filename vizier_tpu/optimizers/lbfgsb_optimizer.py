@@ -84,6 +84,7 @@ class DesignerAsOptimizer:
         problem,
         *,
         count: int = 1,
+        score_fn_returns_dict: bool | None = None,
     ):
         """Runs a mini-study of the score function driven by the designer.
 
@@ -93,21 +94,51 @@ class DesignerAsOptimizer:
         ``BatchTrialScoreFunction`` (``optimizers/base.py:34``) — a mapping
         of metric name to an [N] / [N, 1] array, in which case the caller's
         own metric goals rank the results (Pareto front for multi-metric).
+        Pass ``score_fn_returns_dict`` to skip the classification probe.
         """
         import numpy as np
 
         from vizier_tpu.algorithms import core as core_lib
+        from vizier_tpu.designers import random as random_lib
         from vizier_tpu.pyvizier import base_study_config
         from vizier_tpu.pyvizier import multimetric
         from vizier_tpu.pyvizier import trial as trial_
 
-        try:
-            dict_scores = isinstance(score_fn([]), dict)
-        except Exception:
-            # score_fn can't take an empty batch; reference-style dict fns
-            # are the norm when the caller's problem carries metric configs.
-            dict_scores = bool(problem.metric_information)
-        if dict_scores and problem.metric_information:
+        probe_scored = None
+        if score_fn_returns_dict is not None:
+            dict_scores = score_fn_returns_dict
+        else:
+            # Classify from a real single-suggestion batch: an empty-batch
+            # probe misclassifies list-style fns that can't handle []. The
+            # evaluation is kept as a ranked candidate so it isn't wasted.
+            try:
+                probe = random_lib.RandomDesigner(
+                    problem.search_space, seed=0
+                ).suggest(1)
+                values = score_fn(probe)
+                dict_scores = isinstance(values, dict)
+                if dict_scores:
+                    probe_metrics = {
+                        k: float(np.asarray(v[0]).reshape(()))
+                        for k, v in values.items()
+                    }
+                else:
+                    probe_metrics = {"acquisition": float(values[0])}
+                probe_scored = (probe_metrics, probe[0])
+            except Exception:
+                # score_fn can't take the 1-row probe (e.g. specialized to
+                # the round batch shape): fall back to the problem-shape
+                # heuristic. Shape-specialized callers should pass
+                # score_fn_returns_dict explicitly.
+                dict_scores = bool(problem.metric_information)
+                probe_scored = None
+        if dict_scores and not problem.metric_information:
+            raise ValueError(
+                "A dict-returning score_fn needs problem.metric_information "
+                "to rank its metrics; pass a problem with metrics or a "
+                "sequence-returning score_fn."
+            )
+        if dict_scores:
             metric_goals = {
                 m.name: m.goal for m in problem.metric_information
             }
@@ -131,7 +162,11 @@ class DesignerAsOptimizer:
                 ),
             )
         designer = self.designer_factory(inner_problem)
-        scored = []  # (metrics_dict, suggestion)
+        # Drop the probe if its metric keys don't cover the ranking metrics
+        # (dict-style score_fn with an empty metric_information problem).
+        if probe_scored is not None and not set(metric_goals) <= set(probe_scored[0]):
+            probe_scored = None
+        scored = [probe_scored] if probe_scored is not None else []
         next_id = 1
         for _ in range(self.num_rounds):
             suggestions = designer.suggest(self.batch_size)

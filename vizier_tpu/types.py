@@ -67,7 +67,7 @@ class PaddedArray:
         on_host = not isinstance(array, jax.Array)
         xp = np if on_host else jnp
         array = xp.asarray(array)
-        if on_host:
+        if on_host and not jax.config.jax_enable_x64:
             # Mirror jax's x64-disabled canonicalization: a float64/int64
             # host buffer would otherwise key a second jit-cache entry per
             # dtype downstream (the exact retrace this host path avoids).

@@ -1,6 +1,6 @@
 """Utilities: profiling, JSON codecs, serializable ABCs, validators."""
 
-from vizier_tpu.utils.json_utils import NumpyDecoder, NumpyEncoder
+from vizier_tpu.utils.json_utils import NumpyDecoder, NumpyEncoder, dumps, loads
 from vizier_tpu.utils.profiler import (
     collect_events,
     record_runtime,

@@ -45,6 +45,14 @@ def _object_hook(d: dict) -> Any:
     return d
 
 
+class NumpyDecoder(json.JSONDecoder):
+    """Inverse of :class:`NumpyEncoder` (``json.loads(s, cls=NumpyDecoder)``)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("object_hook", _object_hook)
+        super().__init__(**kwargs)
+
+
 def dumps(obj: Any) -> str:
     return json.dumps(obj, cls=NumpyEncoder)
 
