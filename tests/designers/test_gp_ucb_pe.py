@@ -471,3 +471,56 @@ class TestPriorAcquisition:
         assert len(batch) == 3
         for s in batch:
             assert s.parameters["x"].value > 0.8, s.parameters.as_dict()
+
+
+class TestAcquisitionBudgetPolicy:
+    """Batch budget semantics (TPU-first default: one sweep's evaluations
+    per suggest() call, split across picks; per_pick = reference behavior,
+    75k per pick, ref gp_ucb_pe.py:693-697,1440-1446)."""
+
+    def test_default_splits_budget_across_batch(self):
+        problem = _single_metric_problem()
+        d = _designer(problem, max_acquisition_evaluations=75_000)
+        assert d.acquisition_budget_policy == "per_batch"
+        assert d._pick_vec_opt(25).max_evaluations == 3_000
+        # Single pick keeps the full budget.
+        assert d._pick_vec_opt(1).max_evaluations == 75_000
+
+    def test_split_budget_floors_at_minimum(self):
+        from vizier_tpu.designers import gp_ucb_pe as mod
+
+        problem = _single_metric_problem()
+        d = _designer(problem, max_acquisition_evaluations=1_000)
+        assert d._pick_vec_opt(25).max_evaluations == mod._MIN_PICK_EVALUATIONS
+
+    def test_per_pick_policy_uses_full_budget(self):
+        problem = _single_metric_problem()
+        d = _designer(
+            problem,
+            max_acquisition_evaluations=75_000,
+            acquisition_budget_policy="per_pick",
+        )
+        assert d._pick_vec_opt(25) is d._vec_opt
+        assert d._pick_vec_opt(25).max_evaluations == 75_000
+
+    def test_invalid_policy_rejected(self):
+        problem = _single_metric_problem()
+        with pytest.raises(ValueError, match="acquisition_budget_policy"):
+            _designer(problem, acquisition_budget_policy="bogus")
+
+    def test_pick_opt_cache_reuses_instances(self):
+        problem = _single_metric_problem()
+        d = _designer(problem, max_acquisition_evaluations=75_000)
+        assert d._pick_vec_opt(25) is d._pick_vec_opt(25)
+
+    def test_batch_suggest_runs_under_split_budget(self):
+        problem = _single_metric_problem()
+        d = _designer(problem, max_acquisition_evaluations=600, num_seed_trials=1)
+        trials = _complete(
+            problem,
+            np.random.default_rng(0).uniform(size=5),
+            lambda x: {"obj": -((x - 0.5) ** 2)},
+        )
+        d.update(core_lib.CompletedTrials(trials))
+        batch = d.suggest(4)
+        assert len(batch) == 4

@@ -82,9 +82,18 @@ class TestMultitaskConfig:
 
 
 class TestMultitaskSuggest:
-    def test_separable_trains_joint_state(self):
+    @pytest.mark.parametrize(
+        "variant",
+        [
+            MultiTaskType.SEPARABLE,
+            MultiTaskType.SEPARABLE_LKJ,
+            MultiTaskType.SEPARABLE_DIAG,
+        ],
+    )
+    def test_variant_trains_joint_state_and_suggests(self, variant):
+        """Each SEPARABLE variant drives the full designer loop."""
         problem = _two_metric_problem()
-        d = _designer(problem, MultiTaskType.SEPARABLE)
+        d = _designer(problem, variant)
         _run(
             d,
             lambda xs: {
@@ -103,11 +112,10 @@ class TestMultitaskSuggest:
             for di in range(3):
                 assert 0.0 <= s.parameters.get_value(f"x{di}") <= 1.0
 
-    def test_correlated_metrics_learn_task_coupling(self):
-        """Two strongly correlated metrics → learned B has positive
-        off-diagonal correlation."""
+    def _learned_task_corr(self, multitask_type, metric_fn, seed=3):
+        """Fits the joint GP on 12 random trials; returns B's correlation."""
         problem = _two_metric_problem()
-        d = _designer(problem, MultiTaskType.SEPARABLE, seed=3)
+        d = _designer(problem, multitask_type, seed=seed)
         rng = np.random.default_rng(0)
         trials = []
         for i in range(12):
@@ -118,7 +126,7 @@ class TestMultitaskSuggest:
             base = float(-np.sum((xs - 0.5) ** 2))
             t.complete(
                 vz.Measurement(
-                    metrics={"m1": base, "m2": 0.9 * base + 0.01 * rng.normal()}
+                    metrics=metric_fn(base, float(rng.normal()))
                 )
             )
             trials.append(t)
@@ -128,8 +136,51 @@ class TestMultitaskSuggest:
         # Best ensemble member's constrained params → task covariance.
         p0 = {k: v[0] for k, v in states.params.items()}
         b = np.asarray(model._task_cov(p0))
-        corr = b[0, 1] / np.sqrt(b[0, 0] * b[1, 1])
+        return b[0, 1] / np.sqrt(b[0, 0] * b[1, 1])
+
+    def test_correlated_metrics_learn_task_coupling(self):
+        """Two strongly correlated metrics → learned B has positive
+        off-diagonal correlation."""
+        corr = self._learned_task_corr(
+            MultiTaskType.SEPARABLE,
+            lambda base, eps: {"m1": base, "m2": 0.9 * base + 0.01 * eps},
+        )
         assert corr > 0.1, f"correlated tasks should couple, got corr={corr:.3f}"
+
+    def test_anticorrelated_metrics_learn_negative_coupling(self):
+        """Anti-correlated metrics (the multi-objective trade-off case) must
+        learn a NEGATIVE task correlation — requires the signed off-diagonal
+        Cholesky parameterization (reference signed Normal prior,
+        multitask_tuned_gp_models.py:144-151)."""
+        corr = self._learned_task_corr(
+            MultiTaskType.SEPARABLE,
+            lambda base, eps: {"m1": base, "m2": -0.9 * base + 0.01 * eps},
+        )
+        assert corr < -0.1, (
+            f"anti-correlated tasks should couple negatively, got corr={corr:.3f}"
+        )
+
+    def test_lkj_learns_signed_coupling(self):
+        corr_pos = self._learned_task_corr(
+            MultiTaskType.SEPARABLE_LKJ,
+            lambda base, eps: {"m1": base, "m2": 0.9 * base + 0.01 * eps},
+        )
+        corr_neg = self._learned_task_corr(
+            MultiTaskType.SEPARABLE_LKJ,
+            lambda base, eps: {"m1": base, "m2": -0.9 * base + 0.01 * eps},
+        )
+        assert corr_pos > 0.1, f"LKJ positive coupling, got {corr_pos:.3f}"
+        assert corr_neg < -0.1, f"LKJ negative coupling, got {corr_neg:.3f}"
+
+    def test_diag_has_no_cross_task_coupling(self):
+        corr = self._learned_task_corr(
+            MultiTaskType.SEPARABLE_DIAG,
+            lambda base, eps: {"m1": base, "m2": 0.9 * base + 0.01 * eps},
+        )
+        assert abs(corr) < 0.05, f"DIAG B must be diagonal, got corr={corr:.3f}"
+
+    def test_separable_normal_is_alias(self):
+        assert MultiTaskType.SEPARABLE_NORMAL is MultiTaskType.SEPARABLE
 
     def test_predict_and_sample_shapes(self):
         problem = _two_metric_problem()
@@ -190,11 +241,18 @@ class TestMultitaskZDT1Quality:
             ).convert(trials)
             return float(curve.ys[0, -1])
 
-        hv_sep = final_hv(MultiTaskType.SEPARABLE, seed=1)
-        hv_ind = final_hv(MultiTaskType.INDEPENDENT, seed=1)
-        assert hv_sep > 0.0, "separable run must dominate the reference point"
-        # Statistical band, not superiority: equal-budget HV within 40% of
-        # the independent default (single seed; a hard gate would be flaky).
+        # Averaged over seeds so one unlucky ARD fit can neither trip the
+        # gate spuriously nor hide a real collapse.
+        seeds = (1, 2)
+        hv_sep = float(
+            np.mean([final_hv(MultiTaskType.SEPARABLE, seed=s) for s in seeds])
+        )
+        hv_ind = float(
+            np.mean([final_hv(MultiTaskType.INDEPENDENT, seed=s) for s in seeds])
+        )
+        assert hv_sep > 0.0, "separable runs must dominate the reference point"
+        # Statistical band, not superiority: equal-budget mean HV within 40%
+        # of the independent default.
         assert hv_sep >= 0.6 * hv_ind, (
             f"separable HV {hv_sep:.3f} collapsed vs independent {hv_ind:.3f}"
         )

@@ -61,6 +61,12 @@ class ParameterSpec:
     restarts. ``prior_mu/sigma``: log-normal regularizer
     0.5*((log(v) - mu)/sigma)^2 summed over elements (the reference's
     log-squared regularizers, ``tuned_gp_models.py:132-220``).
+
+    ``linear=True`` switches to linear-space sampling and a plain Gaussian
+    regularizer 0.5*((v - mu)/sigma)^2 — required for SIGNED parameters
+    (e.g. task-covariance Cholesky off-diagonals, which must be able to
+    learn negative task correlations; reference
+    ``multitask_tuned_gp_models.py:144-151`` uses signed Normal priors).
     """
 
     name: str
@@ -70,14 +76,25 @@ class ParameterSpec:
     init_high: float
     prior_mu: float = 0.0
     prior_sigma: float = 1.0
+    linear: bool = False
+    # False = no per-spec prior penalty (e.g. reference Uniform priors, or
+    # parameters whose prior lives in a model-level term instead).
+    regularize: bool = True
 
     def sample_constrained(self, rng: Array) -> Array:
-        lo, hi = np.log(self.init_low), np.log(self.init_high)
         u = jax.random.uniform(rng, self.shape, dtype=jnp.float32)
+        if self.linear:
+            return self.init_low + (self.init_high - self.init_low) * u
+        lo, hi = np.log(self.init_low), np.log(self.init_high)
         return jnp.exp(lo + (hi - lo) * u)
 
     def regularizer(self, constrained_value: Array) -> Array:
-        z = (jnp.log(constrained_value) - self.prior_mu) / self.prior_sigma
+        if not self.regularize:
+            return jnp.asarray(0.0, jnp.float32)
+        if self.linear:
+            z = (constrained_value - self.prior_mu) / self.prior_sigma
+        else:
+            z = (jnp.log(constrained_value) - self.prior_mu) / self.prior_sigma
         return 0.5 * jnp.sum(z * z)
 
 

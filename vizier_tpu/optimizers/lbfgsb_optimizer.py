@@ -110,7 +110,10 @@ class DesignerAsOptimizer:
         else:
             # Classify from a real single-suggestion batch: an empty-batch
             # probe misclassifies list-style fns that can't handle []. The
-            # evaluation is kept as a ranked candidate so it isn't wasted.
+            # evaluation is kept as a ranked candidate so it isn't wasted
+            # (auto-classification costs this one probe evaluation; callers
+            # with expensive/stateful score functions can pass
+            # score_fn_returns_dict to skip it).
             try:
                 probe = random_lib.RandomDesigner(
                     problem.search_space, seed=0
@@ -125,11 +128,29 @@ class DesignerAsOptimizer:
                 else:
                     probe_metrics = {"acquisition": float(values[0])}
                 probe_scored = (probe_metrics, probe[0])
-            except Exception:
-                # score_fn can't take the 1-row probe (e.g. specialized to
-                # the round batch shape): fall back to the problem-shape
-                # heuristic. Shape-specialized callers should pass
-                # score_fn_returns_dict explicitly.
+            except (
+                TypeError,
+                ValueError,
+                IndexError,
+                KeyError,
+                AssertionError,
+                RuntimeError,  # includes jaxlib XlaRuntimeError
+            ) as e:
+                # Shape/arity-style failures mean "score_fn can't take the
+                # 1-row probe" (jit-specialized callables raise TypeError/
+                # ValueError/XlaRuntimeError; hand-guarded ones assert):
+                # fall back to the problem-shape heuristic, loudly. Anything
+                # else (a genuine score_fn bug) propagates to the caller
+                # instead of being silently reclassified. Shape-specialized
+                # callers should pass score_fn_returns_dict explicitly.
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "DesignerAsOptimizer probe evaluation failed (%s: %s); "
+                    "classifying score_fn from problem.metric_information.",
+                    type(e).__name__,
+                    e,
+                )
                 dict_scores = bool(problem.metric_information)
                 probe_scored = None
         if dict_scores and not problem.metric_information:
