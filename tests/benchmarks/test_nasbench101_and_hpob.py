@@ -1,0 +1,254 @@
+"""NASBench-101 graph encoding + real HPO-B v3 layout (VERDICT r3 #3/#4).
+
+Both are data-gated in production; these tests drive the encoding/parsing
+logic on synthetic fixtures: the NASBench-101 trial→spec→prune→hash path
+and the HPO-B split semantics / discrete evaluation protocol.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.benchmarks.experimenters import nasbench101 as nb
+from vizier_tpu.benchmarks.experimenters.surrogates import HPOBHandler
+
+
+def _spec_to_params(spec: nb.ModelSpec) -> dict:
+    params = {}
+    for y in range(nb.NUM_VERTICES):
+        for x in range(nb.NUM_VERTICES):
+            if y > x:
+                params[f"{x}_{y}"] = str(bool(spec.matrix[x, y]))
+    for i in range(nb.OP_SPOTS):
+        params[f"ops_{i}"] = spec.ops[i + 1]
+    return params
+
+
+class TestModelSpec:
+    def test_rejects_non_dag(self):
+        m = np.zeros((3, 3), dtype=int)
+        m[2, 0] = 1  # lower-triangular edge
+        with pytest.raises(ValueError, match="upper-triangular"):
+            nb.ModelSpec(matrix=m, ops=[nb.INPUT_OP, "conv3x3-bn-relu", nb.OUTPUT_OP])
+
+    def test_prune_removes_dangling_vertices(self):
+        # 0 -> 1 -> 3 with vertex 2 dangling (no path to output).
+        m = np.zeros((4, 4), dtype=int)
+        m[0, 1] = m[1, 3] = 1
+        m[0, 2] = 1  # 2 reaches nothing
+        spec = nb.ModelSpec(
+            matrix=m,
+            ops=[nb.INPUT_OP, "conv3x3-bn-relu", "maxpool3x3", nb.OUTPUT_OP],
+        )
+        pruned = spec.pruned()
+        assert pruned.matrix.shape == (3, 3)
+        assert pruned.ops == [nb.INPUT_OP, "conv3x3-bn-relu", nb.OUTPUT_OP]
+
+    def test_disconnected_graph_prunes_to_none(self):
+        m = np.zeros((3, 3), dtype=int)  # no edges at all
+        spec = nb.ModelSpec(
+            matrix=m, ops=[nb.INPUT_OP, "conv3x3-bn-relu", nb.OUTPUT_OP]
+        )
+        assert spec.pruned() is None
+        assert spec.graph_hash() == "invalid"
+
+    def test_hash_invariant_under_vertex_relabeling(self):
+        """Two labelings of the same computation graph hash identically."""
+        # Graph A: 0->1->3, 0->2->3 with ops conv3x3 at 1, maxpool at 2.
+        m1 = np.zeros((4, 4), dtype=int)
+        m1[0, 1] = m1[1, 3] = m1[0, 2] = m1[2, 3] = 1
+        s1 = nb.ModelSpec(
+            matrix=m1,
+            ops=[nb.INPUT_OP, "conv3x3-bn-relu", "maxpool3x3", nb.OUTPUT_OP],
+        )
+        # Graph B: identical but with the two interior vertices swapped.
+        m2 = np.zeros((4, 4), dtype=int)
+        m2[0, 1] = m2[1, 3] = m2[0, 2] = m2[2, 3] = 1
+        s2 = nb.ModelSpec(
+            matrix=m2,
+            ops=[nb.INPUT_OP, "maxpool3x3", "conv3x3-bn-relu", nb.OUTPUT_OP],
+        )
+        assert s1.graph_hash() == s2.graph_hash()
+
+    def test_hash_distinguishes_ops(self):
+        m = np.zeros((3, 3), dtype=int)
+        m[0, 1] = m[1, 2] = 1
+        a = nb.ModelSpec(matrix=m, ops=[nb.INPUT_OP, "conv3x3-bn-relu", nb.OUTPUT_OP])
+        b = nb.ModelSpec(matrix=m, ops=[nb.INPUT_OP, "maxpool3x3", nb.OUTPUT_OP])
+        assert a.graph_hash() != b.graph_hash()
+
+    def test_hash_ignores_pruned_vertices(self):
+        """A dangling vertex must not change the hash (it prunes away)."""
+        m1 = np.zeros((3, 3), dtype=int)
+        m1[0, 1] = m1[1, 2] = 1
+        core = nb.ModelSpec(
+            matrix=m1, ops=[nb.INPUT_OP, "conv3x3-bn-relu", nb.OUTPUT_OP]
+        )
+        m2 = np.zeros((4, 4), dtype=int)
+        m2[0, 1] = m2[1, 3] = 1
+        m2[0, 2] = 1  # dangling
+        padded = nb.ModelSpec(
+            matrix=m2,
+            ops=[nb.INPUT_OP, "conv3x3-bn-relu", "maxpool3x3", nb.OUTPUT_OP],
+        )
+        assert core.graph_hash() == padded.graph_hash()
+
+
+class TestNASBench101Experimenter:
+    def test_problem_statement_shape(self):
+        api, _ = nb.synthetic_nasbench101(num_cells=4)
+        problem = nb.NASBench101Experimenter(api).problem_statement()
+        # 21 bools + 5 op spots.
+        assert problem.search_space.num_parameters() == 26
+        assert problem.metric_information.item().name == "validation_accuracy"
+
+    def test_valid_cell_completes_with_all_metrics(self):
+        api, specs = nb.synthetic_nasbench101(num_cells=8)
+        exp = nb.NASBench101Experimenter(api)
+        t = vz.Trial(id=1, parameters=_spec_to_params(specs[0]))
+        exp.evaluate([t])
+        assert not t.infeasible
+        for name in nb.METRIC_NAMES:
+            assert name in t.final_measurement.metrics
+
+    def test_invalid_cell_is_infeasible(self):
+        api, _ = nb.synthetic_nasbench101(num_cells=4)
+        exp = nb.NASBench101Experimenter(api)
+        empty = nb.ModelSpec(
+            matrix=np.zeros((nb.NUM_VERTICES, nb.NUM_VERTICES), dtype=int),
+            ops=[nb.INPUT_OP]
+            + ["conv3x3-bn-relu"] * nb.OP_SPOTS
+            + [nb.OUTPUT_OP],
+        )
+        t = vz.Trial(id=1, parameters=_spec_to_params(empty))
+        exp.evaluate([t])
+        assert t.infeasible
+
+    def test_edge_budget_enforced(self):
+        api, _ = nb.synthetic_nasbench101(num_cells=4)
+        dense = nb.ModelSpec(
+            matrix=np.triu(np.ones((nb.NUM_VERTICES, nb.NUM_VERTICES), int), 1),
+            ops=[nb.INPUT_OP]
+            + ["conv3x3-bn-relu"] * nb.OP_SPOTS
+            + [nb.OUTPUT_OP],
+        )
+        assert dense.matrix.sum() > nb.MAX_EDGES
+        assert not api.is_valid(dense)
+
+    def test_designer_runs_on_nasbench_space(self):
+        """The conditional-free mixed bool/categorical space drives a real
+        suggest→evaluate loop (random designer: the space is all-discrete)."""
+        from vizier_tpu.algorithms import core as core_lib
+        from vizier_tpu.designers import RandomDesigner
+
+        api, _ = nb.synthetic_nasbench101(num_cells=16)
+        exp = nb.NASBench101Experimenter(api)
+        problem = exp.problem_statement()
+        designer = RandomDesigner(problem.search_space, seed=1)
+        feasible = 0
+        for i in range(10):
+            trials = [s.to_trial(i + 1) for s in designer.suggest(1)]
+            exp.evaluate(trials)
+            feasible += sum(not t.infeasible for t in trials)
+            designer.update(core_lib.CompletedTrials(trials))
+        # Random 35%-density DAGs rarely match the tiny synthetic table;
+        # what matters is every trial completes one way or the other.
+        assert feasible >= 0
+
+
+@pytest.fixture
+def hpob_root(tmp_path):
+    """A miniature but layout-faithful HPO-B dump."""
+    xs = [[0.1, 0.2], [0.4, 0.5], [0.9, 0.1], [0.3, 0.8], [0.6, 0.6], [0.2, 0.9]]
+    ys = [[1.0], [3.0], [2.0], [5.0], [4.0], [0.5]]
+    test = {"5860": {"145833": {"X": xs, "y": ys}}}
+    train = {"5860": {"300": {"X": xs[:3], "y": ys[:3]}}}
+    train_aug = {"5860": {"300aug": {"X": xs[:4], "y": ys[:4]}}}
+    valid = {"5860": {"400": {"X": xs[1:4], "y": ys[1:4]}}}
+    inits = {"5860": {"145833": {s: [0, 1, 2, 3, 4] for s in HPOBHandler.SEEDS}}}
+    (tmp_path / "meta-test-dataset.json").write_text(json.dumps(test))
+    (tmp_path / "meta-train-dataset.json").write_text(json.dumps(train))
+    (tmp_path / "meta-train-dataset-augmented.json").write_text(
+        json.dumps(train_aug)
+    )
+    (tmp_path / "meta-validation-dataset.json").write_text(json.dumps(valid))
+    (tmp_path / "bo-initializations.json").write_text(json.dumps(inits))
+    return str(tmp_path)
+
+
+class TestHPOBHandler:
+    def test_v3_test_loads_only_test_split(self, hpob_root):
+        h = HPOBHandler(root_dir=hpob_root, mode="v3-test")
+        h._ensure_loaded()
+        assert "145833" in h.meta_test_data["5860"]
+        assert h.meta_train_data == {}
+
+    def test_v3_loads_all_splits(self, hpob_root):
+        h = HPOBHandler(root_dir=hpob_root, mode="v3")
+        h._ensure_loaded()
+        assert "300" in h.meta_train_data["5860"]
+        assert "400" in h.meta_validation_data["5860"]
+        assert "145833" in h.meta_test_data["5860"]
+
+    def test_v3_train_augmented_uses_augmented_file(self, hpob_root):
+        h = HPOBHandler(root_dir=hpob_root, mode="v3-train-augmented")
+        h._ensure_loaded()
+        assert "300aug" in h.meta_train_data["5860"]
+
+    def test_v1_merges_splits_into_test(self, hpob_root):
+        h = HPOBHandler(root_dir=hpob_root, mode="v1")
+        h._ensure_loaded()
+        # v1: augmented train merged with test+validation per search space.
+        merged = h.meta_test_data["5860"]
+        assert {"300aug", "145833", "400"} <= set(merged)
+
+    def test_evaluate_discrete_protocol(self, hpob_root):
+        h = HPOBHandler(root_dir=hpob_root, mode="v3-test")
+
+        class GreedyNearBest:
+            def observe_and_suggest(self, x_obs, y_obs, x_pen):
+                # Always pick the first pending candidate.
+                assert x_obs.shape[1] == x_pen.shape[1] == 2
+                return 0
+
+        history = h.evaluate(
+            GreedyNearBest(),
+            search_space_id="5860",
+            dataset_id="145833",
+            seed="test0",
+            n_trials=1,
+        )
+        # Initial 5 points include the y-max (5.0 -> normalized 1.0).
+        assert len(history) == 2
+        assert history[0] == pytest.approx(1.0)
+        assert history[-1] >= history[0]
+
+    def test_evaluate_requires_protocol_method(self, hpob_root):
+        h = HPOBHandler(root_dir=hpob_root)
+        with pytest.raises(ValueError, match="observe_and_suggest"):
+            h.evaluate(object(), "5860", "145833", "test0")
+
+    def test_seeds_match_published_names(self):
+        assert HPOBHandler().get_seeds() == [
+            "test0", "test1", "test2", "test3", "test4",
+        ]
+
+    def test_make_experimenter_serves_table(self, hpob_root):
+        h = HPOBHandler(root_dir=hpob_root, mode="v3-test")
+        exp = h.make_experimenter("5860", "145833")
+        t = vz.Trial(id=1, parameters={"x0": 0.3, "x1": 0.8})
+        exp.evaluate([t])
+        assert t.final_measurement.metrics["objective"].value == 5.0
+
+    def test_missing_data_raises(self):
+        with pytest.raises(FileNotFoundError):
+            HPOBHandler(root_dir=None).make_experimenter("ss", "ds")
+
+    def test_continuous_protocol_gated_on_xgboost(self, hpob_root):
+        h = HPOBHandler(root_dir=hpob_root)
+        with pytest.raises((ImportError, NotImplementedError)):
+            h.evaluate_continuous(
+                object(), "5860", "145833", "test0", n_trials=1
+            )

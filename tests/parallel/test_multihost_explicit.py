@@ -1,0 +1,91 @@
+"""End-to-end explicit-coordinator multihost init (VERDICT r2/r3 carry-over).
+
+Spawns TWO real OS processes that each call
+``parallel.initialize_multihost(coordinator_address=..., num_processes=2,
+process_id=i)`` on the CPU backend and assert the returned mesh is GLOBAL
+(it spans both processes' devices). This executes the explicit-coordinator
+branch of ``parallel/__init__.py`` — ``jax.distributed.initialize`` wiring
+over a real localhost socket — which the in-process suite cannot reach
+(jax.distributed refuses to initialize twice in one process).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+_WORKER = textwrap.dedent(
+    """
+    import sys
+
+    # Pin the CPU platform BEFORE any jax import side effects (the image's
+    # sitecustomize force-inits the TPU plugin otherwise).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    coordinator, process_id = sys.argv[1], int(sys.argv[2])
+
+    from vizier_tpu import parallel
+
+    mesh = parallel.initialize_multihost(
+        coordinator_address=coordinator, num_processes=2, process_id=process_id
+    )
+    n_global = len(mesh.devices.flat)
+    n_local = len(jax.local_devices())
+    n_procs = jax.process_count()
+    print(
+        f"RESULT process_id={process_id} global={n_global} "
+        f"local={n_local} procs={n_procs}",
+        flush=True,
+    )
+    assert n_procs == 2, n_procs
+    assert n_global == 2 * n_local, (n_global, n_local)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_explicit_coordinator_returns_global_mesh(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the worker pins cpu via jax.config
+    # 2 virtual devices per process -> the global mesh must see 4.
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # Repo root from this file's location, not cwd, so the test passes
+    # regardless of where pytest is invoked from.
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coordinator, str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outputs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+        assert f"RESULT process_id={i} global=4 local=2 procs=2" in out, out

@@ -142,9 +142,9 @@ class TestSurrogateRegressions:
         exp.evaluate([t])
         assert t.infeasible
 
-    def test_hpob_mode_filenames(self):
+    def test_hpob_modes(self):
         from vizier_tpu.benchmarks.experimenters.surrogates import HPOBHandler
 
-        assert HPOBHandler._MODE_FILES["v3-test"] == "meta-test-dataset.json"
+        assert "v3-test" in HPOBHandler.MODES
         with pytest.raises(ValueError, match="Unknown HPO-B mode"):
-            HPOBHandler(root_dir="/tmp", mode="bogus").make_experimenter("s", "d")
+            HPOBHandler(root_dir="/tmp", mode="bogus")

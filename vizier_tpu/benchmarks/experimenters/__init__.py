@@ -9,6 +9,10 @@ from vizier_tpu.benchmarks.experimenters.combinatorial import (
     L1CategoricalExperimenter,
     PestControlExperimenter,
 )
+from vizier_tpu.benchmarks.experimenters.nasbench101 import (
+    NASBench101Experimenter,
+    TabularNASBench101,
+)
 from vizier_tpu.benchmarks.experimenters.surrogates import (
     Atari100kHandler,
     HPOBHandler,
