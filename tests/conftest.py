@@ -22,6 +22,28 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # parallelism gain. Dedicated mesh tests opt back in with use_mesh=True.
 os.environ.setdefault("VIZIER_DISABLE_MESH", "1")
 
+import gc  # noqa: E402
+
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _gc_relief():
+    """Keeps full-suite GC pauses bounded (observed failure mode: ~950
+    tests of jit compilations accumulate millions of live Python objects
+    (~5 GB RSS), after which any full collection stalls the main thread for
+    minutes — surfacing as spurious gRPC channel-ready timeouts or apparent
+    hangs in whatever test the pause lands on).
+
+    At each module boundary: drop jax's compilation caches (their jaxprs
+    dominate the object graph; cross-module cache reuse is minimal anyway),
+    collect once, then ``gc.freeze()`` the survivors into the permanent
+    generation so subsequent collections scan only new objects.
+    """
+    yield
+    jax.clear_caches()
+    gc.collect()
+    gc.freeze()

@@ -524,3 +524,24 @@ class TestAcquisitionBudgetPolicy:
         d.update(core_lib.CompletedTrials(trials))
         batch = d.suggest(4)
         assert len(batch) == 4
+
+
+class TestProfilerSpans:
+    """suggest() emits the reference's profiler span names
+    (ref gp_ucb_pe.py `profiler.timeit('acquisition_optimizer')` etc.)."""
+
+    def test_suggest_emits_latency_events(self):
+        from vizier_tpu.utils import profiler
+
+        problem = _single_metric_problem()
+        d = _designer(problem, num_seed_trials=1)
+        trials = _complete(
+            problem,
+            np.random.default_rng(0).uniform(size=4),
+            lambda x: {"obj": -((x - 0.5) ** 2)},
+        )
+        d.update(core_lib.CompletedTrials(trials))
+        with profiler.collect_events() as events:
+            d.suggest(2)
+        names = {e.name for e in events}
+        assert {"train_gp", "acquisition_optimizer", "best_candidates_to_trials"} <= names

@@ -41,6 +41,7 @@ from vizier_tpu.optimizers import lbfgs as lbfgs_lib
 from vizier_tpu.optimizers import vectorized as vectorized_lib
 from vizier_tpu.pyvizier import base_study_config
 from vizier_tpu.pyvizier import trial as trial_
+from vizier_tpu.utils import profiler
 
 Array = jax.Array
 
@@ -334,10 +335,12 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         if getattr(self, "_priors", None):
             return self._suggest_with_priors(count)
 
-        data = gp_lib.GPData.from_model_data(self._warped_model_data())
-        states = self._train(
-            data, self._next_rng(), self.ensemble_size, self._warm_params
-        )
+        with profiler.timeit("convert_trials"):
+            data = gp_lib.GPData.from_model_data(self._warped_model_data())
+        with profiler.timeit("train_gp"):
+            states = self._train(
+                data, self._next_rng(), self.ensemble_size, self._warm_params
+            )
         # Warm-start the next suggest from this one's best member
         # (states.params are constrained; map back through the bijectors).
         coll = self._model.param_collection()
@@ -392,8 +395,11 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             trust_region=trust,
         )
         prior = self._prior_features(data)
-        result = self._maximize(scoring, self._next_rng(), count, prior)
-        return self._decode_result(result, count, kind=self.acquisition)
+        with profiler.timeit("acquisition_optimizer"):
+            result = self._maximize(scoring, self._next_rng(), count, prior)
+            jax.block_until_ready(result.scores)
+        with profiler.timeit("best_candidates_to_trials"):
+            return self._decode_result(result, count, kind=self.acquisition)
 
     def _decode_result(
         self, result: vectorized_lib.VectorizedOptimizerResult, count: int, *, kind: str
@@ -431,16 +437,18 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     def _suggest_with_priors(self, count: int) -> List[trial_.TrialSuggestion]:
         from vizier_tpu.models import stacked_residual
 
-        datasets = [self._data_for_trials(p) for p in self._priors]
-        data = gp_lib.GPData.from_model_data(self._warped_model_data())
-        datasets.append(data)
-        stack = stacked_residual.train_stacked_residual_gp(
-            self._model,
-            self._ard,
-            datasets,
-            self._next_rng(),
-            num_restarts=self.ard_restarts,
-        )
+        with profiler.timeit("convert_trials"):
+            datasets = [self._data_for_trials(p) for p in self._priors]
+            data = gp_lib.GPData.from_model_data(self._warped_model_data())
+            datasets.append(data)
+        with profiler.timeit("train_gp"):
+            stack = stacked_residual.train_stacked_residual_gp(
+                self._model,
+                self._ard,
+                datasets,
+                self._next_rng(),
+                num_restarts=self.ard_restarts,
+            )
         self._last_predictive = stack  # duck-typed .predict
         best_label = jnp.max(jnp.where(data.row_mask, data.labels, -jnp.inf))
         scoring = acquisitions.ScoringFunction(
@@ -453,10 +461,15 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
                 else None
             ),
         )
-        result = self._maximize(
-            scoring, self._next_rng(), count, self._prior_features(data)
-        )
-        return self._decode_result(result, count, kind=f"{self.acquisition}+priors")
+        with profiler.timeit("acquisition_optimizer"):
+            result = self._maximize(
+                scoring, self._next_rng(), count, self._prior_features(data)
+            )
+            jax.block_until_ready(result.scores)
+        with profiler.timeit("best_candidates_to_trials"):
+            return self._decode_result(
+                result, count, kind=f"{self.acquisition}+priors"
+            )
 
     # -- multi-objective ---------------------------------------------------
 
@@ -489,9 +502,10 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
                 )
             )
         batched = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *datas)
-        states = _train_gp_per_metric(
-            self._model, self._ard, batched, self._next_rng(), self.ard_restarts
-        )
+        with profiler.timeit("train_gp"):
+            states = _train_gp_per_metric(
+                self._model, self._ard, batched, self._next_rng(), self.ard_restarts
+            )
         m = len(objective_idx)
         directions = jnp.abs(
             jax.random.normal(self._next_rng(), (64, m), dtype=jnp.float32)
@@ -508,10 +522,13 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
                 else None
             ),
         )
-        result = self._maximize(
-            scoring, self._next_rng(), count, self._prior_features(datas[0])
-        )
-        return self._decode_result(result, count, kind="hv_scalarized_ucb")
+        with profiler.timeit("acquisition_optimizer"):
+            result = self._maximize(
+                scoring, self._next_rng(), count, self._prior_features(datas[0])
+            )
+            jax.block_until_ready(result.scores)
+        with profiler.timeit("best_candidates_to_trials"):
+            return self._decode_result(result, count, kind="hv_scalarized_ucb")
 
     # -- pieces ------------------------------------------------------------
 

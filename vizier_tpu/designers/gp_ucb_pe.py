@@ -54,6 +54,7 @@ from vizier_tpu.optimizers import eagle as eagle_lib
 from vizier_tpu.optimizers import vectorized as vectorized_lib
 from vizier_tpu.pyvizier import base_study_config
 from vizier_tpu.pyvizier import trial as trial_
+from vizier_tpu.utils import profiler
 
 Array = jax.Array
 
@@ -763,7 +764,8 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         if getattr(self, "_priors", None):
             return self._suggest_with_priors(count)
 
-        states_me, datas = self._train_states_me()
+        with profiler.timeit("train_gp"):
+            states_me, datas = self._train_states_me()
         is_mt = isinstance(states_me, mtgp.MultiTaskGPState)
         if is_mt:
             self._last_predictive = _MetricZeroMTPredictive(states_me)
@@ -807,25 +809,28 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             )
         else:
             model = self._model
-        batch, aux = _suggest_batch(
-            model,
-            self._pick_vec_opt(count),
-            states_me,
-            all_data,
-            labels_mn,
-            labels_mask,
-            ref_point,
-            self._prior_features(datas[0]),
-            self._next_rng(),
-            first_has_new,
-            has_completed,
-            count,
-            self.config,
-            self.use_trust_region,
-            self._mesh,
-            self.prior_acquisition,
-        )
-        return self._decode_ucb_pe(batch, aux, count)
+        with profiler.timeit("acquisition_optimizer"):
+            batch, aux = _suggest_batch(
+                model,
+                self._pick_vec_opt(count),
+                states_me,
+                all_data,
+                labels_mn,
+                labels_mask,
+                ref_point,
+                self._prior_features(datas[0]),
+                self._next_rng(),
+                first_has_new,
+                has_completed,
+                count,
+                self.config,
+                self.use_trust_region,
+                self._mesh,
+                self.prior_acquisition,
+            )
+            jax.block_until_ready(batch.scores)
+        with profiler.timeit("best_candidates_to_trials"):
+            return self._decode_ucb_pe(batch, aux, count)
 
     def _suggest_with_set_acquisition(
         self, count, states_me, all_data, labels_mn, labels_mask, ref_point,
@@ -834,13 +839,15 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         """Reference flow: one UCB pick if fresh data, then a joint PE set."""
         suggestions: List[trial_.TrialSuggestion] = []
         if bool(first_has_new):
-            first, aux1 = _suggest_batch(
-                self._model, self._vec_opt, states_me, all_data,
-                labels_mn, labels_mask, ref_point,
-                self._prior_features(datas[0]), self._next_rng(),
-                first_has_new, has_completed, 1, self.config,
-                self.use_trust_region, self._mesh, self.prior_acquisition,
-            )
+            with profiler.timeit("acquisition_optimizer"):
+                first, aux1 = _suggest_batch(
+                    self._model, self._vec_opt, states_me, all_data,
+                    labels_mn, labels_mask, ref_point,
+                    self._prior_features(datas[0]), self._next_rng(),
+                    first_has_new, has_completed, 1, self.config,
+                    self.use_trust_region, self._mesh, self.prior_acquisition,
+                )
+                jax.block_until_ready(first.scores)
             suggestions.extend(self._decode_ucb_pe(first, aux1, 1))
             all_data = _append_row(
                 all_data,
@@ -863,18 +870,21 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
                 strategy, max_evaluations=self.max_acquisition_evaluations
             )
             self._set_opt_cache[q] = set_opt
-        result, aux = _suggest_set_pe(
-            self._model,
-            set_opt,
-            states_me,
-            all_data,
-            self._next_rng(),
-            q,
-            self.config,
-            self.use_trust_region,
-            self.prior_acquisition,
-        )
-        suggestions.extend(self._decode_ucb_pe(result, aux, q))
+        with profiler.timeit("set_acquisition_optimizer"):
+            result, aux = _suggest_set_pe(
+                self._model,
+                set_opt,
+                states_me,
+                all_data,
+                self._next_rng(),
+                q,
+                self.config,
+                self.use_trust_region,
+                self.prior_acquisition,
+            )
+            jax.block_until_ready(result.scores)
+        with profiler.timeit("best_candidates_to_trials"):
+            suggestions.extend(self._decode_ucb_pe(result, aux, q))
         return suggestions
 
     def _decode_ucb_pe(

@@ -8,6 +8,7 @@ compiles in ``build_protos.sh``.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, Tuple
 
 import grpc
@@ -138,14 +139,32 @@ class PythiaServiceStub(_Stub):
         super().__init__(channel, PYTHIA_SERVICE_NAME, PYTHIA_METHODS)
 
 
-def create_vizier_stub(endpoint: str, timeout: float = 10.0) -> VizierServiceStub:
-    """Creates a stub after waiting for the channel to be ready."""
-    channel = grpc.insecure_channel(endpoint)
+# One channel per endpoint for the process lifetime. Stub creation sits on
+# every client constructor (`vizier_client.create_or_load_study`), and a
+# fresh `grpc.insecure_channel` per call leaks its sockets + watcher
+# threads for the life of the process — enough accumulated channels
+# eventually wedge grpc-core's connectivity subscription (observed as a
+# hang inside `channel.subscribe` after ~900 tests). gRPC channels are
+# thread-safe and auto-reconnect, so sharing per endpoint is the intended
+# usage.
+_CHANNEL_LOCK = threading.Lock()
+_CHANNELS: Dict[str, grpc.Channel] = {}
+
+
+def _shared_channel(endpoint: str, timeout: float) -> grpc.Channel:
+    with _CHANNEL_LOCK:
+        channel = _CHANNELS.get(endpoint)
+        if channel is None:
+            channel = grpc.insecure_channel(endpoint)
+            _CHANNELS[endpoint] = channel
     grpc.channel_ready_future(channel).result(timeout=timeout)
-    return VizierServiceStub(channel)
+    return channel
+
+
+def create_vizier_stub(endpoint: str, timeout: float = 10.0) -> VizierServiceStub:
+    """Creates a stub on the shared per-endpoint channel once it is ready."""
+    return VizierServiceStub(_shared_channel(endpoint, timeout))
 
 
 def create_pythia_stub(endpoint: str, timeout: float = 10.0) -> PythiaServiceStub:
-    channel = grpc.insecure_channel(endpoint)
-    grpc.channel_ready_future(channel).result(timeout=timeout)
-    return PythiaServiceStub(channel)
+    return PythiaServiceStub(_shared_channel(endpoint, timeout))
