@@ -241,9 +241,10 @@ def main() -> None:
         "unit": "ms",
         "vs_baseline": round(target_ms / p50, 3),
         "e2e_default_designer_suggest_p50_ms": round(e2e_p50, 1),
-        # Round-4 semantics: the designer splits max_acquisition_evaluations
-        # across the batch (docs/guides/tpu_architecture.md) — r1-r3 e2e
-        # numbers spent 25x more sweep evaluations per suggest.
+        # Round-4 semantics (docs/guides/tpu_architecture.md): the default
+        # "first_pick_full" spends one full budget on the exploitation pick
+        # plus one split across the rest (~2 sweeps per suggest) — r1-r3
+        # e2e numbers spent a full budget on EVERY pick (25 sweeps).
         "e2e_budget_policy": designer.acquisition_budget_policy,
     }
     if backend_tag:

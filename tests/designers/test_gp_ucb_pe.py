@@ -478,20 +478,54 @@ class TestAcquisitionBudgetPolicy:
     per suggest() call, split across picks; per_pick = reference behavior,
     75k per pick, ref gp_ucb_pe.py:693-697,1440-1446)."""
 
-    def test_default_splits_budget_across_batch(self):
+    def test_default_is_first_pick_full(self):
         problem = _single_metric_problem()
         d = _designer(problem, max_acquisition_evaluations=75_000)
-        assert d.acquisition_budget_policy == "per_batch"
-        assert d._pick_vec_opt(25).max_evaluations == 3_000
+        assert d.acquisition_budget_policy == "first_pick_full"
+        # Remaining 24 picks split one further full budget.
+        assert d._pick_vec_opt(25).max_evaluations == 75_000 // 24
         # Single pick keeps the full budget.
+        assert d._pick_vec_opt(1).max_evaluations == 75_000
+
+    def test_per_batch_splits_across_all_picks(self):
+        problem = _single_metric_problem()
+        d = _designer(
+            problem,
+            max_acquisition_evaluations=75_000,
+            acquisition_budget_policy="per_batch",
+        )
+        assert d._pick_vec_opt(25).max_evaluations == 3_000
         assert d._pick_vec_opt(1).max_evaluations == 75_000
 
     def test_split_budget_floors_at_minimum(self):
         from vizier_tpu.designers import gp_ucb_pe as mod
 
         problem = _single_metric_problem()
-        d = _designer(problem, max_acquisition_evaluations=1_000)
+        d = _designer(
+            problem,
+            max_acquisition_evaluations=1_000,
+            acquisition_budget_policy="per_batch",
+        )
         assert d._pick_vec_opt(25).max_evaluations == mod._MIN_PICK_EVALUATIONS
+
+    def test_first_pick_full_runs_two_programs(self):
+        """Batch suggest under the default policy: first pick full budget,
+        remainder split; the batch still comes back whole and in-box."""
+        problem = _single_metric_problem()
+        d = _designer(problem, max_acquisition_evaluations=900, num_seed_trials=1)
+        trials = _complete(
+            problem,
+            np.random.default_rng(0).uniform(size=5),
+            lambda x: {"obj": -((x - 0.5) ** 2)},
+        )
+        d.update(core_lib.CompletedTrials(trials))
+        batch = d.suggest(3)
+        assert len(batch) == 3
+        for s in batch:
+            assert 0.0 <= float(s.parameters["x"].value) <= 1.0
+        # Picks 2-3 saw pick 1 as pending: no duplicate suggestions.
+        xs = sorted(float(s.parameters["x"].value) for s in batch)
+        assert all(b - a > 1e-4 for a, b in zip(xs, xs[1:])), xs
 
     def test_per_pick_policy_uses_full_budget(self):
         problem = _single_metric_problem()

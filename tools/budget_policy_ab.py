@@ -1,0 +1,102 @@
+"""A/B: per_batch vs per_pick acquisition budget on shifted 20-D BBOB.
+
+Usage: python tools/budget_policy_ab.py [--trials 150] [--seeds 1 2]
+
+Same shifted instances as parity_suite.py / the CI gate. Prints one JSON
+line per (function, policy, seed) plus a summary — evidence that the
+TPU-native per_batch default (25x fewer sweep evaluations per suggest(25))
+does not degrade regret.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _honor_platform_env
+
+_honor_platform_env()
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=150)
+    ap.add_argument("--batch", type=int, default=10)
+    ap.add_argument("--evals", type=int, default=25_000)
+    ap.add_argument("--seeds", type=int, nargs="+", default=[1, 2])
+    args = ap.parse_args()
+
+    from vizier_tpu import benchmarks
+    from vizier_tpu.algorithms import core as core_lib
+    from vizier_tpu.benchmarks.experimenters import wrappers
+    from vizier_tpu.benchmarks.experimenters.synthetic import bbob
+    from vizier_tpu.designers.gp_ucb_pe import VizierGPUCBPEBandit
+
+    results: dict = {}
+    for fn_name in ("Sphere", "Rastrigin"):
+        for policy in ("first_pick_full", "per_batch", "per_pick"):
+            finals = []
+            for seed in args.seeds:
+                shift = np.random.default_rng(1000 + seed).uniform(
+                    -2.0, 2.0, size=20
+                )
+                exp = wrappers.ShiftingExperimenter(
+                    benchmarks.NumpyExperimenter(
+                        bbob.BBOB_FUNCTIONS[fn_name], benchmarks.bbob_problem(20)
+                    ),
+                    shift=shift,
+                )
+                problem = exp.problem_statement()
+                designer = VizierGPUCBPEBandit(
+                    problem,
+                    rng_seed=seed,
+                    max_acquisition_evaluations=args.evals,
+                    num_seed_trials=5,
+                    acquisition_budget_policy=policy,
+                )
+                best, tid = np.inf, 0
+                t0 = time.perf_counter()
+                while tid < args.trials:
+                    batch = [
+                        s.to_trial(tid + i + 1)
+                        for i, s in enumerate(designer.suggest(args.batch))
+                    ]
+                    tid += len(batch)
+                    exp.evaluate(batch)
+                    designer.update(core_lib.CompletedTrials(batch))
+                    for t in batch:
+                        best = min(
+                            best,
+                            t.final_measurement.metrics["bbob_eval"].value,
+                        )
+                elapsed = time.perf_counter() - t0
+                finals.append(best)
+                print(
+                    json.dumps(
+                        {
+                            "fn": fn_name,
+                            "policy": policy,
+                            "seed": seed,
+                            "final_regret": round(best, 4),
+                            "wall_s": round(elapsed, 1),
+                        }
+                    ),
+                    flush=True,
+                )
+            results[(fn_name, policy)] = finals
+    print("== summary (median final regret, lower better) ==", flush=True)
+    summary = {}
+    for (fn_name, policy), finals in results.items():
+        summary[f"{fn_name}:{policy}"] = float(np.median(finals))
+    print(json.dumps(summary, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -578,18 +578,23 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
 
     config: UCBPEConfig = UCBPEConfig()
     num_seed_trials: int = 1  # reference default: center point first
-    # Acquisition evaluation budget semantics for batch suggests:
-    # - "per_batch" (default): ``max_acquisition_evaluations`` is the TOTAL
-    #   budget for one suggest() call, split evenly across the batch's
-    #   greedy picks (floored at _MIN_PICK_EVALUATIONS). Profiling shows the
-    #   per-pick sweep dominates e2e latency (~88% at 1000x20-D), and each
-    #   pick's sweep starts seeded at the incumbents, so a split budget
-    #   loses little quality while cutting suggest(25) cost ~25x.
+    # Acquisition evaluation budget semantics for batch suggests (measured
+    # A/B in docs/guides/tpu_architecture.md):
+    # - "first_pick_full" (default): the batch's FIRST pick — the
+    #   exploitation (UCB) pick whose local optimization precision drives
+    #   simple regret — runs the full ``max_acquisition_evaluations``;
+    #   the remaining picks, which maximize the flatter pure-exploration
+    #   stddev surface, split one further full budget between them. Total
+    #   ≈ 2 sweeps per suggest() regardless of batch size.
+    # - "per_batch": one full budget split across ALL picks (floored at
+    #   _MIN_PICK_EVALUATIONS) — cheapest, measurably worse exploitation
+    #   precision on 20-D (the per-pick sweep dominates e2e latency, ~88%
+    #   at 1000x20-D).
     # - "per_pick": every pick runs the full budget — the reference's
     #   effective behavior (its ``_suggest_one`` spends max_evaluations=75k
     #   per pick, ``gp_ucb_pe.py:693-697,1440-1446``, with a TODO
     #   acknowledging the budget should scale with count).
-    acquisition_budget_policy: str = "per_batch"
+    acquisition_budget_policy: str = "first_pick_full"
     # Optional additive acquisition prior (reference `prior_acquisition`,
     # gp_ucb_pe.py:299): called with the candidate MixedFeatures batch,
     # returns a [Q] score added to both the UCB and PE acquisitions. Must be
@@ -600,10 +605,15 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
 
     def __post_init__(self):
         super().__post_init__()
-        if self.acquisition_budget_policy not in ("per_batch", "per_pick"):
+        if self.acquisition_budget_policy not in (
+            "first_pick_full",
+            "per_batch",
+            "per_pick",
+        ):
             raise ValueError(
-                "acquisition_budget_policy must be 'per_batch' | 'per_pick', "
-                f"got {self.acquisition_budget_policy!r}."
+                "acquisition_budget_policy must be 'first_pick_full' | "
+                "'per_batch' | 'per_pick', got "
+                f"{self.acquisition_budget_policy!r}."
             )
         self._active_trials: List[trial_.Trial] = []
         self._metric_warpers: List[output_warpers.WarperPipeline] = []
@@ -617,18 +627,13 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         # by their per-pick evaluation budget.
         self._pick_opt_cache: dict = {}
 
-    def _pick_vec_opt(self, count: int) -> vectorized_lib.VectorizedOptimizer:
-        """The acquisition optimizer one greedy pick runs with.
-
-        Under "per_batch", a batch of ``count`` splits
-        ``max_acquisition_evaluations`` evenly across its picks so one
-        suggest() call costs one full sweep's evaluations regardless of
-        batch size.
-        """
-        if self.acquisition_budget_policy == "per_pick" or count <= 1:
+    def _split_vec_opt(self, num_picks: int) -> vectorized_lib.VectorizedOptimizer:
+        """One full budget split evenly across ``num_picks`` picks."""
+        if num_picks <= 1:
             return self._vec_opt
         per_pick = max(
-            self.max_acquisition_evaluations // count, _MIN_PICK_EVALUATIONS
+            self.max_acquisition_evaluations // num_picks,
+            _MIN_PICK_EVALUATIONS,
         )
         opt = self._pick_opt_cache.get(per_pick)
         if opt is None:
@@ -637,6 +642,19 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             )
             self._pick_opt_cache[per_pick] = opt
         return opt
+
+    def _pick_vec_opt(self, count: int) -> vectorized_lib.VectorizedOptimizer:
+        """The acquisition optimizer the batch loop's picks run with.
+
+        "per_batch" splits ``max_acquisition_evaluations`` across all
+        ``count`` picks; "first_pick_full" handles its full-budget first
+        pick separately in ``suggest`` and splits across the remainder.
+        """
+        if self.acquisition_budget_policy == "per_pick" or count <= 1:
+            return self._vec_opt
+        if self.acquisition_budget_policy == "first_pick_full":
+            return self._split_vec_opt(count - 1)
+        return self._split_vec_opt(count)
 
     # -- Designer ----------------------------------------------------------
 
@@ -809,28 +827,63 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             )
         else:
             model = self._model
+        prior_feats = self._prior_features(datas[0])
+        results: List[Tuple] = []  # [(result, aux, rows)]
         with profiler.timeit("acquisition_optimizer"):
-            batch, aux = _suggest_batch(
-                model,
-                self._pick_vec_opt(count),
-                states_me,
-                all_data,
-                labels_mn,
-                labels_mask,
-                ref_point,
-                self._prior_features(datas[0]),
-                self._next_rng(),
-                first_has_new,
-                has_completed,
-                count,
-                self.config,
-                self.use_trust_region,
-                self._mesh,
-                self.prior_acquisition,
-            )
-            jax.block_until_ready(batch.scores)
+            if self.acquisition_budget_policy == "first_pick_full" and count > 1:
+                # Full budget on the exploitation-critical first pick; one
+                # further full budget split across the remaining picks.
+                first, aux1 = _suggest_batch(
+                    model, self._vec_opt, states_me, all_data,
+                    labels_mn, labels_mask, ref_point, prior_feats,
+                    self._next_rng(), first_has_new, has_completed, 1,
+                    self.config, self.use_trust_region, self._mesh,
+                    self.prior_acquisition,
+                )
+                x = kernels.MixedFeatures(
+                    first.features.continuous[:1],
+                    first.features.categorical[:1],
+                )
+                all_data = (_append_row_mt if is_mt else _append_row)(
+                    all_data, x
+                )
+                # _pick_vec_opt(count) is the ONE budget-dispatch point: under
+                # first_pick_full it returns the (count-1)-way split sweep.
+                rest, aux2 = _suggest_batch(
+                    model, self._pick_vec_opt(count), states_me,
+                    all_data, labels_mn, labels_mask, ref_point, prior_feats,
+                    self._next_rng(), jnp.asarray(False), has_completed,
+                    count - 1, self.config, self.use_trust_region,
+                    self._mesh, self.prior_acquisition,
+                )
+                jax.block_until_ready(rest.scores)
+                results = [(first, aux1, 1), (rest, aux2, count - 1)]
+            else:
+                batch, aux = _suggest_batch(
+                    model,
+                    self._pick_vec_opt(count),
+                    states_me,
+                    all_data,
+                    labels_mn,
+                    labels_mask,
+                    ref_point,
+                    prior_feats,
+                    self._next_rng(),
+                    first_has_new,
+                    has_completed,
+                    count,
+                    self.config,
+                    self.use_trust_region,
+                    self._mesh,
+                    self.prior_acquisition,
+                )
+                jax.block_until_ready(batch.scores)
+                results = [(batch, aux, count)]
         with profiler.timeit("best_candidates_to_trials"):
-            return self._decode_ucb_pe(batch, aux, count)
+            out: List[trial_.TrialSuggestion] = []
+            for result, aux, rows in results:
+                out.extend(self._decode_ucb_pe(result, aux, rows))
+            return out
 
     def _suggest_with_set_acquisition(
         self, count, states_me, all_data, labels_mn, labels_mask, ref_point,

@@ -161,6 +161,19 @@ def _shared_channel(endpoint: str, timeout: float) -> grpc.Channel:
     return channel
 
 
+def close_channel(endpoint: str) -> None:
+    """Closes and evicts the shared channel for ``endpoint`` (if any).
+
+    Servers call this from ``stop()`` so channels to dead endpoints do not
+    accumulate for the process lifetime (each test-scoped server would
+    otherwise leave one live channel behind forever).
+    """
+    with _CHANNEL_LOCK:
+        channel = _CHANNELS.pop(endpoint, None)
+    if channel is not None:
+        channel.close()
+
+
 def create_vizier_stub(endpoint: str, timeout: float = 10.0) -> VizierServiceStub:
     """Creates a stub on the shared per-endpoint channel once it is ready."""
     return VizierServiceStub(_shared_channel(endpoint, timeout))

@@ -59,6 +59,9 @@ class DefaultVizierServer:
 
     def stop(self, grace: Optional[float] = None) -> None:
         self._server.stop(grace)
+        from vizier_tpu.service import grpc_stubs
+
+        grpc_stubs.close_channel(self._endpoint)
 
     def __del__(self):
         try:
@@ -66,6 +69,9 @@ class DefaultVizierServer:
             # completes, which deadlocks interpreter shutdown if a handler
             # thread is still parked (observed after early-stopping RPCs).
             self._server.stop(0)
+            from vizier_tpu.service import grpc_stubs
+
+            grpc_stubs.close_channel(self._endpoint)
         except Exception:
             pass
 
@@ -122,3 +128,7 @@ class DistributedPythiaVizierServer:
     def stop(self, grace: Optional[float] = None) -> None:
         self._pythia_server.stop(grace)
         self._vizier_server.stop(grace)
+        from vizier_tpu.service import grpc_stubs
+
+        grpc_stubs.close_channel(self._pythia_endpoint)
+        grpc_stubs.close_channel(self._vizier_endpoint)
