@@ -407,19 +407,14 @@ def main() -> None:
     # Shifted per seed (matching the reference factory's shift-application,
     # ``experimenter_factory.py:151-153``) so the optimum never coincides
     # with the search-box center that GP designers default-seed: an
-    # unshifted run measures seeding, not optimization.
-    from vizier_tpu.benchmarks.experimenters.wrappers import ShiftingExperimenter
+    # unshifted run measures seeding, not optimization. ONE shared instance
+    # definition pins this report, the CI gate, and the budget A/B together.
+    from vizier_tpu.benchmarks.experimenters import experimenter_factory
 
     for fn_name in ("Sphere", "Rastrigin"):
 
         def shifted_bbob(seed, _fn=fn_name):
-            shift = np.random.default_rng(1000 + seed).uniform(-2.0, 2.0, size=20)
-            return ShiftingExperimenter(
-                benchmarks.NumpyExperimenter(
-                    bbob.BBOB_FUNCTIONS[_fn], benchmarks.bbob_problem(20)
-                ),
-                shift=shift,
-            )
+            return experimenter_factory.shifted_bbob_instance(_fn, seed)
 
         run_config(
             f"bbob20d_{fn_name.lower()}",

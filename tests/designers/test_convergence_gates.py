@@ -105,11 +105,10 @@ class TestShifted20DGates:
     DEFAULT designer's 20-D behavior fail here."""
 
     def _shifted_sphere_20d(self, seed):
-        # Identical shift construction to parity_suite.py's bbob20d configs.
-        shift = np.random.default_rng(1000 + seed).uniform(-2.0, 2.0, size=20)
-        return wrappers.ShiftingExperimenter(
-            NumpyExperimenter(bbob.Sphere, bbob_problem(20)), shift=shift
-        )
+        # THE pinned instance (shared with parity_suite.py + the A/B tool).
+        from vizier_tpu.benchmarks.experimenters import experimenter_factory
+
+        return experimenter_factory.shifted_bbob_instance("Sphere", seed)
 
     def test_ucb_pe_beats_random_on_shifted_sphere_20d(self):
         from vizier_tpu.algorithms import core as core_lib

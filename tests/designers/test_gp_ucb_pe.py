@@ -579,3 +579,32 @@ class TestProfilerSpans:
             d.suggest(2)
         names = {e.name for e in events}
         assert {"train_gp", "acquisition_optimizer", "best_candidates_to_trials"} <= names
+
+
+class TestRetraceDiscipline:
+    def test_no_retrace_within_padding_bucket_batch(self):
+        """Steady-state batch suggests under first_pick_full reuse both
+        compiled programs (the full-budget pick and the split rest-batch)."""
+        from vizier_tpu.designers import gp_ucb_pe as mod
+
+        problem = _single_metric_problem()
+        d = _designer(problem, num_seed_trials=1, max_acquisition_evaluations=300)
+        rng = np.random.default_rng(0)
+        tid = 0
+
+        def complete_round():
+            nonlocal tid
+            done = []
+            for s in d.suggest(2):
+                tid += 1
+                t = s.to_trial(tid)
+                t.complete(vz.Measurement(metrics={"obj": float(rng.uniform())}))
+                done.append(t)
+            d.update(core_lib.CompletedTrials(done))
+
+        complete_round()  # seeding round
+        complete_round()  # 2 trials: compile both programs in the 8-bucket
+        complete_round()  # 4 trials: still 8-bucket (4+2 spare rows -> 8)
+        size = mod._suggest_batch._cache_size()
+        complete_round()  # 6 trials: 6+2 -> still the 8-bucket, no retrace
+        assert mod._suggest_batch._cache_size() == size

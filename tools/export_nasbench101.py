@@ -1,0 +1,85 @@
+"""Exports the real NASBench-101 dataset into this repo's table format.
+
+Usage (on a machine with the `nasbench` package + its TFRecord dataset):
+
+    python tools/export_nasbench101.py \
+        --dataset /path/to/nasbench_only108.tfrecord \
+        --out nasbench101_table.json
+
+The output is the hash→metrics JSON that
+``vizier_tpu.benchmarks.experimenters.nasbench101.TabularNASBench101.from_file``
+serves, keyed by THIS repo's ``ModelSpec.graph_hash`` (recomputed from each
+entry's matrix/ops so the lookup key and the experimenter's encoding always
+agree — the upstream package's own hashes are not reused).
+
+Both the package and the dataset are absent from this image by design; the
+tool is data-gated and exits with a clear message without them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", required=True, help="NASBench-101 TFRecord path")
+    ap.add_argument("--out", default="nasbench101_table.json")
+    ap.add_argument(
+        "--epochs", type=int, default=108, help="Training-epoch budget to export"
+    )
+    args = ap.parse_args()
+
+    try:
+        from nasbench import api  # type: ignore
+    except ImportError:
+        raise SystemExit(
+            "The `nasbench` package is not installed (and is not bundled in "
+            "this image). Run this export on a machine that has it plus the "
+            "public dataset, then ship the JSON."
+        )
+    if not os.path.exists(args.dataset):
+        raise SystemExit(f"Dataset not found: {args.dataset}")
+
+    from vizier_tpu.benchmarks.experimenters import nasbench101 as nb
+
+    nasbench = api.NASBench(args.dataset)
+    table = {}
+    skipped = 0
+    for upstream_hash in nasbench.hash_iterator():
+        fixed, computed = nasbench.get_metrics_from_hash(upstream_hash)
+        spec = nb.ModelSpec(
+            matrix=fixed["module_adjacency"],
+            ops=list(fixed["module_operations"]),
+        )
+        h = spec.graph_hash()
+        if h == "invalid":
+            skipped += 1
+            continue
+        runs = computed[args.epochs]
+        # Average over the dataset's repeated training runs (3 per cell).
+        def avg(key):
+            return float(sum(r[key] for r in runs) / len(runs))
+
+        table[h] = {
+            "trainable_parameters": float(fixed["trainable_parameters"]),
+            "training_time": avg("final_training_time"),
+            "train_accuracy": avg("final_train_accuracy"),
+            "validation_accuracy": avg("final_validation_accuracy"),
+            "test_accuracy": avg("final_test_accuracy"),
+        }
+    with open(args.out, "w") as f:
+        json.dump(table, f)
+    print(
+        f"Exported {len(table)} cells to {args.out} "
+        f"({skipped} skipped as disconnected)."
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -56,3 +56,28 @@ class SingleObjectiveExperimenterFactory:
         if self.noise_std:
             parts.append(f"noise{self.noise_std}")
         return "_".join(parts)
+
+
+def shifted_bbob_instance(
+    fn_name: str, seed: int, dim: int = 20, shift_range: float = 2.0
+) -> base.Experimenter:
+    """THE pinned per-seed shifted BBOB instance the repo's evidence uses.
+
+    One definition shared by ``parity_suite.py`` (the committed
+    ``regret_report_r4.json``), the CI convergence gate
+    (``tests/designers/test_convergence_gates.py::TestShifted20DGates``)
+    and ``tools/budget_policy_ab.py`` — editing the recipe here moves all
+    three together, so the gate can never silently diverge from the
+    published evidence. Mirrors the reference factory's shift application
+    (``experimenter_factory.py:151-153``): the optimum moves off the
+    search-box center, so center-seeding cannot fake convergence.
+    """
+    shift = np.random.default_rng(1000 + seed).uniform(
+        -shift_range, shift_range, size=dim
+    )
+    return wrappers.ShiftingExperimenter(
+        base.NumpyExperimenter(
+            bbob.BBOB_FUNCTIONS[fn_name], base.bbob_problem(dim)
+        ),
+        shift=shift,
+    )

@@ -58,7 +58,11 @@ class DefaultVizierServer:
         return self._servicer
 
     def stop(self, grace: Optional[float] = None) -> None:
-        self._server.stop(grace)
+        # grpc.Server.stop is non-blocking (returns an event); wait for the
+        # grace window to drain in-flight RPCs BEFORE closing the shared
+        # client channel, else the close cancels the very RPCs the grace
+        # period protects. Stubs created before stop() are invalidated.
+        self._server.stop(grace).wait()
         from vizier_tpu.service import grpc_stubs
 
         grpc_stubs.close_channel(self._endpoint)
@@ -126,8 +130,12 @@ class DistributedPythiaVizierServer:
         return self._pythia_endpoint
 
     def stop(self, grace: Optional[float] = None) -> None:
-        self._pythia_server.stop(grace)
-        self._vizier_server.stop(grace)
+        # Drain both servers through the grace window first (stop() is
+        # non-blocking), THEN close the cross-connect channels.
+        pythia_done = self._pythia_server.stop(grace)
+        vizier_done = self._vizier_server.stop(grace)
+        pythia_done.wait()
+        vizier_done.wait()
         from vizier_tpu.service import grpc_stubs
 
         grpc_stubs.close_channel(self._pythia_endpoint)
