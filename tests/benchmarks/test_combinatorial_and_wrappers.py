@@ -320,7 +320,31 @@ class TestAtari100k:
         with pytest.raises(FileNotFoundError, match="Atari100k"):
             handler.make_experimenter()
 
-    def test_loads_json_table(self, tmp_path):
+    def test_live_experimenter_space_matches_reference(self):
+        """The published 14-parameter gin space + eval_average_return."""
+        exp = surrogates.Atari100kExperimenter(game_name="Pong", agent_name="DrQ")
+        problem = exp.problem_statement()
+        names = set(problem.search_space.parameter_names())
+        assert problem.search_space.num_parameters() == 14
+        assert {
+            "JaxDQNAgent.gamma",
+            "JaxFullRainbowAgent.noisy",
+            "Atari100kRainbowAgent.data_augmentation",
+            "create_optimizer.learning_rate",
+        } <= names
+        assert problem.metric_information.item().name == "eval_average_return"
+
+    def test_live_experimenter_gated_on_dopamine(self):
+        exp = surrogates.Atari100kExperimenter()
+        t = trial_.Trial(id=1, parameters={"JaxDQNAgent.update_horizon": 3})
+        with pytest.raises(ImportError, match="dopamine"):
+            exp.evaluate([t])
+
+    def test_invalid_agent_rejected(self):
+        with pytest.raises(ValueError, match="agent_name"):
+            surrogates.Atari100kExperimenter(agent_name="Rainbow9000")
+
+    def test_loads_json_table_with_gin_columns(self, tmp_path):
         import json
 
         table = []
@@ -328,11 +352,12 @@ class TestAtari100k:
         for _ in range(16):
             table.append(
                 {
-                    "learning_rate": float(10 ** rng.uniform(-5, -2)),
-                    "epsilon": float(10 ** rng.uniform(-8, -3)),
-                    "n_steps": int(rng.integers(1, 21)),
-                    "update_horizon": int(rng.integers(1, 21)),
-                    "score": float(rng.normal()),
+                    "create_optimizer.learning_rate": float(
+                        10 ** rng.uniform(-5, -2)
+                    ),
+                    "JaxDQNAgent.update_horizon": int(rng.integers(1, 21)),
+                    "JaxFullRainbowAgent.num_atoms": int(rng.integers(1, 101)),
+                    "eval_average_return": float(rng.normal()),
                 }
             )
         path = tmp_path / "atari.json"
@@ -342,9 +367,74 @@ class TestAtari100k:
         t = trial_.Trial(
             id=1,
             parameters={
-                "learning_rate": 1e-3, "epsilon": 1e-5,
-                "n_steps": 5, "update_horizon": 10,
+                "create_optimizer.learning_rate": 1e-3,
+                "JaxDQNAgent.update_horizon": 5,
+                "JaxFullRainbowAgent.num_atoms": 51,
             },
         )
         exp.evaluate([t])
-        assert np.isfinite(t.final_measurement.metrics["score"].value)
+        assert np.isfinite(
+            t.final_measurement.metrics["eval_average_return"].value
+        )
+
+    def test_unknown_column_rejected(self, tmp_path):
+        import json
+
+        path = tmp_path / "atari.json"
+        path.write_text(json.dumps([{"bogus_param": 1.0, "score": 0.5}]))
+        handler = surrogates.Atari100kHandler(data_path=str(path))
+        with pytest.raises(ValueError, match="bogus_param"):
+            handler.make_experimenter()
+
+    def test_bool_params_bind_as_python_bools(self):
+        from vizier_tpu.benchmarks.experimenters.surrogates import (
+            _gin_native_value,
+        )
+
+        assert _gin_native_value("JaxFullRainbowAgent.noisy", "False") is False
+        assert _gin_native_value("JaxFullRainbowAgent.noisy", "True") is True
+        # Non-bool params pass through untouched.
+        assert _gin_native_value("JaxDQNAgent.update_horizon", 7) == 7
+
+    def test_problem_statement_matches_table_columns(self, tmp_path):
+        import json
+
+        path = tmp_path / "atari.json"
+        path.write_text(
+            json.dumps(
+                [{"JaxDQNAgent.update_horizon": 3, "eval_average_return": 1.0}]
+            )
+        )
+        handler = surrogates.Atari100kHandler(data_path=str(path))
+        assert handler.problem_statement().search_space.parameter_names() == [
+            "JaxDQNAgent.update_horizon"
+        ]
+        # Without data: the full published space.
+        assert (
+            surrogates.Atari100kHandler().problem_statement()
+            .search_space.num_parameters()
+            == 14
+        )
+
+    def test_mismatched_row_columns_rejected(self, tmp_path):
+        import json
+
+        path = tmp_path / "atari.json"
+        path.write_text(
+            json.dumps(
+                [
+                    {"JaxDQNAgent.update_horizon": 3, "score": 1.0},
+                    {"JaxDQNAgent.update_period": 2, "score": 2.0},
+                ]
+            )
+        )
+        handler = surrogates.Atari100kHandler(data_path=str(path))
+        with pytest.raises(ValueError, match="differ from row"):
+            handler.make_experimenter()
+
+    def test_empty_table_rejected(self, tmp_path):
+        path = tmp_path / "atari.json"
+        path.write_text("[]")
+        handler = surrogates.Atari100kHandler(data_path=str(path))
+        with pytest.raises(ValueError, match="Empty Atari100k"):
+            handler.make_experimenter()

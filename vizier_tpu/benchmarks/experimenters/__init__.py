@@ -14,6 +14,7 @@ from vizier_tpu.benchmarks.experimenters.nasbench101 import (
     TabularNASBench101,
 )
 from vizier_tpu.benchmarks.experimenters.surrogates import (
+    Atari100kExperimenter,
     Atari100kHandler,
     HPOBHandler,
     NASBench201Handler,

@@ -365,41 +365,229 @@ class NASBench201Handler:
         )
 
 
+ATARI100K_AGENTS = ("DER", "DrQ", "DrQ_eps", "OTRainbow")
+
+
+def atari100k_search_space() -> pc.SearchSpace:
+    """The published Rainbow/Atari-100k tuning space (reference
+    ``atari100k_experimenter.py`` ``default_search_space``): gin-bindable
+    agent hyperparameters, names included."""
+    ss = pc.SearchSpace()
+    root = ss.root
+    root.add_float_param(
+        "JaxDQNAgent.gamma", 0.7, 0.999999,
+        scale_type=pc.ScaleType.REVERSE_LOG,
+    )
+    root.add_int_param("JaxDQNAgent.update_horizon", 1, 20)
+    root.add_int_param("JaxDQNAgent.update_period", 1, 10)
+    root.add_int_param("JaxDQNAgent.target_update_period", 1, 10000)
+    root.add_int_param("JaxDQNAgent.min_replay_history", 100, 100000)
+    root.add_float_param(
+        "JaxDQNAgent.epsilon_train", 1e-7, 1.0, scale_type=pc.ScaleType.LOG
+    )
+    root.add_int_param("JaxDQNAgent.epsilon_decay_period", 1000, 10000)
+    root.add_bool_param("JaxFullRainbowAgent.noisy")
+    root.add_bool_param("JaxFullRainbowAgent.dueling")
+    root.add_bool_param("JaxFullRainbowAgent.double_dqn")
+    root.add_int_param("JaxFullRainbowAgent.num_atoms", 1, 100)
+    root.add_bool_param("Atari100kRainbowAgent.data_augmentation")
+    root.add_float_param(
+        "create_optimizer.learning_rate", 1e-7, 1.0,
+        scale_type=pc.ScaleType.LOG,
+    )
+    root.add_float_param(
+        "create_optimizer.eps", 1e-7, 1.0, scale_type=pc.ScaleType.LOG
+    )
+    return ss
+
+
+def _atari100k_problem() -> base_study_config.ProblemStatement:
+    problem = base_study_config.ProblemStatement(
+        search_space=atari100k_search_space()
+    )
+    problem.metric_information.append(
+        base_study_config.MetricInformation(
+            name="eval_average_return",
+            goal=base_study_config.ObjectiveMetricGoal.MAXIMIZE,
+        )
+    )
+    return problem
+
+
+def _gin_native_value(name: str, value):
+    """Trial parameter → the python value gin must see.
+
+    Bool params travel as the strings "True"/"False" (categorical
+    encoding); binding those into gin would make every ``if noisy:`` check
+    truthy, so BOOLEAN-typed parameters convert back to real bools here.
+    """
+    cfg = _ATARI100K_PARAMS.get(name)
+    if cfg is not None and cfg.external_type == pc.ExternalType.BOOLEAN:
+        return str(value) == "True"
+    return value
+
+
+_ATARI100K_PARAMS = {p.name: p for p in atari100k_search_space().parameters}
+
+
+class Atari100kExperimenter(base.Experimenter):
+    """Live Atari-100k Rainbow tuning (reference ``Atari100kExperimenter``).
+
+    Each trial's parameters are gin bindings applied over the chosen agent
+    base config (DER / DrQ / DrQ_eps / OTRainbow); evaluation runs real
+    dopamine training + eval with ``eval_average_return`` as the
+    objective. The dopamine/gin stack is absent from this image, so
+    ``evaluate`` is import-gated; the problem surface (the published
+    14-parameter space) works everywhere. ``gin_config_dir`` must point at
+    the published agent configs (e.g. dopamine's or the reference's
+    ``atari100k_configs/`` directory — they are data, not shipped here).
+    """
+
+    def __init__(
+        self,
+        game_name: str = "Pong",
+        agent_name: str = "DER",
+        initial_gin_bindings: Optional[Dict] = None,
+        gin_config_dir: Optional[str] = None,
+    ):
+        if agent_name not in ATARI100K_AGENTS:
+            raise ValueError(
+                f"agent_name must be one of {ATARI100K_AGENTS}, got {agent_name!r}."
+            )
+        self._game_name = game_name
+        self._agent_name = agent_name
+        self._initial_gin_bindings = dict(initial_gin_bindings or {})
+        self._gin_config_dir = gin_config_dir
+
+    def problem_statement(self) -> base_study_config.ProblemStatement:
+        return _atari100k_problem()
+
+    def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
+        try:
+            import gin  # noqa: F401
+            from dopamine.labs.atari_100k import (  # noqa: F401
+                eval_run_experiment,
+            )
+        except ImportError as e:
+            raise ImportError(
+                "Atari100kExperimenter.evaluate needs the dopamine-rl + gin "
+                "stack (absent from this image) to run real Rainbow "
+                "training; use Atari100kHandler for offline tabular dumps."
+            ) from e
+        if not self._gin_config_dir:
+            raise ValueError(
+                "Pass gin_config_dir= pointing at the published Atari-100k "
+                "agent .gin configs (DER/DrQ/DrQ_eps/OTRainbow) to run live."
+            )
+        gin_file = os.path.join(
+            self._gin_config_dir, f"{self._agent_name}.gin"
+        )
+        _require_file(gin_file, "Atari100k gin config")
+        for t in suggestions:
+            with gin.unlock_config():
+                gin.parse_config_file(gin_file)
+                gin.bind_parameter(
+                    "atari_lib.create_atari_environment.game_name",
+                    self._game_name,
+                )
+                for name, value in self._initial_gin_bindings.items():
+                    gin.bind_parameter(name, value)
+                for name in t.parameters:
+                    gin.bind_parameter(
+                        name,
+                        _gin_native_value(name, t.parameters.get_value(name)),
+                    )
+            runner = eval_run_experiment.MaxEpisodeEvalRunner(base_dir="/tmp/")
+            statistics = runner.run_experiment()
+            final = trial_.Measurement(
+                metrics={
+                    "eval_average_return": float(
+                        statistics.data_lists["eval_average_return"][-1]
+                    )
+                }
+            )
+            t.complete(final)
+
+
 @dataclasses.dataclass
 class Atari100kHandler:
-    """Atari-100k RL-tuning surrogate handler (reference ``atari100k``).
+    """Atari-100k offline tabular handler over the REAL tuning space.
 
-    Expects a json table of {hyperparam columns..., "score": float} records
-    for one game; data is not bundled — pass the dump's path.
+    Expects a json table of records keyed by the published gin-parameter
+    names (``atari100k_search_space``) plus an ``eval_average_return``
+    metric column (the metric column — only — may also use the legacy name
+    ``score``); data is not bundled — pass the dump's path.
+
+    With ``data_path`` set, ``problem_statement()`` reflects the table's
+    columns — the (sub)space the dump actually swept — and matches
+    ``make_experimenter().problem_statement()`` exactly; without data it
+    returns the full published 14-parameter space.
     """
 
     data_path: Optional[str] = None
-    # The Atari100k search space of the reference experimenter.
-    _FLOATS = (
-        ("learning_rate", 1e-5, 1e-2, pc.ScaleType.LOG),
-        ("epsilon", 1e-8, 1e-3, pc.ScaleType.LOG),
-    )
-    _INTS = (("n_steps", 1, 20), ("update_horizon", 1, 20))
+
+    _VALUE_COLS = ("eval_average_return", "score")
 
     def problem_statement(self) -> base_study_config.ProblemStatement:
+        if self.data_path and os.path.exists(self.data_path):
+            rows, _ = self._load_table()
+            return self._table_problem(rows)
+        return _atari100k_problem()
+
+    def _load_table(self):
+        path = _require_file(self.data_path, "Atari100k")
+        with open(path) as f:
+            table = json.load(f)
+        if not table:
+            raise ValueError(f"Empty Atari100k table at {path!r}.")
+        full = set(_ATARI100K_PARAMS)
+        expected_keys = None
+        ys = []
+        rows = []
+        for i, row in enumerate(table):
+            param_keys = frozenset(k for k in row if k not in self._VALUE_COLS)
+            unknown = param_keys - full
+            if unknown:
+                raise ValueError(
+                    f"Unknown Atari100k column {sorted(unknown)[0]!r} in row "
+                    f"{i}; expected gin parameter names from "
+                    "atari100k_search_space()."
+                )
+            if expected_keys is None:
+                expected_keys = param_keys
+            elif param_keys != expected_keys:
+                raise ValueError(
+                    f"Row {i} columns {sorted(param_keys)} differ from row "
+                    f"0's {sorted(expected_keys)}; every row must sweep the "
+                    "same parameters."
+                )
+            for col in self._VALUE_COLS:
+                if col in row:
+                    ys.append(row[col])
+                    break
+            else:
+                raise ValueError(
+                    f"Row {i} needs an 'eval_average_return' (or legacy "
+                    "'score') metric column."
+                )
+            rows.append({k: row[k] for k in param_keys})
+        return rows, ys
+
+    def _table_problem(self, rows) -> base_study_config.ProblemStatement:
         problem = base_study_config.ProblemStatement()
-        for name, lo, hi, scale in self._FLOATS:
-            problem.search_space.root.add_float_param(name, lo, hi, scale_type=scale)
-        for name, lo, hi in self._INTS:
-            problem.search_space.root.add_int_param(name, lo, hi)
+        for name in sorted(rows[0]):
+            problem.search_space.root.add(_ATARI100K_PARAMS[name])
         problem.metric_information.append(
             base_study_config.MetricInformation(
-                name="score", goal=base_study_config.ObjectiveMetricGoal.MAXIMIZE
+                name="eval_average_return",
+                goal=base_study_config.ObjectiveMetricGoal.MAXIMIZE,
             )
         )
         return problem
 
     def make_experimenter(self) -> base.Experimenter:
-        path = _require_file(self.data_path, "Atari100k")
-        with open(path) as f:
-            table = json.load(f)
-        rows = [{k: v for k, v in row.items() if k != "score"} for row in table]
-        ys = [row["score"] for row in table]
+        rows, ys = self._load_table()
         return TabularSurrogateExperimenter(
-            self.problem_statement(), rows, ys, metric_name="score"
+            self._table_problem(rows), rows, ys,
+            metric_name="eval_average_return",
         )
