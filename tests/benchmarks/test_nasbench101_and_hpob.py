@@ -252,3 +252,63 @@ class TestHPOBHandler:
             h.evaluate_continuous(
                 object(), "5860", "145833", "test0", n_trials=1
             )
+
+
+class TestPredictorExperimenter:
+    """Reference surrogate_experimenter.py parity: a fitted GP serves as
+    the objective for benchmarking other algorithms."""
+
+    def test_gp_predictor_serves_objective(self):
+        from vizier_tpu.algorithms import core as core_lib
+        from vizier_tpu.benchmarks.experimenters.surrogates import (
+            PredictorExperimenter,
+        )
+        from vizier_tpu.designers.gp_bandit import VizierGPBandit
+        from vizier_tpu.optimizers.lbfgs import AdamOptimizer
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 1.0)
+        problem.metric_information.append(
+            vz.MetricInformation(
+                name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE
+            )
+        )
+        gp = VizierGPBandit(
+            problem, ard_restarts=2, ard_optimizer=AdamOptimizer(maxiter=20)
+        )
+        trials = []
+        for i, x in enumerate(np.linspace(0.0, 1.0, 8)):
+            t = vz.Trial(id=i + 1, parameters={"x": float(x)})
+            t.complete(
+                vz.Measurement(metrics={"obj": float(-(x - 0.7) ** 2)})
+            )
+            trials.append(t)
+        gp.update(core_lib.CompletedTrials(trials))
+
+        exp = PredictorExperimenter(gp, problem, seed=1)
+        probe = [
+            vz.Trial(id=100, parameters={"x": 0.7}),
+            vz.Trial(id=101, parameters={"x": 0.05}),
+        ]
+        exp.evaluate(probe)
+        near = probe[0].final_measurement.metrics["obj"].value
+        far = probe[1].final_measurement.metrics["obj"].value
+        # Surrogate preserves the objective's shape: 0.7 beats 0.05.
+        assert near > far
+        assert exp.problem_statement().search_space.num_parameters() == 1
+
+    def test_rejects_multi_objective(self):
+        from vizier_tpu.benchmarks.experimenters.surrogates import (
+            PredictorExperimenter,
+        )
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 1.0)
+        for name in ("a", "b"):
+            problem.metric_information.append(
+                vz.MetricInformation(
+                    name=name, goal=vz.ObjectiveMetricGoal.MAXIMIZE
+                )
+            )
+        with pytest.raises(ValueError, match="single-objective"):
+            PredictorExperimenter(object(), problem)

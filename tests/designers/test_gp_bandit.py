@@ -428,3 +428,33 @@ class TestReferencePointHelpers:
         labels = jnp.zeros((2, 3))
         mask = jnp.zeros((3,), bool)
         assert np.all(np.isfinite(acq.get_reference_point(labels, mask)))
+
+
+class TestPredictionUserScale:
+    def test_minimize_metric_predictions_are_not_sign_flipped(self):
+        """Regression: the model trains all-MAXIMIZE (flipped labels); the
+        Predictor contract is USER scale, so MINIMIZE predictions at an
+        observed point must land near the observed (positive) value."""
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 1.0)
+        problem.metric_information.append(
+            vz.MetricInformation(
+                name="loss", goal=vz.ObjectiveMetricGoal.MINIMIZE
+            )
+        )
+        d = VizierGPBandit(
+            problem, ard_restarts=2, ard_optimizer=_FAST_ARD, num_seed_trials=2
+        )
+        trials = []
+        for i, x in enumerate(np.linspace(0.0, 1.0, 8)):
+            t = vz.Trial(id=i + 1, parameters={"x": float(x)})
+            # Loss in [5, 9]: strictly positive user-space values.
+            t.complete(
+                vz.Measurement(metrics={"loss": float(5.0 + 4.0 * (x - 0.5) ** 2 * 4)})
+            )
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        pred = d.predict(
+            [vz.TrialSuggestion(parameters={"x": 0.5})], num_samples=500
+        )
+        assert 4.0 < float(pred.mean[0]) < 10.0, pred.mean

@@ -608,3 +608,37 @@ class TestRetraceDiscipline:
         size = mod._suggest_batch._cache_size()
         complete_round()  # 6 trials: 6+2 -> still the 8-bucket, no retrace
         assert mod._suggest_batch._cache_size() == size
+
+
+class TestPredictionUserScale:
+    def test_minimize_metrics_predict_in_user_scale(self):
+        """Multimetric: a MINIMIZE metric's predictions come back positive
+        (user scale), not negated into the model's all-MAXIMIZE space."""
+        p = vz.ProblemStatement()
+        p.search_space.root.add_float_param("x", 0.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(name="loss", goal=vz.ObjectiveMetricGoal.MINIMIZE)
+        )
+        p.metric_information.append(
+            vz.MetricInformation(name="acc", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+        )
+        d = _designer(p, num_seed_trials=1)
+        trials = []
+        for i, x in enumerate(np.linspace(0.0, 1.0, 8)):
+            t = vz.Trial(id=i + 1, parameters={"x": float(x)})
+            t.complete(
+                vz.Measurement(
+                    metrics={
+                        "loss": float(5.0 + (x - 0.5) ** 2),  # in [5, 5.25]
+                        "acc": float(0.9 - (x - 0.5) ** 2),  # in [0.65, 0.9]
+                    }
+                )
+            )
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        pred = d.predict(
+            [vz.TrialSuggestion(parameters={"x": 0.5})], num_samples=500
+        )
+        loss_mean, acc_mean = float(pred.mean[0, 0]), float(pred.mean[0, 1])
+        assert 4.5 < loss_mean < 5.8, pred.mean
+        assert 0.5 < acc_mean < 1.1, pred.mean

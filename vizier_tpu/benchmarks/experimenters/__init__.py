@@ -18,6 +18,7 @@ from vizier_tpu.benchmarks.experimenters.surrogates import (
     Atari100kHandler,
     HPOBHandler,
     NASBench201Handler,
+    PredictorExperimenter,
     TabularSurrogateExperimenter,
 )
 from vizier_tpu.benchmarks.experimenters.synthetic.classic import (

@@ -10,6 +10,7 @@ data is available; construction without data raises a clear error.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import json
 import os
@@ -591,3 +592,48 @@ class Atari100kHandler:
             self._table_problem(rows), rows, ys,
             metric_name="eval_average_return",
         )
+
+
+class PredictorExperimenter(base.Experimenter):
+    """Serves a trained ``Predictor``'s posterior mean as the objective.
+
+    Parity with the reference ``PredictorExperimenter``
+    (``surrogate_experimenter.py:26``): any designer implementing the
+    Predictor mixin (e.g. a GP bandit fit on real measurements) becomes a
+    cheap stand-in objective for benchmarking other algorithms.
+    """
+
+    def __init__(
+        self,
+        predictor,
+        problem_statement: base_study_config.ProblemStatement,
+        seed: int = 0,
+    ):
+        name = problem_statement.single_objective_metric_name
+        if name is None:
+            raise ValueError(
+                "PredictorExperimenter needs a single-objective problem."
+            )
+        self._predictor = predictor
+        self._problem = copy.deepcopy(problem_statement)
+        self._objective_name = name
+        self._rng = np.random.default_rng(seed)
+
+    def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
+        if not suggestions:
+            return
+        as_suggestions = [
+            trial_.TrialSuggestion(parameters=t.parameters)
+            for t in suggestions
+        ]
+        prediction = self._predictor.predict(as_suggestions, self._rng)
+        means = np.asarray(prediction.mean).reshape(len(suggestions), -1)
+        for t, mean in zip(suggestions, means):
+            t.complete(
+                trial_.Measurement(
+                    metrics={self._objective_name: float(mean[0])}
+                )
+            )
+
+    def problem_statement(self) -> base_study_config.ProblemStatement:
+        return copy.deepcopy(self._problem)

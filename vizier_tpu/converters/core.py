@@ -348,6 +348,18 @@ class MetricsEncoder:
                 labels[:, j] = -labels[:, j]
         return labels
 
+    def decode_column(self, values: np.ndarray, index: int) -> np.ndarray:
+        """model space → user space for ONE metric column (any shape).
+
+        The single owner of the flip rule — designers' ``sample``/``predict``
+        route through this so a converter built with
+        ``flip_signs_for_min=False`` never gets double-(un)flipped.
+        """
+        info = self._metrics[index]
+        if self._flip and info.goal == base_study_config.ObjectiveMetricGoal.MINIMIZE:
+            return -np.asarray(values)
+        return np.asarray(values)
+
 
 @dataclasses.dataclass(frozen=True)
 class TrialToModelInputConverter:

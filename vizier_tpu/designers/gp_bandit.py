@@ -182,6 +182,7 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             self.problem.search_space, seed=self.rng_seed
         )
         self._trials: List[trial_.Trial] = []
+        self._warper_fitted = False
         self._rng = jax.random.PRNGKey(self.rng_seed)
         self._last_predictive: Optional[gp_lib.EnsemblePredictive] = None
         # Production multi-chip path (SURVEY §2.10): when more than one
@@ -307,6 +308,7 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         conv = self._converter
         raw_labels = conv.metrics.encode(self._trials)  # [N, M], NaN infeasible
         warped = self._warper(raw_labels[:, self.metric_index])
+        self._warper_fitted = raw_labels.shape[0] > 0
         features, n_pad = self._padded_features(self._trials, extra_rows)
         return types.ModelData(
             features=features, labels=self._padded_labels(warped, n_pad)
@@ -429,6 +431,7 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         conv = self._converter
         raw = conv.metrics.encode(trials)
         warped = self._warper(raw[:, self.metric_index])
+        self._warper_fitted = raw.shape[0] > 0
         features, n_pad = self._padded_features(trials)
         return gp_lib.GPData.from_model_data(
             types.ModelData(features, self._padded_labels(warped, n_pad))
@@ -587,11 +590,15 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         mean, stddev = predictive.predict(feats)
         eps = jax.random.normal(rng, (num_samples,) + mean.shape, mean.dtype)
         warped = np.asarray(mean[None] + stddev[None] * eps)  # [S, T]
-        try:
-            return self._warper.unwarp(warped.reshape(-1, 1)).reshape(warped.shape)
-        except (ValueError, NotImplementedError):
-            # Warper not fitted yet (predict before any training labels).
+        if not self._warper_fitted:
+            # Predict before any training labels: the warped space IS the
+            # native space (prior samples on a fresh study).
             return warped
+        out = self._warper.unwarp(warped.reshape(-1, 1)).reshape(warped.shape)
+        # The model trains on sign-flipped (all-MAXIMIZE) labels; the
+        # converter owns the flip rule, so route back through it for
+        # genuine user-scale samples on MINIMIZE objectives.
+        return self._converter.metrics.decode_column(out, self.metric_index)
 
     def predict(
         self,

@@ -1012,9 +1012,15 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             out = np.moveaxis(out, 1, 2)
             return out[:, :, 0] if out.shape[-1] == 1 else out
         out = np.empty_like(warped)
-        for m, warper in enumerate(self._metric_warpers):
+        metrics_enc = self._converter.metrics
+        for m, (warper, idx) in enumerate(
+            zip(self._metric_warpers, self._objective_indices())
+        ):
             flat = warped[:, m, :].reshape(-1, 1)
-            out[:, m, :] = warper.unwarp(flat).reshape(warped.shape[0], -1)
+            unwarped = warper.unwarp(flat).reshape(warped.shape[0], -1)
+            # The converter owns the all-MAXIMIZE flip rule; route back
+            # through it so samples land in the user's metric scale.
+            out[:, m, :] = metrics_enc.decode_column(unwarped, idx)
         out = np.moveaxis(out, 1, 2)  # [S, T, M]
         return out[:, :, 0] if out.shape[-1] == 1 else out
 
