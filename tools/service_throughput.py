@@ -1,0 +1,75 @@
+"""Service throughput at the reference's stress configs, over real gRPC.
+
+Usage: python tools/service_throughput.py [--out SERVICE_THROUGHPUT.json]
+
+Reference ``performance_test.py:44-89`` runs clients×trials configs
+{1×10, 2×10, 10×10, 50×5, 100×5} on RANDOM_SEARCH over a 2-D space and
+logs wall time only. This tool runs the same topology against this repo's
+``DefaultVizierServer`` (one shared study per config, one thread per
+client, each doing its own suggest→complete loop over a real localhost
+gRPC channel) and prints a JSON report with wall time and trials/sec.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _honor_platform_env
+
+_honor_platform_env()
+
+
+CONFIGS = ((1, 10), (2, 10), (10, 10), (50, 5), (100, 5))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from vizier_tpu.service import clients as clients_lib
+    from vizier_tpu.service import vizier_server
+    from vizier_tpu.testing import stress
+
+    server = vizier_server.DefaultVizierServer(host="localhost")
+    clients_lib.environment_variables.server_endpoint = server.endpoint
+    report = {"topology": "one DefaultVizierServer, real localhost gRPC",
+              "algorithm": "RANDOM_SEARCH", "configs": []}
+    try:
+        for num_clients, trials_each in CONFIGS:
+            study = clients_lib.Study.from_study_config(
+                stress.stress_study_config(),
+                owner="perf",
+                study_id=f"tp-{num_clients}x{trials_each}",
+            )
+            wall, completed = stress.run_stress_round(
+                study, num_clients, trials_each
+            )
+            total = num_clients * trials_each
+            row = {
+                "clients": num_clients,
+                "trials_each": trials_each,
+                "total_trials": total,
+                "completed": completed,
+                "wall_s": round(wall, 3),
+                "trials_per_s": round(total / wall, 1),
+            }
+            report["configs"].append(row)
+            print(json.dumps(row), flush=True)
+            assert completed == total, (completed, total)
+    finally:
+        clients_lib.environment_variables.server_endpoint = clients_lib.NO_ENDPOINT
+        server.stop(0)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

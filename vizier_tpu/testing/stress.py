@@ -1,0 +1,55 @@
+"""Shared multi-client service-stress topology.
+
+One implementation of the reference ``performance_test.py:44-89`` load
+shape — a 2-D RANDOM_SEARCH study, N thread-pool clients each running
+their own suggest→complete loop — used by both the CI stress test
+(``tests/service/test_performance.py``) and the throughput measurement
+tool (``tools/service_throughput.py``) so the two cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import time
+from typing import Tuple
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.service import clients as clients_lib
+
+
+def stress_study_config() -> vz.StudyConfig:
+    sc = vz.StudyConfig()
+    sc.search_space.root.add_float_param("x", 0.0, 1.0)
+    sc.search_space.root.add_float_param("y", 0.0, 1.0)
+    sc.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MINIMIZE)
+    )
+    sc.algorithm = "RANDOM_SEARCH"
+    return sc
+
+
+def run_stress_round(
+    study: "clients_lib.Study", num_clients: int, trials_each: int
+) -> Tuple[float, int]:
+    """Runs the N-client suggest→complete round; returns (wall_s, completed).
+
+    ``completed`` counts COMPLETED trials only (an ACTIVE row left behind
+    by a dropped completion must not pass for throughput).
+    """
+
+    def worker(worker_id: int) -> None:
+        for _ in range(trials_each):
+            (trial,) = study.suggest(count=1, client_id=f"worker_{worker_id}")
+            x, y = float(trial.parameters["x"]), float(trial.parameters["y"])
+            trial.complete(
+                vz.Measurement(metrics={"obj": (x - 0.3) ** 2 + (y - 0.7) ** 2})
+            )
+
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(max_workers=num_clients) as pool:
+        list(pool.map(worker, range(num_clients)))
+    wall = time.perf_counter() - t0
+    completed = len(
+        list(study.trials(vz.TrialFilter(status=[vz.TrialStatus.COMPLETED])))
+    )
+    return wall, completed
