@@ -22,15 +22,9 @@ def server():
     return vizier_server.DefaultVizierServer(host="localhost")
 
 
-def _study_config():
-    sc = vz.StudyConfig()
-    sc.search_space.root.add_float_param("x", 0.0, 1.0)
-    sc.search_space.root.add_float_param("y", 0.0, 1.0)
-    sc.metric_information.append(
-        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MINIMIZE)
-    )
-    sc.algorithm = "RANDOM_SEARCH"
-    return sc
+from vizier_tpu.testing import stress
+
+_study_config = stress.stress_study_config
 
 
 @pytest.mark.parametrize(
@@ -47,34 +41,15 @@ def test_multi_client_suggest_complete_over_grpc(
             owner="perf",
             study_id=f"stress-{num_clients}x{num_trials_each}",
         )
-
-        def worker(worker_id: int):
-            my_ids = []
-            for _ in range(num_trials_each):
-                (trial,) = study.suggest(count=1, client_id=f"worker_{worker_id}")
-                x = trial.parameters["x"]
-                y = trial.parameters["y"]
-                trial.complete(
-                    vz.Measurement(
-                        metrics={"obj": (float(x) - 0.3) ** 2 + (float(y) - 0.7) ** 2}
-                    )
-                )
-                my_ids.append(trial.id)
-            return my_ids
-
-        t0 = time.time()
-        with cf.ThreadPoolExecutor(num_clients) as ex:
-            per_worker = list(ex.map(worker, range(num_clients)))
-        elapsed = time.time() - t0
-
+        # ONE shared topology with tools/service_throughput.py.
+        elapsed, completed, per_worker = stress.run_stress_round(
+            study, num_clients, num_trials_each
+        )
         all_ids = [tid for ids in per_worker for tid in ids]
         # Every worker's completions are distinct trials — no cross-worker
         # reuse, no lost updates under the per-study locks.
         assert len(set(all_ids)) == len(all_ids) == num_clients * num_trials_each
-        completed = list(
-            study.trials(vz.TrialFilter(status=[vz.TrialStatus.COMPLETED]))
-        )
-        assert len(completed) == num_clients * num_trials_each
+        assert completed == num_clients * num_trials_each
         print(
             f"[perf] {num_clients} clients x {num_trials_each} trials over gRPC: "
             f"{elapsed:.2f}s ({len(all_ids) / elapsed:.1f} trials/s)"

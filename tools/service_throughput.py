@@ -47,7 +47,7 @@ def main() -> None:
                 owner="perf",
                 study_id=f"tp-{num_clients}x{trials_each}",
             )
-            wall, completed = stress.run_stress_round(
+            wall, completed, _ = stress.run_stress_round(
                 study, num_clients, trials_each
             )
             total = num_clients * trials_each

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import time
-from typing import Tuple
+from typing import List, Tuple
 
 from vizier_tpu import pyvizier as vz
 from vizier_tpu.service import clients as clients_lib
@@ -30,26 +30,31 @@ def stress_study_config() -> vz.StudyConfig:
 
 def run_stress_round(
     study: "clients_lib.Study", num_clients: int, trials_each: int
-) -> Tuple[float, int]:
-    """Runs the N-client suggest→complete round; returns (wall_s, completed).
+) -> Tuple[float, int, List[List[int]]]:
+    """Runs the N-client suggest→complete round.
 
-    ``completed`` counts COMPLETED trials only (an ACTIVE row left behind
-    by a dropped completion must not pass for throughput).
+    Returns ``(wall_s, completed, per_worker_trial_ids)``: ``completed``
+    counts COMPLETED trials only (an ACTIVE row left behind by a dropped
+    completion must not pass for throughput), and the per-worker id lists
+    let callers assert cross-worker trial disjointness.
     """
 
-    def worker(worker_id: int) -> None:
+    def worker(worker_id: int) -> List[int]:
+        my_ids: List[int] = []
         for _ in range(trials_each):
             (trial,) = study.suggest(count=1, client_id=f"worker_{worker_id}")
             x, y = float(trial.parameters["x"]), float(trial.parameters["y"])
             trial.complete(
                 vz.Measurement(metrics={"obj": (x - 0.3) ** 2 + (y - 0.7) ** 2})
             )
+            my_ids.append(trial.id)
+        return my_ids
 
     t0 = time.perf_counter()
     with cf.ThreadPoolExecutor(max_workers=num_clients) as pool:
-        list(pool.map(worker, range(num_clients)))
+        per_worker = list(pool.map(worker, range(num_clients)))
     wall = time.perf_counter() - t0
     completed = len(
         list(study.trials(vz.TrialFilter(status=[vz.TrialStatus.COMPLETED])))
     )
-    return wall, completed
+    return wall, completed, per_worker
