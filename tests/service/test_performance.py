@@ -8,7 +8,6 @@ here are stronger: trial-count accounting and per-worker trial disjointness).
 """
 
 import concurrent.futures as cf
-import time
 
 import pytest
 
