@@ -84,3 +84,23 @@ def test_distributed_pythia_topology_under_load(server):
         assert len(completed) == 12
     finally:
         clients_lib.environment_variables.server_endpoint = clients_lib.NO_ENDPOINT
+
+
+class TestSharedChannelLifecycle:
+    def test_failed_ready_wait_evicts_entry_and_retries_fail_fast(self):
+        from vizier_tpu.service import grpc_stubs
+
+        dead = "127.0.0.1:1"  # nothing listens on port 1
+        for _ in range(2):  # retry must re-attempt readiness, not hang
+            with pytest.raises(Exception):
+                grpc_stubs.create_vizier_stub(dead, timeout=0.5)
+            assert dead not in grpc_stubs._CHANNELS
+
+    def test_channel_closed_and_evicted_on_server_stop(self):
+        from vizier_tpu.service import grpc_stubs
+
+        srv = vizier_server.DefaultVizierServer(host="localhost")
+        grpc_stubs.create_vizier_stub(srv.endpoint)
+        assert srv.endpoint in grpc_stubs._CHANNELS
+        srv.stop(0)
+        assert srv.endpoint not in grpc_stubs._CHANNELS

@@ -147,18 +147,54 @@ class PythiaServiceStub(_Stub):
 # hang inside `channel.subscribe` after ~900 tests). gRPC channels are
 # thread-safe and auto-reconnect, so sharing per endpoint is the intended
 # usage.
+#
+# The ready-wait runs ONLY on first creation (every channel_ready_future
+# subscribes a connectivity-watcher thread; re-subscribing per stub churns
+# threads and races channel.close() at server stop). Concurrent callers
+# share the creator's outcome via the entry's event, and a failed
+# ready-wait evicts the entry so retries re-attempt readiness instead of
+# receiving a never-connected channel.
 _CHANNEL_LOCK = threading.Lock()
-_CHANNELS: Dict[str, grpc.Channel] = {}
+
+
+class _ChannelEntry:
+    def __init__(self, channel: grpc.Channel):
+        self.channel = channel
+        self.ready = threading.Event()
+        self.error: Any = None
+
+
+_CHANNELS: Dict[str, _ChannelEntry] = {}
 
 
 def _shared_channel(endpoint: str, timeout: float) -> grpc.Channel:
     with _CHANNEL_LOCK:
-        channel = _CHANNELS.get(endpoint)
-        if channel is None:
-            channel = grpc.insecure_channel(endpoint)
-            _CHANNELS[endpoint] = channel
-    grpc.channel_ready_future(channel).result(timeout=timeout)
-    return channel
+        entry = _CHANNELS.get(endpoint)
+        fresh = entry is None
+        if fresh:
+            entry = _ChannelEntry(grpc.insecure_channel(endpoint))
+            _CHANNELS[endpoint] = entry
+    if fresh:
+        try:
+            grpc.channel_ready_future(entry.channel).result(timeout=timeout)
+        except Exception as e:  # timeout or connectivity failure
+            entry.error = e
+            with _CHANNEL_LOCK:
+                if _CHANNELS.get(endpoint) is entry:
+                    del _CHANNELS[endpoint]
+            entry.ready.set()  # release concurrent waiters with the error
+            entry.channel.close()
+            raise
+        entry.ready.set()
+        return entry.channel
+    # Cached: wait for the creator's ready outcome (usually already set).
+    if not entry.ready.wait(timeout=timeout):
+        raise grpc.FutureTimeoutError(
+            f"Channel to {endpoint} not ready within {timeout}s."
+        )
+    if entry.error is not None:
+        raise entry.error
+    return entry.channel
 
 
 def close_channel(endpoint: str) -> None:
@@ -169,9 +205,9 @@ def close_channel(endpoint: str) -> None:
     otherwise leave one live channel behind forever).
     """
     with _CHANNEL_LOCK:
-        channel = _CHANNELS.pop(endpoint, None)
-    if channel is not None:
-        channel.close()
+        entry = _CHANNELS.pop(endpoint, None)
+    if entry is not None:
+        entry.channel.close()
 
 
 def create_vizier_stub(endpoint: str, timeout: float = 10.0) -> VizierServiceStub:
