@@ -438,3 +438,95 @@ class TestAtari100k:
         handler = surrogates.Atari100kHandler(data_path=str(path))
         with pytest.raises(ValueError, match="Empty Atari100k"):
             handler.make_experimenter()
+
+
+class TestMAXSAT:
+    WCNF = (
+        "c tiny instance\n"
+        "p wcnf 3 4\n"
+        "2.0 1 -2 0\n"
+        "1.0 2 3 0\n"
+        "4.0 -1 0\n"
+        "3.0 -3 0\n"
+    )
+
+    def test_parse_shapes_and_header(self):
+        n, w, var_idx, want_true, mask = combinatorial.parse_wcnf(self.WCNF)
+        assert n == 3
+        assert w.shape == (4,)
+        assert var_idx.shape == want_true.shape == mask.shape == (4, 2)
+        assert mask[2, 1] == False  # unit clause padded
+        np.testing.assert_array_equal(var_idx[0], [0, 1])
+        np.testing.assert_array_equal(want_true[0], [True, False])
+
+    def test_header_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            combinatorial.parse_wcnf("p wcnf 2 5\n1.0 1 0\n")
+
+    def test_matches_naive_reference_semantics(self):
+        rng = np.random.default_rng(7)
+        text = combinatorial.random_wcnf(8, 20, rng)
+        exp = combinatorial.MAXSATExperimenter(text)
+        n, raw_w, _, _, _ = combinatorial.parse_wcnf(text)
+        w = (raw_w - raw_w.mean()) / raw_w.std()
+        # Naive per-clause loop (reference combo_experimenter.py:409-420).
+        clauses = []
+        for line in text.splitlines():
+            if line.startswith(("c", "p")) or not line.strip():
+                continue
+            lits = [int(p) for p in line.split()[1:-1]]
+            clauses.append(([abs(l) - 1 for l in lits], [l > 0 for l in lits]))
+        for code in rng.integers(0, 2**8, size=16):
+            x = np.array([(code >> i) & 1 for i in range(8)], dtype=bool)
+            sat = np.array(
+                [(x[idx] == np.array(sgn)).any() for idx, sgn in clauses]
+            )
+            expected = -np.sum(w * sat)
+            got = exp.evaluate_batch(x[None])[0]
+            np.testing.assert_allclose(got, expected, rtol=1e-12)
+
+    def test_evaluate_trials_and_problem(self):
+        exp = combinatorial.MAXSATExperimenter(self.WCNF)
+        problem = exp.problem_statement()
+        assert len(problem.search_space.parameter_names()) == 3
+        t = trial_.Trial(
+            id=1, parameters={"x_0": False, "x_1": False, "x_2": False}
+        )
+        exp.evaluate([t])
+        assert t.final_measurement is not None
+        # All-false satisfies clauses 1 (-2), 3 (-1), 4 (-3), not clause 2.
+        w = np.array([2.0, 1.0, 4.0, 3.0])
+        wz = (w - w.mean()) / w.std()
+        expected = -(wz[0] + wz[2] + wz[3])
+        np.testing.assert_allclose(
+            t.final_measurement.metrics["main_objective"].value, expected, rtol=1e-6
+        )
+
+    def test_constant_weights_keep_raw_signal(self):
+        # Unweighted instances must not z-score to a flat-zero objective.
+        text = "p wcnf 2 2\n1.0 1 0\n1.0 2 0\n"
+        exp = combinatorial.MAXSATExperimenter(text)
+        v = exp.evaluate_batch(np.array([[True, True], [False, False]]))
+        assert np.isfinite(v).all()
+        np.testing.assert_allclose(v, [-2.0, 0.0])
+
+    def test_random_designer_loop(self):
+        rng = np.random.default_rng(3)
+        exp = combinatorial.MAXSATExperimenter(combinatorial.random_wcnf(6, 12, rng))
+        designer = RandomDesigner(exp.problem_statement().search_space, seed=1)
+        best = _run_designer_loop(designer, exp, n_rounds=4, batch=3)
+        assert np.isfinite(best)
+
+    def test_multiple_clauses_per_line(self):
+        # DIMACS permits several "weight lits 0" groups on one line; a
+        # mid-line 0 is a clause boundary, not a literal.
+        one_per_line = "p wcnf 3 2\n2.0 1 -2 0\n3.0 3 0\n"
+        merged = "p wcnf 3 2\n2.0 1 -2 0 3.0 3 0\n"
+        a = combinatorial.MAXSATExperimenter(one_per_line)
+        b = combinatorial.MAXSATExperimenter(merged)
+        X = np.array([[0, 0, 0], [1, 1, 1], [1, 0, 1], [0, 1, 0]], dtype=bool)
+        np.testing.assert_allclose(a.evaluate_batch(X), b.evaluate_batch(X))
+
+    def test_no_clauses_raises(self):
+        with pytest.raises(ValueError, match="no clauses"):
+            combinatorial.parse_wcnf("p wcnf 3 0\n")

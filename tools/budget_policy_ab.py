@@ -1,6 +1,6 @@
 """A/B of the acquisition budget policies on shifted 20-D BBOB.
 
-Usage: python tools/budget_policy_ab.py [--trials 150] [--seeds 1 2]
+Usage: python tools/budget_policy_ab.py [--trials 150] [--seeds 1 2 3 4 5]
 
 Compares first_pick_full (the shipped default: full budget on the
 exploitation pick, one further budget split across the exploration picks)
@@ -20,7 +20,8 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
 
 from __graft_entry__ import _honor_platform_env
 
@@ -34,7 +35,7 @@ def main() -> None:
     ap.add_argument("--trials", type=int, default=150)
     ap.add_argument("--batch", type=int, default=10)
     ap.add_argument("--evals", type=int, default=25_000)
-    ap.add_argument("--seeds", type=int, nargs="+", default=[1, 2])
+    ap.add_argument("--seeds", type=int, nargs="+", default=[1, 2, 3, 4, 5])
     args = ap.parse_args()
 
     from vizier_tpu.algorithms import core as core_lib
@@ -42,11 +43,22 @@ def main() -> None:
     from vizier_tpu.designers.gp_ucb_pe import VizierGPUCBPEBandit
 
     results: dict = {}
-    for fn_name in ("Sphere", "Rastrigin"):
+    # Two 20-D BBOB families plus a low-D classic (Branin in the BBOB
+    # frame, bbob.EXTRA_FUNCTIONS) so the DEFAULT-policy evidence does not
+    # rest on one dimensionality regime (r4 verdict weak #2).
+    configs = (("Sphere", 20), ("Rastrigin", 20), ("Branin", 2))
+    # Optimum VALUE of each objective (shift moves the argmin, not the
+    # minimum): Sphere/Rastrigin are 0 at the optimum; Branin ≈ 0.397887
+    # (synthetic/bbob.py:381). Subtracted so "final_regret" is a true
+    # regret, comparable across functions.
+    optima = {"Sphere": 0.0, "Rastrigin": 0.0, "Branin": 0.3978873577}
+    for fn_name, dim in configs:
         for policy in ("first_pick_full", "per_batch", "per_pick"):
             finals = []
             for seed in args.seeds:
-                exp = experimenter_factory.shifted_bbob_instance(fn_name, seed)
+                exp = experimenter_factory.shifted_bbob_instance(
+                    fn_name, seed, dim=dim
+                )
                 problem = exp.problem_statement()
                 designer = VizierGPUCBPEBandit(
                     problem,
@@ -71,11 +83,13 @@ def main() -> None:
                             t.final_measurement.metrics["bbob_eval"].value,
                         )
                 elapsed = time.perf_counter() - t0
+                best -= optima[fn_name]
                 finals.append(best)
                 print(
                     json.dumps(
                         {
                             "fn": fn_name,
+                            "dim": dim,
                             "policy": policy,
                             "seed": seed,
                             "final_regret": round(best, 4),
@@ -84,12 +98,27 @@ def main() -> None:
                     ),
                     flush=True,
                 )
-            results[(fn_name, policy)] = finals
+            results[(f"{fn_name}{dim}d", policy)] = finals
     print("== summary (median final regret, lower better) ==", flush=True)
     summary = {}
-    for (fn_name, policy), finals in results.items():
-        summary[f"{fn_name}:{policy}"] = float(np.median(finals))
+    for (cfg_name, policy), finals in results.items():
+        summary[f"{cfg_name}:{policy}"] = float(np.median(finals))
     print(json.dumps(summary, indent=1))
+    artifact = {
+        "seeds": args.seeds,
+        "trials": args.trials,
+        "batch": args.batch,
+        "evals": args.evals,
+        "per_run": {
+            f"{cfg}:{pol}": [round(v, 4) for v in finals]
+            for (cfg, pol), finals in results.items()
+        },
+        "median_final_regret": summary,
+    }
+    out = os.path.join(_REPO_ROOT, "budget_ab_r5.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {out}", flush=True)
 
 
 if __name__ == "__main__":

@@ -7,6 +7,7 @@ from vizier_tpu.benchmarks.experimenters.combinatorial import (
     ContaminationExperimenter,
     IsingExperimenter,
     L1CategoricalExperimenter,
+    MAXSATExperimenter,
     PestControlExperimenter,
 )
 from vizier_tpu.benchmarks.experimenters.nasbench101 import (

@@ -449,3 +449,153 @@ class L1CategoricalExperimenter(base.Experimenter):
 
     def problem_statement(self) -> base_study_config.ProblemStatement:
         return copy.deepcopy(self._problem)
+
+
+# ---------------------------------------------------------------------------
+# MAXSAT (weighted CNF).
+# ---------------------------------------------------------------------------
+
+
+def parse_wcnf(
+    text: str,
+) -> Tuple[int, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Parse DIMACS WCNF into padded clause tensors.
+
+    Returns ``(n_variables, weights [C], var_idx [C, L], want_true [C, L],
+    literal_mask [C, L])`` where ``L`` is the longest clause. Mirrors the
+    reference's parse (``combo_experimenter.py:384-404``: header ``p wcnf
+    V C``, per-line ``weight lit ... 0``) but materializes the clauses as
+    padded arrays so evaluation is one vectorized reduction instead of a
+    per-clause python loop.
+    """
+    n_variables = n_clauses = None
+    weights: List[float] = []
+    clauses: List[List[int]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("c"):
+            continue
+        if line.startswith("p "):
+            parts = line.split()
+            n_variables, n_clauses = int(parts[2]), int(parts[3])
+            continue
+        # DIMACS allows several "weight lit... 0" clauses on one line; walk
+        # the token stream splitting at each 0 terminator so a mid-line 0 is
+        # a clause boundary, never a literal.
+        tokens = line.split()
+        pos = 0
+        while pos < len(tokens):
+            weight = float(tokens[pos])
+            pos += 1
+            lits: List[int] = []
+            while pos < len(tokens) and tokens[pos] != "0":
+                lits.append(int(tokens[pos]))
+                pos += 1
+            pos += 1  # skip the 0 terminator (or run off a missing one)
+            if not lits:
+                continue
+            weights.append(weight)
+            clauses.append(lits)
+    if n_variables is None:
+        raise ValueError("WCNF text has no 'p wcnf <vars> <clauses>' header.")
+    if not clauses:
+        raise ValueError("WCNF text contains no clauses.")
+    if n_clauses is not None and len(clauses) != n_clauses:
+        raise ValueError(
+            f"WCNF header declares {n_clauses} clauses, found {len(clauses)}."
+        )
+    max_len = max(len(c) for c in clauses)
+    var_idx = np.zeros((len(clauses), max_len), dtype=np.int64)
+    want_true = np.zeros((len(clauses), max_len), dtype=bool)
+    mask = np.zeros((len(clauses), max_len), dtype=bool)
+    for i, lits in enumerate(clauses):
+        for j, lit in enumerate(lits):
+            var_idx[i, j] = abs(lit) - 1
+            want_true[i, j] = lit > 0
+            mask[i, j] = True
+    if var_idx.max() >= n_variables:
+        raise ValueError("WCNF clause references a variable beyond the header.")
+    return n_variables, np.asarray(weights, np.float64), var_idx, want_true, mask
+
+
+def random_wcnf(
+    n_variables: int, n_clauses: int, rng: np.random.Generator, max_clause_len: int = 3
+) -> str:
+    """Synthetic DIMACS WCNF text (for tests; no COMBO data download)."""
+    lines = [f"c synthetic random wcnf", f"p wcnf {n_variables} {n_clauses}"]
+    for _ in range(n_clauses):
+        k = int(rng.integers(1, max_clause_len + 1))
+        vars_ = rng.choice(n_variables, size=k, replace=False) + 1
+        signs = rng.integers(0, 2, size=k) * 2 - 1
+        w = float(rng.uniform(1.0, 10.0))
+        lits = " ".join(str(int(v * s)) for v, s in zip(vars_, signs))
+        lines.append(f"{w:.3f} {lits} 0")
+    return "\n".join(lines) + "\n"
+
+
+class MAXSATExperimenter(base.Experimenter):
+    """Weighted MAXSAT over boolean assignments.
+
+    Parity target: ``combo_experimenter.py:380-447`` (MAXSATExperimenter) —
+    same normalized-weight objective ``-Σ w̃_c · satisfied_c`` (MINIMIZE,
+    weights z-scored across clauses) and the same ``x_i`` bool search
+    space. Evaluation here is batched: all suggestions' assignments are
+    stacked into ``[B, n]`` and every clause is checked with one gather +
+    ``any`` reduction over the padded literal tensors.
+
+    Data files (maxsat2018 ``.wcnf``) are external downloads in the
+    reference too; use :meth:`from_file` when present, or construct
+    directly from WCNF text (``random_wcnf`` for synthetic instances).
+    """
+
+    def __init__(self, wcnf_text: str):
+        (
+            self._n_variables,
+            raw_weights,
+            self._var_idx,
+            self._want_true,
+            self._mask,
+        ) = parse_wcnf(wcnf_text)
+        std = np.std(raw_weights)
+        # Reference z-scores clause weights (combo_experimenter.py:396-399).
+        # Unweighted instances (all weights equal) would z-score to an
+        # identically-zero objective; keep the raw weights there so the
+        # clause-count signal survives.
+        if std:
+            self._weights = (raw_weights - np.mean(raw_weights)) / std
+        else:
+            self._weights = raw_weights
+        self._problem = _bool_problem(self._n_variables)
+
+    @classmethod
+    def from_file(cls, path: str) -> "MAXSATExperimenter":
+        with open(path, "rt") as f:
+            return cls(f.read())
+
+    @property
+    def num_variables(self) -> int:
+        return self._n_variables
+
+    @property
+    def num_clauses(self) -> int:
+        return len(self._weights)
+
+    def evaluate_batch(self, assignments: np.ndarray) -> np.ndarray:
+        """``[B, n] bool -> [B]`` objective values (vectorized)."""
+        x = np.asarray(assignments, dtype=bool)
+        lit_ok = x[:, self._var_idx] == self._want_true[None]  # [B, C, L]
+        satisfied = (lit_ok & self._mask[None]).any(axis=-1)  # [B, C]
+        return -(satisfied @ self._weights)
+
+    def evaluate(self, suggestions: Sequence[trial_.Trial]) -> None:
+        if not suggestions:
+            return
+        x = np.stack(
+            [_bool_vector(t, self._n_variables).astype(bool) for t in suggestions]
+        )
+        values = self.evaluate_batch(x)
+        for t, v in zip(suggestions, values):
+            t.complete(trial_.Measurement(metrics={"main_objective": float(v)}))
+
+    def problem_statement(self) -> base_study_config.ProblemStatement:
+        return copy.deepcopy(self._problem)

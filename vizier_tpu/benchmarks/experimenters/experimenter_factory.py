@@ -75,9 +75,11 @@ def shifted_bbob_instance(
     shift = np.random.default_rng(1000 + seed).uniform(
         -shift_range, shift_range, size=dim
     )
+    fn = bbob.BBOB_FUNCTIONS.get(fn_name) or bbob.EXTRA_FUNCTIONS.get(fn_name)
+    if fn is None:
+        valid = sorted(bbob.BBOB_FUNCTIONS) + sorted(bbob.EXTRA_FUNCTIONS)
+        raise ValueError(f"Unknown function {fn_name!r}; choices: {valid}")
     return wrappers.ShiftingExperimenter(
-        base.NumpyExperimenter(
-            bbob.BBOB_FUNCTIONS[fn_name], base.bbob_problem(dim)
-        ),
+        base.NumpyExperimenter(fn, base.bbob_problem(dim)),
         shift=shift,
     )
