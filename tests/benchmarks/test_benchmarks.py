@@ -223,3 +223,91 @@ class TestRunnerAndAnalyzers:
         )
         best = min(t.final_measurement.metrics["bbob_eval"].value for t in trials)
         assert best == pytest.approx(0.0, abs=1e-9)
+
+
+class TestNoiseTypes:
+    """Per-type parity for the BBOB-noisy model zoo (wrappers.make_noise_fn)."""
+
+    def _fn(self, noise_type, dim=4, seed=7):
+        return wrappers.make_noise_fn(
+            noise_type, dimension=dim, rng=np.random.default_rng(seed)
+        )
+
+    def test_no_noise_identity(self):
+        fn = self._fn("NO_NOISE")
+        # Stabilization still applies its floor offset above target_value.
+        assert fn(5.0) == pytest.approx(5.0 + 1.01e-8)
+        assert fn(1e-12) == 1e-12
+
+    def test_gaussian_matches_lognormal_formula(self):
+        for sev, sigma in [("MODERATE", 0.01), ("SEVERE", 0.1)]:
+            fn = self._fn(f"{sev}_GAUSSIAN", seed=3)
+            ref_rng = np.random.default_rng(3)
+            expected = 5.0 * ref_rng.lognormal(0.0, sigma) + 1.01e-8
+            assert fn(5.0) == pytest.approx(expected, rel=1e-12)
+
+    def test_uniform_matches_formula(self):
+        dim = 5
+        for sev, e in [("MODERATE", 0.01), ("SEVERE", 0.1)]:
+            fn = self._fn(f"{sev}_UNIFORM", dim=dim, seed=11)
+            ref_rng = np.random.default_rng(11)
+            v = 3.0
+            shrink = ref_rng.uniform() ** max(0.0, e)
+            amplify = (1e9 / (v + 1e-99)) ** (e * (0.49 + 1.0 / dim) * ref_rng.uniform())
+            expected = v * shrink * max(1.0, amplify) + 1.01e-8
+            assert fn(v) == pytest.approx(expected, rel=1e-12)
+
+    def test_cauchy_matches_formula(self):
+        for sev, (strength, freq) in [
+            ("MODERATE", (0.01, 0.05)),
+            ("SEVERE", (0.1, 0.25)),
+        ]:
+            fn = self._fn(f"{sev}_SELDOM_CAUCHY", seed=13)
+            ref_rng = np.random.default_rng(13)
+            v = 2.0
+            c = (ref_rng.uniform() < freq) * ref_rng.standard_cauchy()
+            expected = v + strength * max(0.0, 1000.0 + c) + 1.01e-8
+            assert fn(v) == pytest.approx(expected, rel=1e-12)
+
+    def test_additive_gaussian_no_stabilization(self):
+        for sev, std in [("LIGHT", 0.01), ("MODERATE", 0.1), ("SEVERE", 1.0)]:
+            fn = self._fn(f"{sev}_ADDITIVE_GAUSSIAN", seed=17)
+            ref_rng = np.random.default_rng(17)
+            assert fn(1.0) == pytest.approx(1.0 + ref_rng.normal(0.0, std))
+            # Below-target values are noised too (additive is unstabilized).
+            assert fn(0.0) != 0.0
+
+    def test_stabilization_passes_near_optimum(self):
+        fn = self._fn("SEVERE_UNIFORM")
+        assert fn(1e-9) == 1e-9  # below target_value: untouched
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(ValueError, match="Unknown noise type"):
+            self._fn("EXTREME_LAPLACE")
+
+    def test_from_type_preserves_before_noise(self):
+        sphere = benchmarks.NumpyExperimenter(
+            bbob.Sphere, benchmarks.bbob_problem(2)
+        )
+        exp = wrappers.NoisyExperimenter.from_type(
+            sphere, "SEVERE_GAUSSIAN", seed=1
+        )
+        t = vz.Trial(id=1, parameters={"x0": 1.0, "x1": 1.0})
+        exp.evaluate([t])
+        m = t.final_measurement.metrics
+        assert m["bbob_eval_before_noise"].value == pytest.approx(2.0)
+        assert m["bbob_eval"].value != m["bbob_eval_before_noise"].value
+
+    def test_all_types_run_through_experimenter(self):
+        for noise_type in wrappers.NOISE_TYPES:
+            sphere = benchmarks.NumpyExperimenter(
+                bbob.Sphere, benchmarks.bbob_problem(3)
+            )
+            exp = wrappers.NoisyExperimenter.from_type(sphere, noise_type, seed=2)
+            t = vz.Trial(id=1, parameters={"x0": 0.5, "x1": -0.5, "x2": 1.5})
+            exp.evaluate([t])
+            assert np.isfinite(t.final_measurement.metrics["bbob_eval"].value)
+
+    def test_known_family_unknown_severity_raises(self):
+        with pytest.raises(ValueError, match="Unknown noise type"):
+            self._fn("LIGHT_GAUSSIAN")

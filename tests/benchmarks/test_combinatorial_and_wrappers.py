@@ -530,3 +530,103 @@ class TestMAXSAT:
     def test_no_clauses_raises(self):
         with pytest.raises(ValueError, match="no clauses"):
             combinatorial.parse_wcnf("p wcnf 3 0\n")
+
+
+class TestAtari100kLivePath:
+    """Executes Atari100kExperimenter.evaluate's gin-binding + bool
+    conversion with stub gin/dopamine modules (the real stack is absent)."""
+
+    def _install_stubs(self, monkeypatch, tmp_path, final_return=42.0):
+        import contextlib
+        import sys
+        import types
+
+        bindings = {}
+        parsed_files = []
+
+        gin_stub = types.ModuleType("gin")
+        gin_stub.unlock_config = contextlib.nullcontext
+        gin_stub.parse_config_file = parsed_files.append
+        gin_stub.bind_parameter = lambda name, value: bindings.__setitem__(
+            name, value
+        )
+
+        class FakeStatistics:
+            data_lists = {"eval_average_return": [10.0, final_return]}
+
+        class FakeRunner:
+            def __init__(self, base_dir):
+                self.base_dir = base_dir
+
+            def run_experiment(self):
+                return FakeStatistics()
+
+        eval_mod = types.ModuleType("dopamine.labs.atari_100k.eval_run_experiment")
+        eval_mod.MaxEpisodeEvalRunner = FakeRunner
+        atari_mod = types.ModuleType("dopamine.labs.atari_100k")
+        atari_mod.eval_run_experiment = eval_mod
+        labs_mod = types.ModuleType("dopamine.labs")
+        labs_mod.atari_100k = atari_mod
+        dopamine_mod = types.ModuleType("dopamine")
+        dopamine_mod.labs = labs_mod
+
+        monkeypatch.setitem(sys.modules, "gin", gin_stub)
+        monkeypatch.setitem(sys.modules, "dopamine", dopamine_mod)
+        monkeypatch.setitem(sys.modules, "dopamine.labs", labs_mod)
+        monkeypatch.setitem(sys.modules, "dopamine.labs.atari_100k", atari_mod)
+        monkeypatch.setitem(
+            sys.modules,
+            "dopamine.labs.atari_100k.eval_run_experiment",
+            eval_mod,
+        )
+        gin_dir = tmp_path / "configs"
+        gin_dir.mkdir()
+        (gin_dir / "DER.gin").write_text("# stub agent config\n")
+        return bindings, parsed_files, str(gin_dir)
+
+    def test_evaluate_binds_and_completes(self, monkeypatch, tmp_path):
+        bindings, parsed, gin_dir = self._install_stubs(monkeypatch, tmp_path)
+        exp = surrogates.Atari100kExperimenter(
+            game_name="Breakout",
+            agent_name="DER",
+            initial_gin_bindings={"Runner.num_iterations": 1},
+            gin_config_dir=gin_dir,
+        )
+        t = trial_.Trial(
+            id=1,
+            parameters={
+                "JaxDQNAgent.gamma": 0.97,
+                "JaxFullRainbowAgent.noisy": False,
+                "JaxFullRainbowAgent.dueling": True,
+                "JaxDQNAgent.update_horizon": 3,
+            },
+        )
+        exp.evaluate([t])
+        assert t.final_measurement.metrics["eval_average_return"].value == 42.0
+        assert parsed and parsed[0].endswith("DER.gin")
+        assert (
+            bindings["atari_lib.create_atari_environment.game_name"]
+            == "Breakout"
+        )
+        assert bindings["Runner.num_iterations"] == 1
+        # Bool parameters must arrive as real bools, not "True"/"False"
+        # strings (a truthy-string bind would flip every agent flag on).
+        assert bindings["JaxFullRainbowAgent.noisy"] is False
+        assert bindings["JaxFullRainbowAgent.dueling"] is True
+        assert bindings["JaxDQNAgent.gamma"] == pytest.approx(0.97)
+
+    def test_missing_gin_dir_raises(self, monkeypatch, tmp_path):
+        self._install_stubs(monkeypatch, tmp_path)
+        exp = surrogates.Atari100kExperimenter(agent_name="DrQ")
+        t = trial_.Trial(id=1, parameters={"JaxDQNAgent.gamma": 0.9})
+        with pytest.raises(ValueError, match="gin_config_dir"):
+            exp.evaluate([t])
+
+    def test_missing_agent_config_raises(self, monkeypatch, tmp_path):
+        _, _, gin_dir = self._install_stubs(monkeypatch, tmp_path)
+        exp = surrogates.Atari100kExperimenter(
+            agent_name="OTRainbow", gin_config_dir=gin_dir
+        )
+        t = trial_.Trial(id=1, parameters={"JaxDQNAgent.gamma": 0.9})
+        with pytest.raises(FileNotFoundError):
+            exp.evaluate([t])

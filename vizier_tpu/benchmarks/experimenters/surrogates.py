@@ -111,10 +111,13 @@ class HPOBHandler:
     initial points chosen by the published ``bo-initializations`` ids, then
     ``n_trials`` rounds of the method's ``observe_and_suggest(X_obs, y_obs,
     X_pen) -> index`` over the remaining tabulated candidates, returning
-    the normalized incumbent trace. ``evaluate_continuous`` (XGBoost
-    surrogates, reference ``handler.py:232``) is gated on xgboost being
-    importable. Loading is lazy so constructing a handler without data is
-    cheap; the first data access raises ``FileNotFoundError``.
+    the normalized incumbent trace. ``evaluate_continuous`` (reference
+    ``handler.py:232``) runs the full continuous protocol — published init
+    ids, stats-normalized labels, surrogate-scored free suggestions — with
+    only the XGBoost model serving gated on the library (inject
+    ``predictor=`` to run without it). Loading is lazy so constructing a
+    handler without data is cheap; the first data access raises
+    ``FileNotFoundError``.
     """
 
     SEEDS = ("test0", "test1", "test2", "test3", "test4")
@@ -127,10 +130,10 @@ class HPOBHandler:
         mode: str = "v3-test",
         surrogates_dir: Optional[str] = None,
     ):
-        """``surrogates_dir`` mirrors the reference signature for the
-        continuous protocol's saved XGBoost surrogates; serving them is NOT
-        implemented (xgboost is absent from this image), so it is stored
-        for forward compatibility only — ``evaluate_continuous`` raises."""
+        """``surrogates_dir`` holds the continuous protocol's saved
+        surrogate dumps plus ``summary-stats.json`` (y_min/y_max per
+        surrogate); only :meth:`surrogate_predictor`'s XGBoost call needs
+        the library itself."""
         if mode not in self.MODES:
             raise ValueError(
                 f"Unknown HPO-B mode {mode!r}; choices: {list(self.MODES)}"
@@ -208,8 +211,14 @@ class HPOBHandler:
     def normalize(y, y_min=None, y_max=None):
         y = np.asarray(y, dtype=np.float64)
         if y_min is None:
-            return (y - np.min(y)) / (np.max(y) - np.min(y))
-        return (y - y_min) / (y_max - y_min)
+            y_min, y_max = np.min(y), np.max(y)
+        span = y_max - y_min
+        if span == 0:
+            # Constant-y dataset (or single row): a 0/0 here would poison
+            # every incumbent trace with NaN; all-zeros is the only value
+            # consistent with "distance above the minimum".
+            return np.zeros_like(y)
+        return (y - y_min) / span
 
     def evaluate(
         self,
@@ -249,6 +258,53 @@ class HPOBHandler:
             history.append(float(np.max(ys[current])))
         return history
 
+    def surrogates_stats(self) -> Dict:
+        """Parses ``summary-stats.json`` from ``surrogates_dir`` (the
+        published y_min/y_max per surrogate; reference ``handler.py:131``)."""
+        if self.surrogates_dir is None:
+            raise ValueError(
+                "surrogates_dir is required for the continuous protocol "
+                "(it holds summary-stats.json and the surrogate dumps)."
+            )
+        path = _require_file(
+            os.path.join(self.surrogates_dir, "summary-stats.json"), "HPO-B"
+        )
+        with open(path) as f:
+            return json.load(f)
+
+    def surrogate_predictor(self, search_space_id: str, dataset_id: str):
+        """``[N, dim] -> [N]`` callable serving the saved XGBoost surrogate.
+
+        The only xgboost-gated piece of the continuous protocol: loads
+        ``surrogate-<ss>-<ds>.json`` from ``surrogates_dir`` into a Booster
+        (reference ``handler.py:265-267``). Everything around it —
+        stats parsing, init ids, normalize/clip, the suggest loop — is
+        plain code; tests inject a fake predictor instead.
+        """
+        if self.surrogates_dir is None:
+            raise ValueError(
+                "surrogates_dir is required to serve saved surrogates "
+                "(pass predictor= to evaluate_continuous to go without)."
+            )
+        try:
+            import xgboost as xgb
+        except ImportError as e:
+            raise ImportError(
+                "Serving the published HPO-B surrogates needs the xgboost "
+                "package (absent from this image); pass predictor= to "
+                "evaluate_continuous instead."
+            ) from e
+        model_path = _require_file(
+            os.path.join(
+                self.surrogates_dir,
+                f"surrogate-{search_space_id}-{dataset_id}.json",
+            ),
+            "HPO-B",
+        )
+        booster = xgb.Booster()
+        booster.load_model(model_path)
+        return lambda x: np.asarray(booster.predict(xgb.DMatrix(x))).reshape(-1)
+
     def evaluate_continuous(
         self,
         bo_method=None,
@@ -256,24 +312,64 @@ class HPOBHandler:
         dataset_id: Optional[str] = None,
         seed: Optional[str] = None,
         n_trials: int = 10,
+        predictor=None,
     ) -> List[float]:
-        """Continuous protocol against the published XGBoost surrogates.
+        """Continuous protocol against the published surrogates.
 
-        NOT implemented: raises ImportError without xgboost, else
-        NotImplementedError (the surrogate-serving wiring needs both the
-        package and the saved-surrogates dump)."""
-        try:
-            import xgboost as xgb  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "evaluate_continuous needs the xgboost package (absent from "
-                "this image) to serve the published HPO-B surrogate models; "
-                "use the discrete evaluate() protocol instead."
-            ) from e
-        raise NotImplementedError(
-            "XGBoost surrogate serving requires the saved-surrogates dump; "
-            "wire surrogates_dir when both xgboost and the data exist."
-        )
+        Parity with reference ``handler.py:232-306``: seed the 5 published
+        initial points, then ``n_trials`` rounds of ``observe_and_suggest
+        (X_obs, y_obs_normalized) -> new_x`` where ``new_x`` is any point
+        in the unit cube, scored by the saved surrogate and appended to the
+        observations. Labels are min-max normalized with the surrogate's
+        published ``y_min``/``y_max`` and clipped to [0, 1]; the returned
+        trace is the incumbent before each suggest plus one final entry
+        (which here includes the last suggested point — the reference
+        re-appends the pre-suggest incumbent).
+
+        ``predictor`` is a ``[N, dim] -> [N]`` callable; defaults to the
+        xgboost-served surrogate from :meth:`surrogate_predictor`.
+        """
+        if bo_method is None or not hasattr(bo_method, "observe_and_suggest"):
+            raise ValueError(
+                "bo_method must define observe_and_suggest(X_obs, y_obs) "
+                "-> new continuous point."
+            )
+        if search_space_id is None or dataset_id is None or seed is None:
+            raise ValueError("search_space_id, dataset_id and seed are required.")
+        self._ensure_loaded()
+        if predictor is None:
+            predictor = self.surrogate_predictor(search_space_id, dataset_id)
+        stats = self.surrogates_stats()
+        stats_key = f"surrogate-{search_space_id}-{dataset_id}"
+        if stats_key not in stats:
+            raise KeyError(
+                f"{stats_key!r} missing from summary-stats.json; cannot "
+                "normalize surrogate outputs."
+            )
+        y_min = stats[stats_key]["y_min"]
+        y_max = stats[stats_key]["y_max"]
+
+        entry = self.meta_test_data[search_space_id][dataset_id]
+        xs = np.asarray(entry["X"], dtype=np.float64)
+        ys = np.asarray(entry["y"], dtype=np.float64).reshape(-1)
+        dim = xs.shape[1]
+        init_ids = self.bo_initializations[search_space_id][dataset_id][seed]
+        observed_x = xs[init_ids[: self.N_INITIAL_EVALUATIONS]]
+        observed_y = ys[init_ids[: self.N_INITIAL_EVALUATIONS]]
+
+        history: List[float] = []
+        for _ in range(n_trials):
+            y_tf = np.clip(self.normalize(observed_y, y_min, y_max), 0.0, 1.0)
+            history.append(float(np.max(y_tf)))
+            new_x = np.asarray(
+                bo_method.observe_and_suggest(observed_x, y_tf), dtype=np.float64
+            ).reshape(-1, dim)
+            new_y = predictor(new_x)
+            observed_x = np.concatenate([observed_x, new_x], axis=0)
+            observed_y = np.append(observed_y, new_y)
+        y_tf = np.clip(self.normalize(observed_y, y_min, y_max), 0.0, 1.0)
+        history.append(float(np.max(y_tf)))
+        return history
 
     # -- experimenter bridge ------------------------------------------------
 
