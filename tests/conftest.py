@@ -40,10 +40,14 @@ def _gc_relief():
 
     At each module boundary: drop jax's compilation caches (their jaxprs
     dominate the object graph; cross-module cache reuse is minimal anyway),
-    collect once, then ``gc.freeze()`` the survivors into the permanent
-    generation so subsequent collections scan only new objects.
+    unfreeze the previous boundary's survivors so cycles that died since
+    then are reclaimable (a freeze-only policy would make suite RSS
+    monotone), collect once, then ``gc.freeze()`` the survivors into the
+    permanent generation so collections between boundaries scan only new
+    objects.
     """
     yield
     jax.clear_caches()
+    gc.unfreeze()
     gc.collect()
     gc.freeze()

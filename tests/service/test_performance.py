@@ -104,3 +104,42 @@ class TestSharedChannelLifecycle:
         assert srv.endpoint in grpc_stubs._CHANNELS
         srv.stop(0)
         assert srv.endpoint not in grpc_stubs._CHANNELS
+
+    def test_broken_cached_channel_evicted_and_reconnected(self):
+        from vizier_tpu.service import grpc_stubs
+
+        srv = vizier_server.DefaultVizierServer(host="localhost")
+        try:
+            stub = grpc_stubs.create_vizier_stub(srv.endpoint)
+            entry = grpc_stubs._CHANNELS[srv.endpoint]
+            # Simulate a server dying WITHOUT close_channel(): the watcher
+            # flags the entry; the next stub creation must not serve it.
+            entry.broken = True
+            stub2 = grpc_stubs.create_vizier_stub(srv.endpoint)
+            new_entry = grpc_stubs._CHANNELS[srv.endpoint]
+            assert new_entry is not entry
+            assert not new_entry.broken
+            assert stub2 is not stub  # fresh stub on the fresh channel
+        finally:
+            srv.stop(0)
+
+    def test_transient_failure_marks_entry_broken(self):
+        import grpc as grpc_lib
+
+        from vizier_tpu.service import grpc_stubs
+
+        srv = vizier_server.DefaultVizierServer(host="localhost")
+        try:
+            grpc_stubs.create_vizier_stub(srv.endpoint)
+            entry = grpc_stubs._CHANNELS[srv.endpoint]
+            assert not entry.broken
+            entry._watch(grpc_lib.ChannelConnectivity.TRANSIENT_FAILURE)
+            assert entry.broken
+            # READY clears the flag: TRANSIENT_FAILURE during a reconnect
+            # blip must not get a healthy channel evicted later.
+            entry._watch(grpc_lib.ChannelConnectivity.READY)
+            assert not entry.broken
+            entry._watch(grpc_lib.ChannelConnectivity.SHUTDOWN)
+            assert entry.broken
+        finally:
+            srv.stop(0)

@@ -547,3 +547,37 @@ class TestListStudies:
         # Handles are live: suggest works through them.
         (t,) = studies[0].suggest(count=1)
         assert t.status == vz.TrialStatus.ACTIVE
+
+
+class TestBudgetPolicyViaMetadata:
+    """gRPC-reachable acquisition budget policy (study metadata ns
+    'gp_ucb_pe'), so clients can request reference per-pick semantics."""
+
+    def _designer_for(self, metadata_value):
+        from vizier_tpu.pythia import local_policy_supporters
+        from vizier_tpu.service import policy_factory
+
+        config = _config(algorithm="DEFAULT")
+        problem = config.to_problem()
+        if metadata_value is not None:
+            problem.metadata.ns("gp_ucb_pe")[
+                "acquisition_budget_policy"
+            ] = metadata_value
+        supporter = local_policy_supporters.InRamPolicySupporter(config)
+        policy = policy_factory.DefaultPolicyFactory()(
+            problem, "DEFAULT", supporter, "s"
+        )
+        # DesignerPolicy builds the designer lazily via its factory.
+        return policy._designer_factory(problem)
+
+    def test_default_is_first_pick_full(self):
+        designer = self._designer_for(None)
+        assert designer.acquisition_budget_policy == "first_pick_full"
+
+    def test_metadata_requests_per_pick(self):
+        designer = self._designer_for("per_pick")
+        assert designer.acquisition_budget_policy == "per_pick"
+
+    def test_invalid_value_raises(self):
+        with pytest.raises(ValueError, match="acquisition_budget_policy"):
+            self._designer_for("always_free_lunch")

@@ -51,6 +51,7 @@ def main() -> None:
     nasbench = api.NASBench(args.dataset)
     table = {}
     skipped = 0
+    collisions = 0
     for upstream_hash in nasbench.hash_iterator():
         fixed, computed = nasbench.get_metrics_from_hash(upstream_hash)
         spec = nb.ModelSpec(
@@ -66,18 +67,27 @@ def main() -> None:
         def avg(key):
             return float(sum(r[key] for r in runs) / len(runs))
 
-        table[h] = {
+        entry = {
             "trainable_parameters": float(fixed["trainable_parameters"]),
             "training_time": avg("final_training_time"),
             "train_accuracy": avg("final_train_accuracy"),
             "validation_accuracy": avg("final_validation_accuracy"),
             "test_accuracy": avg("final_test_accuracy"),
         }
+        # The WL-style hash could in principle collide for non-isomorphic
+        # cells; a silent overwrite would merge distinct cells' metrics.
+        # Count and report collisions (differing metrics under one hash) so
+        # a hash weakness is observable in the export log.
+        prior = table.get(h)
+        if prior is not None and prior != entry:
+            collisions += 1
+            print(f"WARNING: hash collision with differing metrics: {h}")
+        table[h] = entry
     with open(args.out, "w") as f:
         json.dump(table, f)
     print(
         f"Exported {len(table)} cells to {args.out} "
-        f"({skipped} skipped as disconnected)."
+        f"({skipped} skipped as disconnected, {collisions} hash collisions)."
     )
 
 

@@ -162,6 +162,26 @@ class _ChannelEntry:
         self.channel = channel
         self.ready = threading.Event()
         self.error: Any = None
+        # Liveness flag kept fresh by one connectivity watcher per CHANNEL
+        # (not per stub call, so no thread churn): a server that dies
+        # without close_channel() flips it, and the next cache hit evicts
+        # and reconnects instead of handing back a dead channel whose
+        # failure would only surface at first RPC.
+        self.broken = False
+        channel.subscribe(self._watch, try_to_connect=False)
+
+    def _watch(self, state: grpc.ChannelConnectivity) -> None:
+        if state in (
+            grpc.ChannelConnectivity.SHUTDOWN,
+            grpc.ChannelConnectivity.TRANSIENT_FAILURE,
+        ):
+            self.broken = True
+        elif state is grpc.ChannelConnectivity.READY:
+            # TRANSIENT_FAILURE is a normal intermediate state (a failed
+            # connect attempt before gRPC's auto-reconnect succeeds); once
+            # the channel reaches READY it is healthy again, and evicting
+            # it would close() it underneath every stub already sharing it.
+            self.broken = False
 
 
 _CHANNELS: Dict[str, _ChannelEntry] = {}
@@ -170,6 +190,12 @@ _CHANNELS: Dict[str, _ChannelEntry] = {}
 def _shared_channel(endpoint: str, timeout: float) -> grpc.Channel:
     with _CHANNEL_LOCK:
         entry = _CHANNELS.get(endpoint)
+        if entry is not None and entry.broken and entry.ready.is_set():
+            # Stale cache hit: evict, close, fall through to a fresh
+            # connect (which re-runs the full ready-wait).
+            del _CHANNELS[endpoint]
+            entry.channel.close()
+            entry = None
         fresh = entry is None
         if fresh:
             entry = _ChannelEntry(grpc.insecure_channel(endpoint))

@@ -31,7 +31,20 @@ class DefaultPolicyFactory:
             try:
                 from vizier_tpu.designers import gp_ucb_pe
 
-                factory = lambda p, **kw: gp_ucb_pe.VizierGPUCBPEBandit(p)
+                def factory(p, **kw):
+                    # gRPC clients can request reference acquisition
+                    # semantics (a full budget on EVERY pick) without a
+                    # code path to the designer kwarg: study metadata
+                    # ns 'gp_ucb_pe' key 'acquisition_budget_policy' =
+                    # per_pick | per_batch | first_pick_full (default).
+                    kwargs = {}
+                    requested = p.metadata.ns("gp_ucb_pe").get(
+                        "acquisition_budget_policy", cls=str
+                    )
+                    if requested:
+                        kwargs["acquisition_budget_policy"] = requested
+                    return gp_ucb_pe.VizierGPUCBPEBandit(p, **kwargs)
+
             except ImportError:  # pragma: no cover - transitional fallback
                 from vizier_tpu.designers import gp_bandit
 
