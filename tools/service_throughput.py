@@ -1,13 +1,21 @@
 """Service throughput at the reference's stress configs, over real gRPC.
 
 Usage: python tools/service_throughput.py [--out SERVICE_THROUGHPUT.json]
+       [--side repo|reference|both]
 
 Reference ``performance_test.py:44-89`` runs clients×trials configs
 {1×10, 2×10, 10×10, 50×5, 100×5} on RANDOM_SEARCH over a 2-D space and
-logs wall time only. This tool runs the same topology against this repo's
-``DefaultVizierServer`` (one shared study per config, one thread per
-client, each doing its own suggest→complete loop over a real localhost
-gRPC channel) and prints a JSON report with wall time and trials/sec.
+logs wall time only. This tool runs the same topology — one shared study
+per config, one thread per client, each doing its own suggest→complete
+loop over a real localhost gRPC channel — against BOTH this repo's
+``DefaultVizierServer`` and the reference's (the runnable copy that
+``tools/build_reference_copy.sh`` puts at /tmp/refvizier, RAM datastore),
+and writes a two-column JSON report with wall time and trials/sec.
+
+The reference side runs in a subprocess so its ``vizier`` package import
+and proto registrations stay isolated; per-worker clients are created
+BEFORE the timed section on both sides, so the clock covers only the
+suggest→complete loops.
 """
 
 from __future__ import annotations
@@ -15,22 +23,21 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from __graft_entry__ import _honor_platform_env
-
-_honor_platform_env()
-
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
 
 CONFIGS = ((1, 10), (2, 10), (10, 10), (50, 5), (100, 5))
+REFCOPY = "/tmp/refvizier"
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default=None)
-    args = ap.parse_args()
+def run_repo() -> list:
+    from __graft_entry__ import _honor_platform_env
+
+    _honor_platform_env()
 
     from vizier_tpu.service import clients as clients_lib
     from vizier_tpu.service import vizier_server
@@ -38,8 +45,7 @@ def main() -> None:
 
     server = vizier_server.DefaultVizierServer(host="localhost")
     clients_lib.environment_variables.server_endpoint = server.endpoint
-    report = {"topology": "one DefaultVizierServer, real localhost gRPC",
-              "algorithm": "RANDOM_SEARCH", "configs": []}
+    rows = []
     try:
         for num_clients, trials_each in CONFIGS:
             study = clients_lib.Study.from_study_config(
@@ -52,6 +58,7 @@ def main() -> None:
             )
             total = num_clients * trials_each
             row = {
+                "side": "repo",
                 "clients": num_clients,
                 "trials_each": trials_each,
                 "total_trials": total,
@@ -59,12 +66,154 @@ def main() -> None:
                 "wall_s": round(wall, 3),
                 "trials_per_s": round(total / wall, 1),
             }
-            report["configs"].append(row)
+            rows.append(row)
             print(json.dumps(row), flush=True)
             assert completed == total, (completed, total)
     finally:
         clients_lib.environment_variables.server_endpoint = clients_lib.NO_ENDPOINT
         server.stop(0)
+    return rows
+
+
+def _ensure_refcopy() -> None:
+    # The shims this diff relies on are part of the build; an isdir check
+    # would accept a stale copy from an older build script.
+    marker = os.path.join(
+        REFCOPY, "vizier/_src/service/vizier_service_pb2_grpc.py"
+    )
+    if not os.path.exists(marker):
+        subprocess.run(
+            ["bash", os.path.join(_REPO_ROOT, "tools/build_reference_copy.sh")],
+            check=True,
+        )
+
+
+def run_reference() -> list:
+    """Identical topology against the reference's DefaultVizierServer."""
+    import concurrent.futures as cf
+
+    # Defensive: direct `--side reference` invocations must not initialize
+    # the axon backend (a dead TPU tunnel hangs jax init on this image).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _ensure_refcopy()
+    sys.path.insert(0, REFCOPY)
+    from vizier._src.service import vizier_client, vizier_server
+    from vizier.service import pyvizier as svz
+
+    server = vizier_server.DefaultVizierServer(database_url=None)
+    vizier_client.environment_variables.server_endpoint = server.endpoint
+
+    def study_config():
+        sc = svz.StudyConfig()
+        sc.search_space.root.add_float_param("x", 0.0, 1.0)
+        sc.search_space.root.add_float_param("y", 0.0, 1.0)
+        sc.metric_information.append(
+            svz.MetricInformation(
+                name="obj", goal=svz.ObjectiveMetricGoal.MINIMIZE
+            )
+        )
+        sc.algorithm = svz.Algorithm.RANDOM_SEARCH
+        return sc
+
+    rows = []
+    for num_clients, trials_each in CONFIGS:
+        study_id = f"tp-{num_clients}x{trials_each}"
+        # Per-worker clients before the clock, mirroring the repo side
+        # (where the study client exists before run_stress_round).
+        clients = [
+            vizier_client.create_or_load_study(
+                owner_id="perf",
+                study_id=study_id,
+                study_config=study_config(),
+                client_id=f"worker_{i}",
+            )
+            for i in range(num_clients)
+        ]
+
+        def worker(client):
+            for _ in range(trials_each):
+                (trial,) = client.get_suggestions(suggestion_count=1)
+                x = trial.parameters["x"].value
+                y = trial.parameters["y"].value
+                m = svz.Measurement(
+                    metrics={"obj": (x - 0.3) ** 2 + (y - 0.7) ** 2}
+                )
+                client.complete_trial(trial.id, m)
+
+        t0 = time.perf_counter()
+        with cf.ThreadPoolExecutor(max_workers=num_clients) as pool:
+            list(pool.map(worker, clients))
+        wall = time.perf_counter() - t0
+        completed = sum(
+            1
+            for t in clients[0].list_trials()
+            if t.status == svz.TrialStatus.COMPLETED
+        )
+        total = num_clients * trials_each
+        row = {
+            "side": "reference",
+            "clients": num_clients,
+            "trials_each": trials_each,
+            "total_trials": total,
+            "completed": completed,
+            "wall_s": round(wall, 3),
+            "trials_per_s": round(total / wall, 1),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        assert completed == total, (completed, total)
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--side", choices=("repo", "reference", "both"), default="both"
+    )
+    args = ap.parse_args()
+
+    if args.side == "reference":
+        rows = run_reference()
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"reference": rows}, f, indent=1)
+            print(f"wrote {args.out}")
+        return
+
+    report = {
+        "topology": (
+            "one DefaultVizierServer per side, real localhost gRPC, "
+            "per-worker clients created before the clock"
+        ),
+        "algorithm": "RANDOM_SEARCH",
+        "repo": run_repo(),
+    }
+    if args.side == "both":
+        _ensure_refcopy()
+        # Subprocess keeps the reference's `vizier` import + proto
+        # registrations out of this process.
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--side", "reference"],
+            capture_output=True,
+            text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(f"reference side failed:\n{proc.stderr[-3000:]}")
+        report["reference"] = [
+            json.loads(line)
+            for line in proc.stdout.splitlines()
+            if line.startswith("{")
+        ]
+        report["speedup_vs_reference"] = {
+            f"{r['clients']}x{r['trials_each']}": round(
+                r["trials_per_s"] / ref["trials_per_s"], 2
+            )
+            for r, ref in zip(report["repo"], report["reference"])
+        }
+        print(json.dumps(report["speedup_vs_reference"]))
+
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1)
