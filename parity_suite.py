@@ -169,6 +169,19 @@ def main() -> None:
     parser.add_argument("--scale", type=float, default=1.0)
     parser.add_argument("--out", default="regret_report_r3.json")
     parser.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
+    parser.add_argument(
+        "--only",
+        default=None,
+        choices=(
+            "branin_2d",
+            "mixed_space_default",
+            "bbob20d_sphere",
+            "bbob20d_rastrigin",
+            "zdt1_hypervolume",
+            "nasbench201_synthetic",
+        ),
+        help="Run a single config by report name (e.g. nasbench201_synthetic).",
+    )
     args = parser.parse_args()
     s = args.scale
 
@@ -254,6 +267,8 @@ def main() -> None:
         }
 
     def run_config(name, experimenter, num_trials, batch, seeds, skip=()):
+        if args.only and name != args.only:
+            return
         # ``experimenter`` may be a factory ``seed -> Experimenter`` so
         # configs can randomize per seed (e.g. shifted BBOB optima).
         if isinstance(experimenter, benchmarks.Experimenter):
@@ -427,6 +442,8 @@ def main() -> None:
 
     # -- Config 4: multi-objective ZDT1 hypervolume ------------------------
     def run_mo():
+        if args.only and args.only != "zdt1_hypervolume":
+            return
         exp = multiobjective.MultiObjectiveExperimenter.zdt("zdt1", dimension=6)
         metrics = list(exp.problem_statement().metric_information)
         ref_point = np.array([-1.1, -6.0], dtype=np.float32)

@@ -609,3 +609,53 @@ class TestReviewRegressions:
             designer
         )
         assert len(builds) == 1  # tiny schedule drift must not rebuild
+
+
+class TestEaglePureCategoricalPerturbation:
+    """Pure-categorical spaces use the reference's CONSTANT resample
+    probability (eagle_strategy_utils.py:299), not the Laplace×25 path
+    that resamples nearly every category per move."""
+
+    def _pure_cat_problem(self, n=6, k=5):
+        problem = vz.ProblemStatement()
+        for i in range(n):
+            problem.search_space.root.add_categorical_param(
+                f"op{i}", [str(c) for c in range(k)]
+            )
+        problem.metric_information.append(
+            vz.MetricInformation(
+                name="acc", goal=vz.ObjectiveMetricGoal.MAXIMIZE
+            )
+        )
+        return problem
+
+    def test_resample_rate_matches_constant(self):
+        from vizier_tpu.designers.eagle_strategy import EagleStrategyDesigner
+
+        d = EagleStrategyDesigner(self._pure_cat_problem(), seed=0)
+        cat = np.zeros(6, dtype=np.int32)
+        x = np.zeros(0)
+        changed = total = 0
+        for _ in range(500):
+            _, out = d._perturb(x, cat, level=0.1)
+            # Uniform resample can redraw the same category: P(change) =
+            # p_resample * (k-1)/k = 0.1 * 0.8 = 0.08.
+            changed += int(np.sum(out != cat))
+            total += len(cat)
+        rate = changed / total
+        assert 0.04 < rate < 0.13, rate
+
+    def test_mixed_space_still_uses_scaled_path(self):
+        from vizier_tpu.designers.eagle_strategy import EagleStrategyDesigner
+
+        problem = _mixed_problem()
+        d = EagleStrategyDesigner(problem, seed=0)
+        nc = d._enc.num_continuous
+        assert nc > 0
+        x = np.full(nc, 0.5)
+        cat = np.zeros(d._enc.num_categorical, dtype=np.int32)
+        moved = False
+        for _ in range(20):
+            out_x, _ = d._perturb(x, cat, level=0.1)
+            moved = moved or bool(np.any(out_x != x))
+        assert moved  # continuous coordinates must keep perturbing
