@@ -659,3 +659,130 @@ class TestEaglePureCategoricalPerturbation:
             out_x, _ = d._perturb(x, cat, level=0.1)
             moved = moved or bool(np.any(out_x != x))
         assert moved  # continuous coordinates must keep perturbing
+
+
+class TestPyCMAESWrapper:
+    """The pycma wrapper protocol, executed against a stub cma module
+    (the real package is absent from this image)."""
+
+    def _problem(self, dim=3):
+        return bbob_problem(dim)
+
+    def _stub_cma(self, popsize=4):
+        import types
+
+        calls = {}
+
+        class FakeEvolution:
+            def __init__(self, x0, sigma0, options):
+                calls["x0"] = np.array(x0)
+                calls["sigma0"] = sigma0
+                calls["options"] = options
+                self.popsize = options.get("popsize", popsize)
+
+            def feed_for_resume(self, features, labels):
+                calls["fed_features"] = np.array(features)
+                calls["fed_labels"] = np.array(labels)
+
+            def ask(self, count):
+                rng = np.random.default_rng(0)
+                return rng.uniform(size=(count, len(calls["x0"])))
+
+        mod = types.ModuleType("cma")
+        mod.CMAEvolutionStrategy = FakeEvolution
+        return mod, calls
+
+    def test_validation(self):
+        from vizier_tpu.designers.pycmaes import PyCMAESDesigner
+
+        with pytest.raises(ValueError, match="popsize"):
+            PyCMAESDesigner(self._problem(), popsize=1)
+        with pytest.raises(ValueError, match="continuous"):
+            PyCMAESDesigner(_mixed_problem())
+
+    def test_import_gate(self):
+        from vizier_tpu.designers.pycmaes import PyCMAESDesigner
+
+        with pytest.raises(ImportError, match="pycma"):
+            PyCMAESDesigner(self._problem()).suggest(1)
+
+    def test_protocol_feeds_whole_generations_sign_flipped(self):
+        from vizier_tpu.algorithms import core as core_lib
+        from vizier_tpu.designers.pycmaes import PyCMAESDesigner
+
+        problem = self._problem(2)
+        d = PyCMAESDesigner(problem, popsize=4)
+        mod, calls = self._stub_cma()
+        # 6 completed trials, popsize 4 -> feed exactly the last 4.
+        trials = []
+        for i in range(6):
+            t = vz.Trial(
+                id=i + 1, parameters={"x0": float(i) - 2.5, "x1": 0.0}
+            )
+            t.complete(vz.Measurement(metrics={"bbob_eval": float(i)}))
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        out = d._suggest_with(mod, 3)
+        assert len(out) == 3
+        assert calls["fed_features"].shape == (4, 2)
+        # bbob_eval is MINIMIZE: converter encodes maximization-signed
+        # (negated), wrapper flips again for pycma -> raw values back.
+        np.testing.assert_allclose(
+            calls["fed_labels"], [2.0, 3.0, 4.0, 5.0]
+        )
+        # x0 is the scaled bounds midpoint.
+        np.testing.assert_allclose(calls["x0"], [0.5, 0.5])
+        for s in out:
+            v = float(s.parameters["x0"].value)
+            assert -5.0 <= v <= 5.0  # back in native bounds
+
+    def test_no_feed_below_one_generation(self):
+        from vizier_tpu.algorithms import core as core_lib
+        from vizier_tpu.designers.pycmaes import PyCMAESDesigner
+
+        d = PyCMAESDesigner(self._problem(2), popsize=4)
+        mod, calls = self._stub_cma()
+        t = vz.Trial(id=1, parameters={"x0": 0.0, "x1": 0.0})
+        t.complete(vz.Measurement(metrics={"bbob_eval": 1.0}))
+        d.update(core_lib.CompletedTrials([t]))
+        d._suggest_with(mod, 2)
+        assert "fed_features" not in calls
+
+    def test_log_scale_x0_uses_converter_frame(self):
+        from vizier_tpu.designers.pycmaes import PyCMAESDesigner
+        from vizier_tpu.pyvizier import parameter_config as pcfg
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param(
+            "lr", 1e-4, 1.0, scale_type=pcfg.ScaleType.LOG, default_value=1e-2
+        )
+        problem.metric_information.append(
+            vz.MetricInformation(
+                name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE
+            )
+        )
+        d = PyCMAESDesigner(problem)
+        # log frame: 1e-2 sits exactly halfway between 1e-4 and 1.
+        np.testing.assert_allclose(d._x0, [0.5], atol=1e-6)
+
+    def test_infeasible_trials_filtered_from_feed(self):
+        from vizier_tpu.algorithms import core as core_lib
+        from vizier_tpu.designers.pycmaes import PyCMAESDesigner
+
+        d = PyCMAESDesigner(self._problem(2), popsize=2)
+        mod, calls = self._stub_cma(popsize=2)
+        trials = []
+        for i in range(4):
+            t = vz.Trial(id=i + 1, parameters={"x0": 0.0, "x1": 0.0})
+            if i == 1:
+                t.complete(
+                    vz.Measurement(), infeasibility_reason="diverged"
+                )
+            else:
+                t.complete(vz.Measurement(metrics={"bbob_eval": float(i)}))
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        d._suggest_with(mod, 1)
+        # 3 finite trials, popsize 2 -> feed the last whole generation (2).
+        assert calls["fed_labels"].shape == (2,)
+        assert np.isfinite(calls["fed_labels"]).all()

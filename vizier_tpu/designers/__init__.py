@@ -20,6 +20,7 @@ def __getattr__(name):
         "UCBPEConfig": ("vizier_tpu.designers.gp_ucb_pe", "UCBPEConfig"),
         "NSGA2Designer": ("vizier_tpu.designers.evolution", "NSGA2Designer"),
         "CMAESDesigner": ("vizier_tpu.designers.cmaes", "CMAESDesigner"),
+        "PyCMAESDesigner": ("vizier_tpu.designers.pycmaes", "PyCMAESDesigner"),
         "EagleStrategyDesigner": ("vizier_tpu.designers.eagle_strategy", "EagleStrategyDesigner"),
         "BOCSDesigner": ("vizier_tpu.designers.bocs", "BOCSDesigner"),
         "HarmonicaDesigner": ("vizier_tpu.designers.harmonica", "HarmonicaDesigner"),
