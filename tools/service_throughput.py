@@ -31,7 +31,14 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
 CONFIGS = ((1, 10), (2, 10), (10, 10), (50, 5), (100, 5))
+# The published stress set above is short enough that channel setup and
+# first-RPC costs dominate the small configs; the steady-state config
+# measures sustained per-trial service latency.
+STEADY_STATE = (1, 200)
 REFCOPY = "/tmp/refvizier"
+
+
+REPEATS = 3  # best-of-N per config: throughput = least-interference run
 
 
 def run_repo() -> list:
@@ -47,28 +54,37 @@ def run_repo() -> list:
     clients_lib.environment_variables.server_endpoint = server.endpoint
     rows = []
     try:
-        for num_clients, trials_each in CONFIGS:
-            study = clients_lib.Study.from_study_config(
-                stress.stress_study_config(),
-                owner="perf",
-                study_id=f"tp-{num_clients}x{trials_each}",
-            )
-            wall, completed, _ = stress.run_stress_round(
-                study, num_clients, trials_each
-            )
+        # Warmup: channel connect + proto/codec first-call costs land on a
+        # throwaway study, so the timed configs measure the service.
+        warm = clients_lib.Study.from_study_config(
+            stress.stress_study_config(), owner="perf", study_id="warmup"
+        )
+        stress.run_stress_round(warm, 1, 3)
+        for num_clients, trials_each in CONFIGS + (STEADY_STATE,):
             total = num_clients * trials_each
+            best_wall = float("inf")
+            for rep in range(REPEATS):
+                study = clients_lib.Study.from_study_config(
+                    stress.stress_study_config(),
+                    owner="perf",
+                    study_id=f"tp-{num_clients}x{trials_each}-r{rep}",
+                )
+                wall, completed, _ = stress.run_stress_round(
+                    study, num_clients, trials_each
+                )
+                assert completed == total, (completed, total)
+                best_wall = min(best_wall, wall)
             row = {
                 "side": "repo",
                 "clients": num_clients,
                 "trials_each": trials_each,
                 "total_trials": total,
-                "completed": completed,
-                "wall_s": round(wall, 3),
-                "trials_per_s": round(total / wall, 1),
+                "completed": total,
+                "wall_s": round(best_wall, 3),
+                "trials_per_s": round(total / best_wall, 1),
             }
             rows.append(row)
             print(json.dumps(row), flush=True)
-            assert completed == total, (completed, total)
     finally:
         clients_lib.environment_variables.server_endpoint = clients_lib.NO_ENDPOINT
         server.stop(0)
@@ -115,53 +131,69 @@ def run_reference() -> list:
         sc.algorithm = svz.Algorithm.RANDOM_SEARCH
         return sc
 
-    rows = []
-    for num_clients, trials_each in CONFIGS:
-        study_id = f"tp-{num_clients}x{trials_each}"
-        # Per-worker clients before the clock, mirroring the repo side
-        # (where the study client exists before run_stress_round).
-        clients = [
-            vizier_client.create_or_load_study(
-                owner_id="perf",
-                study_id=study_id,
-                study_config=study_config(),
-                client_id=f"worker_{i}",
-            )
-            for i in range(num_clients)
-        ]
-
-        def worker(client):
-            for _ in range(trials_each):
-                (trial,) = client.get_suggestions(suggestion_count=1)
-                x = trial.parameters["x"].value
-                y = trial.parameters["y"].value
-                m = svz.Measurement(
-                    metrics={"obj": (x - 0.3) ** 2 + (y - 0.7) ** 2}
-                )
-                client.complete_trial(trial.id, m)
-
-        t0 = time.perf_counter()
-        with cf.ThreadPoolExecutor(max_workers=num_clients) as pool:
-            list(pool.map(worker, clients))
-        wall = time.perf_counter() - t0
-        completed = sum(
-            1
-            for t in clients[0].list_trials()
-            if t.status == svz.TrialStatus.COMPLETED
+    # Warmup mirrors the repo side: throwaway study absorbs first-RPC costs.
+    warm = vizier_client.create_or_load_study(
+        owner_id="perf",
+        study_id="warmup",
+        study_config=study_config(),
+        client_id="w",
+    )
+    for _ in range(3):
+        (t,) = warm.get_suggestions(suggestion_count=1)
+        warm.complete_trial(
+            t.id, svz.Measurement(metrics={"obj": 0.0})
         )
+
+    rows = []
+    for num_clients, trials_each in CONFIGS + (STEADY_STATE,):
         total = num_clients * trials_each
+        best_wall = float("inf")
+        for rep in range(REPEATS):
+            study_id = f"tp-{num_clients}x{trials_each}-r{rep}"
+            # Per-worker clients before the clock, mirroring the repo side
+            # (where the study client exists before run_stress_round).
+            clients = [
+                vizier_client.create_or_load_study(
+                    owner_id="perf",
+                    study_id=study_id,
+                    study_config=study_config(),
+                    client_id=f"worker_{i}",
+                )
+                for i in range(num_clients)
+            ]
+
+            def worker(client):
+                for _ in range(trials_each):
+                    (trial,) = client.get_suggestions(suggestion_count=1)
+                    x = trial.parameters["x"].value
+                    y = trial.parameters["y"].value
+                    m = svz.Measurement(
+                        metrics={"obj": (x - 0.3) ** 2 + (y - 0.7) ** 2}
+                    )
+                    client.complete_trial(trial.id, m)
+
+            t0 = time.perf_counter()
+            with cf.ThreadPoolExecutor(max_workers=num_clients) as pool:
+                list(pool.map(worker, clients))
+            wall = time.perf_counter() - t0
+            completed = sum(
+                1
+                for t in clients[0].list_trials()
+                if t.status == svz.TrialStatus.COMPLETED
+            )
+            assert completed == total, (completed, total)
+            best_wall = min(best_wall, wall)
         row = {
             "side": "reference",
             "clients": num_clients,
             "trials_each": trials_each,
             "total_trials": total,
-            "completed": completed,
-            "wall_s": round(wall, 3),
-            "trials_per_s": round(total / wall, 1),
+            "completed": total,
+            "wall_s": round(best_wall, 3),
+            "trials_per_s": round(total / best_wall, 1),
         }
         rows.append(row)
         print(json.dumps(row), flush=True)
-        assert completed == total, (completed, total)
     return rows
 
 

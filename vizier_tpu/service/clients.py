@@ -37,9 +37,20 @@ def list_studies(owner: str, *, endpoint: Optional[str] = None) -> List["Study"]
 
 
 class Trial(client_abc.TrialInterface):
-    def __init__(self, client: vizier_client.VizierClient, uid: int):
+    def __init__(
+        self,
+        client: vizier_client.VizierClient,
+        uid: int,
+        snapshot: Optional[vz.Trial] = None,
+    ):
         self._client = client
         self._uid = uid
+        # Trial parameters are immutable after creation, so a creation-time
+        # snapshot (e.g. the proto ``suggest`` already received) answers
+        # ``.parameters`` with zero RPCs; measurements/state always
+        # re-materialize.
+        self._snapshot = snapshot
+        self._params: Optional[Dict[str, Any]] = None
 
     @property
     def id(self) -> int:
@@ -47,8 +58,13 @@ class Trial(client_abc.TrialInterface):
 
     @property
     def parameters(self) -> Dict[str, Any]:
-        config = self._client.get_study_config()
-        return config.trial_parameters(self.materialize())
+        if self._params is None:
+            config = self._client.cached_study_config()
+            trial = self._snapshot if self._snapshot is not None else self.materialize()
+            self._params = config.trial_parameters(trial)
+        # Fresh dict per access: a caller mutating the returned mapping must
+        # not poison later reads through the cache.
+        return dict(self._params)
 
     def add_measurement(self, measurement: vz.Measurement) -> None:
         self._client.report_intermediate_objective_value(self._uid, measurement)
@@ -141,7 +157,7 @@ class Study(client_abc.StudyInterface):
         else:
             scoped = self._client
         trials = scoped.get_suggestions(count or 1)
-        return [Trial(self._client, t.id) for t in trials]
+        return [Trial(self._client, t.id, snapshot=t) for t in trials]
 
     def delete(self) -> None:
         self._client.delete_study()

@@ -217,6 +217,21 @@ class VizierClient:
         )
         return pc.study_config_from_proto(study.study_spec)
 
+    def cached_study_config(self) -> vz.StudyConfig:
+        """This study's config, fetched once per client — for SPEC decoding.
+
+        The service has no RPC that edits a study's search space or metric
+        configuration after creation (``SetStudyState`` touches state only),
+        so spec-derived uses — e.g. decoding trial parameters — can reuse
+        one fetch instead of a ``GetStudy`` round-trip per access. Study
+        METADATA is mutable via ``UpdateMetadata`` and may be stale here;
+        metadata readers must use :meth:`get_study_config`.
+        """
+        cached = getattr(self, "_study_config_cache", None)
+        if cached is None:
+            cached = self._study_config_cache = self.get_study_config()
+        return cached
+
     def set_study_state(self, state: vz.StudyState, reason: str = "") -> None:
         state_map = {
             vz.StudyState.ACTIVE: study_pb2.Study.ACTIVE,
