@@ -181,6 +181,24 @@ class TestExperimenterFactory:
         with pytest.raises(ValueError):
             SingleObjectiveExperimenterFactory(name="NotAFunction")()
 
+    def test_noise_type_builds_zoo_model(self):
+        factory = SingleObjectiveExperimenterFactory(
+            name="Sphere", dim=2, noise_type="severe_gaussian", seed=3
+        )
+        exp = factory()
+        t = vz.Trial(id=1, parameters={"x0": 1.0, "x1": 1.0})
+        exp.evaluate([t])
+        m = t.final_measurement.metrics
+        assert m["bbob_eval_before_noise"].value == pytest.approx(2.0)
+        assert m["bbob_eval"].value != m["bbob_eval_before_noise"].value
+        assert "severe_gaussian" in factory.description
+
+    def test_noise_std_and_type_conflict(self):
+        with pytest.raises(ValueError, match="not both"):
+            SingleObjectiveExperimenterFactory(
+                name="Sphere", noise_std=0.1, noise_type="NO_NOISE"
+            )()
+
 
 class TestIntegrations:
     def test_raytune_converter_dict_language(self):

@@ -26,6 +26,7 @@ class SingleObjectiveExperimenterFactory:
     dim: int = 4
     shift: Optional[np.ndarray] = None
     noise_std: Optional[float] = None
+    noise_type: Optional[str] = None  # BBOB-noisy zoo (wrappers.NOISE_TYPES)
     discrete_dict: Optional[dict] = None
     seed: int = 0
 
@@ -35,6 +36,8 @@ class SingleObjectiveExperimenterFactory:
                 f"Unknown BBOB function {self.name!r}; "
                 f"choices: {sorted(bbob.BBOB_FUNCTIONS)}"
             )
+        if self.noise_std is not None and self.noise_type is not None:
+            raise ValueError("Pass noise_std OR noise_type, not both.")
         exptr: base.Experimenter = base.NumpyExperimenter(
             bbob.BBOB_FUNCTIONS[self.name], base.bbob_problem(self.dim)
         )
@@ -46,6 +49,12 @@ class SingleObjectiveExperimenterFactory:
             exptr = wrappers.NoisyExperimenter(
                 exptr, noise_std=self.noise_std, seed=self.seed
             )
+        elif self.noise_type is not None:
+            # Reference factory parity (experimenter_factory.py:199-201):
+            # the named BBOB-noisy model, case-insensitive.
+            exptr = wrappers.NoisyExperimenter.from_type(
+                exptr, self.noise_type.upper(), seed=self.seed
+            )
         return exptr
 
     @property
@@ -55,6 +64,8 @@ class SingleObjectiveExperimenterFactory:
             parts.append("shifted")
         if self.noise_std:
             parts.append(f"noise{self.noise_std}")
+        if self.noise_type:
+            parts.append(self.noise_type.lower())
         return "_".join(parts)
 
 
