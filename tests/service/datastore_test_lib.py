@@ -124,6 +124,30 @@ class DataStoreConformance:
         )
         assert unfinished == []
 
+    def test_suggestion_operation_done_prefilter(self, ds):
+        """The storage-level `done` filter (the hot dedup path) agrees with
+        the proto field across mixed done/undone histories."""
+        ds.create_study(make_study())
+        study = "owners/o/studies/s"
+        for i in range(1, 6):
+            name = resources.SuggestionOperationResource("o", "s", "c", i).name
+            op = vizier_service_pb2.Operation(name=name, done=(i % 2 == 0))
+            ds.create_suggestion_operation(op)
+        undone = ds.list_suggestion_operations(study, "c", done=False)
+        assert [o.name.rsplit("/", 1)[-1] for o in undone] == ["1", "3", "5"]
+        finished = ds.list_suggestion_operations(study, "c", done=True)
+        assert len(finished) == 2 and all(o.done for o in finished)
+        # done= composes with filter_fn and flips on update.
+        op = ds.get_suggestion_operation(undone[0].name)
+        op.done = True
+        ds.update_suggestion_operation(op)
+        assert len(ds.list_suggestion_operations(study, "c", done=False)) == 2
+        assert (
+            ds.list_suggestion_operations(
+                study, "c", lambda o: o.name.endswith("5"), done=False
+            )[0].name.endswith("5")
+        )
+
     # -- early stopping ops ------------------------------------------------
 
     def test_early_stopping_operations(self, ds):

@@ -31,3 +31,86 @@ class TestSQLFileDataStore(datastore_test_lib.DataStoreConformance):
         ds1.create_study(datastore_test_lib.make_study())
         ds2 = sql_datastore.SQLDataStore(url)
         assert ds2.load_study("owners/o/studies/s").display_name == "s"
+
+
+class TestSQLDoneColumnMigration:
+    def test_pre_done_schema_backfills(self, tmp_path):
+        """A database created before the `done` column gains it on open,
+        backfilled from the stored protos."""
+        import sqlite3
+
+        from vizier_tpu.service import resources, sql_datastore
+        from vizier_tpu.service.protos import vizier_service_pb2
+        from tests.service.datastore_test_lib import make_study
+
+        path = str(tmp_path / "old.db")
+        conn = sqlite3.connect(path)
+        conn.executescript(
+            """
+            CREATE TABLE studies (name TEXT PRIMARY KEY, owner TEXT NOT NULL,
+                                  blob BLOB NOT NULL);
+            CREATE TABLE trials (name TEXT PRIMARY KEY, study TEXT NOT NULL,
+                                 trial_id INTEGER NOT NULL, blob BLOB NOT NULL);
+            CREATE TABLE suggestion_ops (name TEXT PRIMARY KEY,
+                                         study TEXT NOT NULL,
+                                         client_id TEXT NOT NULL,
+                                         op_number INTEGER NOT NULL,
+                                         blob BLOB NOT NULL);
+            CREATE TABLE early_stopping_ops (name TEXT PRIMARY KEY,
+                                             study TEXT NOT NULL,
+                                             blob BLOB NOT NULL);
+            """
+        )
+        study = make_study()
+        conn.execute(
+            "INSERT INTO studies (name, owner, blob) VALUES (?, ?, ?)",
+            (study.name, "o", study.SerializeToString()),
+        )
+        for i, done in ((1, False), (2, True)):
+            name = resources.SuggestionOperationResource("o", "s", "c", i).name
+            op = vizier_service_pb2.Operation(name=name, done=done)
+            conn.execute(
+                "INSERT INTO suggestion_ops (name, study, client_id, op_number, blob)"
+                " VALUES (?, ?, ?, ?, ?)",
+                (name, study.name, "c", i, op.SerializeToString()),
+            )
+        conn.commit()
+        conn.close()
+
+        ds = sql_datastore.SQLDataStore(f"sqlite:///{path}")
+        undone = ds.list_suggestion_operations(study.name, "c", done=False)
+        assert [o.name.rsplit("/", 1)[-1] for o in undone] == ["1"]
+        assert len(ds.list_suggestion_operations(study.name, "c", done=True)) == 1
+
+    def test_crash_after_alter_rebackfills(self, tmp_path):
+        """A crash between the autocommitted ALTER and the backfill leaves
+        the column present with all-zero flags; user_version (still 0)
+        must trigger a re-backfill on the next open."""
+        import sqlite3
+
+        from vizier_tpu.service import resources, sql_datastore
+        from vizier_tpu.service.protos import vizier_service_pb2
+        from tests.service.datastore_test_lib import make_study
+
+        path = str(tmp_path / "crashed.db")
+        conn = sqlite3.connect(path)
+        conn.executescript(sql_datastore._SCHEMA)  # has the column already
+        study = make_study()
+        conn.execute(
+            "INSERT INTO studies (name, owner, blob) VALUES (?, ?, ?)",
+            (study.name, "o", study.SerializeToString()),
+        )
+        name = resources.SuggestionOperationResource("o", "s", "c", 1).name
+        op = vizier_service_pb2.Operation(name=name, done=True)
+        # Simulated crash state: blob says done, column says 0, version 0.
+        conn.execute(
+            "INSERT INTO suggestion_ops (name, study, client_id, op_number, done, blob)"
+            " VALUES (?, ?, ?, ?, 0, ?)",
+            (name, study.name, "c", 1, op.SerializeToString()),
+        )
+        conn.commit()
+        conn.close()
+
+        ds = sql_datastore.SQLDataStore(f"sqlite:///{path}")
+        assert ds.list_suggestion_operations(study.name, "c", done=False) == []
+        assert len(ds.list_suggestion_operations(study.name, "c", done=True)) == 1

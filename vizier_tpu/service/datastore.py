@@ -100,7 +100,16 @@ class DataStore(abc.ABC):
         study_name: str,
         client_id: str,
         filter_fn: Optional[Callable[[vizier_service_pb2.Operation], bool]] = None,
+        *,
+        done: Optional[bool] = None,
     ) -> List[vizier_service_pb2.Operation]:
+        """Ops for (study, client), oldest first.
+
+        ``done`` pre-filters on completion status at the STORAGE layer —
+        the hot dedup check (``done=False``) must not deserialize/copy a
+        session's whole operation history. ``filter_fn`` runs afterwards
+        for arbitrary predicates.
+        """
         ...
 
     @abc.abstractmethod

@@ -171,6 +171,8 @@ class NestedDictRAMDataStore(datastore.DataStore):
         study_name: str,
         client_id: str,
         filter_fn: Optional[Callable[[vizier_service_pb2.Operation], bool]] = None,
+        *,
+        done: Optional[bool] = None,
     ) -> List[vizier_service_pb2.Operation]:
         with self._lock:
             node = self._node(study_name)
@@ -185,7 +187,8 @@ class NestedDictRAMDataStore(datastore.DataStore):
             ops = [
                 _copy(op)
                 for _, op in sorted(node.suggestion_ops.get(client_id, {}).items())
-                if filter_fn is None or filter_fn(op)
+                if (done is None or op.done == done)
+                and (filter_fn is None or filter_fn(op))
             ]
         return ops
 

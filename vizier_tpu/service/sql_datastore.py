@@ -45,6 +45,7 @@ CREATE TABLE IF NOT EXISTS suggestion_ops (
   study TEXT NOT NULL,
   client_id TEXT NOT NULL,
   op_number INTEGER NOT NULL,
+  done INTEGER NOT NULL DEFAULT 0,
   blob BLOB NOT NULL
 );
 CREATE INDEX IF NOT EXISTS ops_by_client ON suggestion_ops (study, client_id, op_number);
@@ -62,6 +63,42 @@ class SQLDataStore(datastore.DataStore):
         self._conn = sqlite3.connect(_path_from_url(url), check_same_thread=False)
         with self._lock:
             self._conn.executescript(_SCHEMA)
+            # Migration for databases created before the `done` column.
+            # Completion is tracked by PRAGMA user_version (>= 1), NOT by
+            # column presence: the ALTER autocommits immediately in the
+            # sqlite3 module, so a crash mid-backfill would otherwise leave
+            # the column present with every flag stuck at 0 — and done=True
+            # ops misread as orphans. The backfill is idempotent, and
+            # user_version flips inside the same transaction as its last
+            # UPDATE, so an interrupted run simply re-runs.
+            cols = {
+                row[1]
+                for row in self._conn.execute(
+                    "PRAGMA table_info(suggestion_ops)"
+                )
+            }
+            if "done" not in cols:
+                self._conn.execute(
+                    "ALTER TABLE suggestion_ops ADD COLUMN done INTEGER NOT NULL DEFAULT 0"
+                )
+            version = self._conn.execute("PRAGMA user_version").fetchone()[0]
+            if version < 1:
+                for name, blob in self._conn.execute(
+                    "SELECT name, blob FROM suggestion_ops"
+                ).fetchall():
+                    op = vizier_service_pb2.Operation.FromString(blob)
+                    if op.done:
+                        self._conn.execute(
+                            "UPDATE suggestion_ops SET done = 1 WHERE name = ?",
+                            (name,),
+                        )
+                self._conn.execute("PRAGMA user_version = 1")
+            # After the column is guaranteed (fresh schema or migration).
+            # Covers the dedup query's filter AND its op_number ordering.
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS ops_by_done"
+                " ON suggestion_ops (study, client_id, done, op_number)"
+            )
             self._conn.commit()
 
     # -- studies -----------------------------------------------------------
@@ -204,13 +241,15 @@ class SQLDataStore(datastore.DataStore):
             self._require_study(study_name)
             try:
                 self._conn.execute(
-                    "INSERT INTO suggestion_ops (name, study, client_id, op_number, blob)"
-                    " VALUES (?, ?, ?, ?, ?)",
+                    "INSERT INTO suggestion_ops"
+                    " (name, study, client_id, op_number, done, blob)"
+                    " VALUES (?, ?, ?, ?, ?, ?)",
                     (
                         operation.name,
                         study_name,
                         r.client_id,
                         r.operation_number,
+                        int(operation.done),
                         operation.SerializeToString(),
                     ),
                 )
@@ -235,8 +274,12 @@ class SQLDataStore(datastore.DataStore):
     ) -> str:
         with self._lock:
             cur = self._conn.execute(
-                "UPDATE suggestion_ops SET blob = ? WHERE name = ?",
-                (operation.SerializeToString(), operation.name),
+                "UPDATE suggestion_ops SET blob = ?, done = ? WHERE name = ?",
+                (
+                    operation.SerializeToString(),
+                    int(operation.done),
+                    operation.name,
+                ),
             )
             self._conn.commit()
         if cur.rowcount == 0:
@@ -248,13 +291,22 @@ class SQLDataStore(datastore.DataStore):
         study_name: str,
         client_id: str,
         filter_fn: Optional[Callable[[vizier_service_pb2.Operation], bool]] = None,
+        *,
+        done: Optional[bool] = None,
     ) -> List[vizier_service_pb2.Operation]:
+        # The `done` pre-filter runs in SQL over the indexed column so the
+        # hot dedup check never deserializes a session's full op history.
+        query = (
+            "SELECT blob FROM suggestion_ops WHERE study = ? AND client_id = ?"
+        )
+        params: tuple = (study_name, client_id)
+        if done is not None:
+            query += " AND done = ?"
+            params += (int(done),)
         with self._lock:
             self._require_study(study_name)
             rows = self._conn.execute(
-                "SELECT blob FROM suggestion_ops WHERE study = ? AND client_id = ?"
-                " ORDER BY op_number",
-                (study_name, client_id),
+                query + " ORDER BY op_number", params
             ).fetchall()
         ops = [vizier_service_pb2.Operation.FromString(b) for (b,) in rows]
         if filter_fn is not None:

@@ -126,7 +126,7 @@ class VizierServicer:
             # unless it was orphaned by a server crash (persisted not-done
             # but not in flight here), in which case it is failed and retried.
             unfinished = self.datastore.list_suggestion_operations(
-                study_name, client_id, lambda op: not op.done
+                study_name, client_id, done=False
             )
             for op in unfinished:
                 if op.name in self._inflight_ops:
