@@ -21,10 +21,14 @@ def main() -> None:
     parser.add_argument("--out", default="/tmp/convergence.png")
     parser.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     args = parser.parse_args()
-    if args.platform:
-        import jax
 
-        jax.config.update("jax_platforms", args.platform)
+    # One owner for the platform write: route the flag through the env and
+    # the shared guarded helper (already-initialized backends tolerated).
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    from __graft_entry__ import _honor_platform_env
+
+    _honor_platform_env()
 
     import matplotlib
 

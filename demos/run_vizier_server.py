@@ -23,6 +23,13 @@ def main() -> None:
     parser.add_argument("--database_url", default=None)
     args = parser.parse_args()
 
+    # Honor JAX_PLATFORMS before any backend init (env alone is not enough
+    # on images whose sitecustomize pins an accelerator platform, and a
+    # dead tunnel would hang the first device call).
+    from __graft_entry__ import _honor_platform_env
+
+    _honor_platform_env()
+
     from vizier_tpu.service.vizier_server import DefaultVizierServer
 
     server = DefaultVizierServer(
