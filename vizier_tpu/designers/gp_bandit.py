@@ -380,6 +380,7 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
                 count,
                 16,
                 self._prior_features(data),
+                mesh=self._mesh,
             )
             rows = jnp.asarray(result.features.continuous[0]).reshape(
                 count, self._cont_width
@@ -644,7 +645,9 @@ def default_factory(
     return VizierGPBandit(problem, rng_seed=seed or 0, **kwargs)
 
 
-@functools.partial(jax.jit, static_argnames=("vec_opt", "q", "num_samples"))
+@functools.partial(
+    jax.jit, static_argnames=("vec_opt", "q", "num_samples", "mesh")
+)
 def _maximize_q_batch(
     vec_opt: vectorized_lib.VectorizedOptimizer,
     states: gp_lib.GPState,  # leading ensemble axis
@@ -654,6 +657,7 @@ def _maximize_q_batch(
     q: int,
     num_samples: int,
     prior_features: Optional[kernels.MixedFeatures] = None,
+    mesh=None,
 ) -> vectorized_lib.VectorizedOptimizerResult:
     """Joint q-batch qEI: each candidate is a whole batch in q*Dc space.
 
@@ -663,6 +667,11 @@ def _maximize_q_batch(
     q constituent points under the *joint* ensemble posterior (full q×q
     covariance per candidate — duplicated members are perfectly correlated,
     so collapsing the batch onto one point earns no extra credit).
+
+    With a ``mesh``, the (q·Dc)-space search runs one independent eagle
+    pool per device with a single top-k merge
+    (``parallel.maximize_score_fn_sharded``) — the same pool-sharding the
+    single-point acquisitions use.
     """
     dc = states.data.continuous.shape[-1]
     ds = states.data.categorical.shape[-1]
@@ -698,4 +707,16 @@ def _maximize_q_batch(
         k = prior_features.continuous.shape[0]
         tiled = jnp.tile(prior_features.continuous, (1, q)).reshape(k, q * dc)
         prior = kernels.MixedFeatures(tiled, jnp.zeros((k, 0), jnp.int32))
+    if mesh is not None:
+        from vizier_tpu import parallel
+
+        return parallel.maximize_score_fn_sharded(
+            vec_opt,
+            score_fn,
+            rng,
+            count=1,
+            num_pools=len(mesh.devices.flat),
+            mesh=mesh,
+            prior_features=prior,
+        )
     return vec_opt(score_fn, rng, count=1, prior_features=prior)
