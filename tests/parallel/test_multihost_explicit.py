@@ -44,6 +44,40 @@ _WORKER = textwrap.dedent(
     )
     assert n_procs == 2, n_procs
     assert n_global == 2 * n_local, (n_global, n_local)
+
+    # Data plane over the GLOBAL mesh: a pool-sharded acquisition sweep
+    # whose pools live on BOTH processes' devices, merged by a global
+    # top-k (the cross-host collective), result replicated so every
+    # process reads the same optimum.
+    import jax.numpy as jnp
+
+    from vizier_tpu.optimizers import eagle as eagle_lib
+    from vizier_tpu.optimizers import vectorized as vectorized_lib
+
+    target = jnp.asarray([0.25, 0.75])
+
+    def score_fn(feats):
+        return -jnp.sum((feats.continuous - target) ** 2, axis=-1)
+
+    strategy = eagle_lib.VectorizedEagleStrategy(
+        num_continuous=2, category_sizes=()
+    )
+    vec = vectorized_lib.VectorizedOptimizer(strategy, max_evaluations=200)
+
+    @jax.jit
+    def run(key):
+        res = parallel.maximize_score_fn_sharded(
+            vec, score_fn, key, 1, n_global, mesh
+        )
+        return jax.lax.with_sharding_constraint(
+            res, parallel.replicated(mesh)
+        )
+
+    res = run(jax.random.PRNGKey(0))
+    best = float(res.scores[0])
+    xy = [round(float(v), 3) for v in res.features.continuous[0]]
+    print(f"SPMD process_id={process_id} best={best:.5f} xy={xy}", flush=True)
+    assert best > -0.01, best  # planted optimum found across both hosts
     """
 )
 
@@ -86,6 +120,12 @@ def test_two_process_explicit_coordinator_returns_global_mesh(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    spmd_lines = []
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
         assert f"RESULT process_id={i} global=4 local=2 procs=2" in out, out
+        line = [l for l in out.splitlines() if l.startswith(f"SPMD process_id={i}")]
+        assert line, f"no SPMD result from process {i}:\n{out}"
+        spmd_lines.append(line[0].split(" ", 2)[2])
+    # Replicated output: both processes must report the identical optimum.
+    assert spmd_lines[0] == spmd_lines[1], spmd_lines
