@@ -1,0 +1,33 @@
+#!/bin/bash
+# Reproduces every round-5 evidence artifact from a clean checkout.
+# Everything runs on CPU (JAX_PLATFORMS=cpu is honored via the shared
+# config-level pin); on a live TPU drop the env prefix. Approximate
+# runtimes are from the quiet 8-core container this round ran in.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== 1. full test suite (~16 min, 989 tests) =="
+python -m pytest tests/ -q
+
+echo "== 2. full-scale CPU bench for the shipped default (~30 min) =="
+#    -> compare BENCH_CPU_FULLSCALE.json
+JAX_PLATFORMS=cpu VIZIER_BENCH_SCALE=1.0 VIZIER_BENCH_WATCHDOG_S=14400 \
+  python bench.py
+
+echo "== 3. service throughput head-to-head (~6 min) =="
+#    -> SERVICE_THROUGHPUT.json (builds /tmp/refvizier on first run)
+JAX_PLATFORMS=cpu python tools/service_throughput.py --out /tmp/st.json
+
+echo "== 4. budget-policy A/B, 5 seeds x 3 families (~45 min) =="
+#    -> budget_ab_r5.json
+JAX_PLATFORMS=cpu python tools/budget_policy_ab.py
+
+echo "== 5. full designer-parity suite (~11 min) =="
+#    -> regret_report_r5.json
+JAX_PLATFORMS=cpu python parity_suite.py --out /tmp/regret.json
+
+echo "== 6. multichip dryrun on an 8-device virtual mesh (~2 min) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+echo "all evidence reproduced"
