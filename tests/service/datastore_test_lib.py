@@ -106,6 +106,37 @@ class DataStoreConformance:
         ds.create_study(make_study())
         assert ds.max_trial_id("owners/o/studies/s") == 0
 
+    def test_list_trials_state_prefilter(self, ds):
+        """The storage-level states filter (the suggest hot path) agrees
+        with the proto field, tracks updates, and composes as a tuple."""
+        ds.create_study(make_study())
+        study = "owners/o/studies/s"
+        states = [
+            study_pb2.Trial.ACTIVE,
+            study_pb2.Trial.SUCCEEDED,
+            study_pb2.Trial.REQUESTED,
+            study_pb2.Trial.SUCCEEDED,
+            study_pb2.Trial.ACTIVE,
+        ]
+        for i, st in enumerate(states, start=1):
+            t = make_trial(trial_id=i)
+            t.state = st
+            ds.create_trial(t)
+        open_rows = ds.list_trials(
+            study, states=(study_pb2.Trial.ACTIVE, study_pb2.Trial.REQUESTED)
+        )
+        assert [t.id for t in open_rows] == [1, 3, 5]
+        done_rows = ds.list_trials(study, states=(study_pb2.Trial.SUCCEEDED,))
+        assert [t.id for t in done_rows] == [2, 4]
+        assert len(ds.list_trials(study)) == 5  # unfiltered unchanged
+        # State updates move rows between filters.
+        t = ds.get_trial(open_rows[0].name)
+        t.state = study_pb2.Trial.SUCCEEDED
+        ds.update_trial(t)
+        assert [x.id for x in ds.list_trials(
+            study, states=(study_pb2.Trial.SUCCEEDED,)
+        )] == [1, 2, 4]
+
     # -- suggestion operations --------------------------------------------
 
     def test_suggestion_operations(self, ds):

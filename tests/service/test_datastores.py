@@ -40,7 +40,7 @@ class TestSQLDoneColumnMigration:
         import sqlite3
 
         from vizier_tpu.service import resources, sql_datastore
-        from vizier_tpu.service.protos import vizier_service_pb2
+        from vizier_tpu.service.protos import study_pb2, vizier_service_pb2
         from tests.service.datastore_test_lib import make_study
 
         path = str(tmp_path / "old.db")
@@ -77,10 +77,28 @@ class TestSQLDoneColumnMigration:
         conn.commit()
         conn.close()
 
+        # Plus a pre-state-column trial whose state must backfill too.
+        conn = sqlite3.connect(path)
+        t = study_pb2.Trial(
+            name=study.name + "/trials/7", id=7, state=study_pb2.Trial.SUCCEEDED
+        )
+        conn.execute(
+            "INSERT INTO trials (name, study, trial_id, blob) VALUES (?, ?, ?, ?)",
+            (t.name, study.name, 7, t.SerializeToString()),
+        )
+        conn.commit()
+        conn.close()
+
         ds = sql_datastore.SQLDataStore(f"sqlite:///{path}")
         undone = ds.list_suggestion_operations(study.name, "c", done=False)
         assert [o.name.rsplit("/", 1)[-1] for o in undone] == ["1"]
         assert len(ds.list_suggestion_operations(study.name, "c", done=True)) == 1
+        assert [
+            x.id
+            for x in ds.list_trials(
+                study.name, states=(study_pb2.Trial.SUCCEEDED,)
+            )
+        ] == [7]
 
     def test_crash_after_alter_rebackfills(self, tmp_path):
         """A crash between the autocommitted ALTER and the backfill leaves

@@ -67,7 +67,16 @@ class DataStore(abc.ABC):
         ...
 
     @abc.abstractmethod
-    def list_trials(self, study_name: str) -> List[study_pb2.Trial]:
+    def list_trials(
+        self, study_name: str, *, states: Optional[tuple] = None
+    ) -> List[study_pb2.Trial]:
+        """Trials of a study, id order.
+
+        ``states`` (a tuple of ``study_pb2.Trial.State`` values) filters at
+        the STORAGE layer: the suggest hot path needs only
+        ACTIVE/REQUESTED rows, and copying a long study's completed
+        history per suggest is a measured linear slowdown.
+        """
         ...
 
     @abc.abstractmethod

@@ -117,10 +117,19 @@ class NestedDictRAMDataStore(datastore.DataStore):
                 raise datastore.NotFoundError(f"No such trial: {trial_name}")
             del node.trials[r.trial_id]
 
-    def list_trials(self, study_name: str) -> List[study_pb2.Trial]:
+    def list_trials(
+        self, study_name: str, *, states: Optional[tuple] = None
+    ) -> List[study_pb2.Trial]:
         with self._lock:
             node = self._node(study_name)
-            return [_copy(t) for _, t in sorted(node.trials.items())]
+            # States filter before the copy (same rationale as the op done
+            # filter: completed history dominates a long study, and the
+            # suggest path only wants ACTIVE/REQUESTED rows).
+            return [
+                _copy(t)
+                for _, t in sorted(node.trials.items())
+                if states is None or t.state in states
+            ]
 
     def max_trial_id(self, study_name: str) -> int:
         with self._lock:

@@ -37,6 +37,7 @@ CREATE TABLE IF NOT EXISTS trials (
   name TEXT PRIMARY KEY,
   study TEXT NOT NULL,
   trial_id INTEGER NOT NULL,
+  state INTEGER NOT NULL DEFAULT 0,
   blob BLOB NOT NULL
 );
 CREATE INDEX IF NOT EXISTS trials_by_study ON trials (study, trial_id);
@@ -81,6 +82,14 @@ class SQLDataStore(datastore.DataStore):
                 self._conn.execute(
                     "ALTER TABLE suggestion_ops ADD COLUMN done INTEGER NOT NULL DEFAULT 0"
                 )
+            trial_cols = {
+                row[1]
+                for row in self._conn.execute("PRAGMA table_info(trials)")
+            }
+            if "state" not in trial_cols:
+                self._conn.execute(
+                    "ALTER TABLE trials ADD COLUMN state INTEGER NOT NULL DEFAULT 0"
+                )
             version = self._conn.execute("PRAGMA user_version").fetchone()[0]
             if version < 1:
                 for name, blob in self._conn.execute(
@@ -92,7 +101,16 @@ class SQLDataStore(datastore.DataStore):
                             "UPDATE suggestion_ops SET done = 1 WHERE name = ?",
                             (name,),
                         )
-                self._conn.execute("PRAGMA user_version = 1")
+            if version < 2:
+                for name, blob in self._conn.execute(
+                    "SELECT name, blob FROM trials"
+                ).fetchall():
+                    t = study_pb2.Trial.FromString(blob)
+                    self._conn.execute(
+                        "UPDATE trials SET state = ? WHERE name = ?",
+                        (int(t.state), name),
+                    )
+                self._conn.execute("PRAGMA user_version = 2")
             # After the column is guaranteed (fresh schema or migration).
             # Covers the dedup query's filter AND its op_number ordering.
             self._conn.execute(
@@ -173,11 +191,13 @@ class SQLDataStore(datastore.DataStore):
             self._require_study(r.study_resource.name)
             try:
                 self._conn.execute(
-                    "INSERT INTO trials (name, study, trial_id, blob) VALUES (?, ?, ?, ?)",
+                    "INSERT INTO trials (name, study, trial_id, state, blob)"
+                    " VALUES (?, ?, ?, ?, ?)",
                     (
                         trial.name,
                         r.study_resource.name,
                         r.trial_id,
+                        int(trial.state),
                         trial.SerializeToString(),
                     ),
                 )
@@ -198,8 +218,8 @@ class SQLDataStore(datastore.DataStore):
     def update_trial(self, trial: study_pb2.Trial) -> str:
         with self._lock:
             cur = self._conn.execute(
-                "UPDATE trials SET blob = ? WHERE name = ?",
-                (trial.SerializeToString(), trial.name),
+                "UPDATE trials SET blob = ?, state = ? WHERE name = ?",
+                (trial.SerializeToString(), int(trial.state), trial.name),
             )
             self._conn.commit()
         if cur.rowcount == 0:
@@ -213,12 +233,21 @@ class SQLDataStore(datastore.DataStore):
         if cur.rowcount == 0:
             raise datastore.NotFoundError(f"No such trial: {trial_name}")
 
-    def list_trials(self, study_name: str) -> List[study_pb2.Trial]:
+    def list_trials(
+        self, study_name: str, *, states: Optional[tuple] = None
+    ) -> List[study_pb2.Trial]:
+        query = "SELECT blob FROM trials WHERE study = ?"
+        params: tuple = (study_name,)
+        if states is not None:
+            # Storage-level state filter (see datastore.DataStore contract):
+            # the suggest path must not deserialize completed history.
+            placeholders = ",".join("?" * len(states))
+            query += f" AND state IN ({placeholders})"
+            params += tuple(int(s) for s in states)
         with self._lock:
             self._require_study(study_name)
             rows = self._conn.execute(
-                "SELECT blob FROM trials WHERE study = ? ORDER BY trial_id",
-                (study_name,),
+                query + " ORDER BY trial_id", params
             ).fetchall()
         return [study_pb2.Trial.FromString(b) for (b,) in rows]
 
