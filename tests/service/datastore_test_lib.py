@@ -106,6 +106,16 @@ class DataStoreConformance:
         ds.create_study(make_study())
         assert ds.max_trial_id("owners/o/studies/s") == 0
 
+    def test_max_trial_id_recomputes_after_deleting_max(self, ds):
+        ds.create_study(make_study())
+        for i in (1, 2, 5):
+            ds.create_trial(make_trial(trial_id=i))
+        assert ds.max_trial_id("owners/o/studies/s") == 5
+        ds.delete_trial(make_trial(trial_id=5).name)
+        assert ds.max_trial_id("owners/o/studies/s") == 2
+        ds.delete_trial(make_trial(trial_id=1).name)  # non-max delete
+        assert ds.max_trial_id("owners/o/studies/s") == 2
+
     def test_list_trials_state_prefilter(self, ds):
         """The storage-level states filter (the suggest hot path) agrees
         with the proto field, tracks updates, and composes as a tuple."""

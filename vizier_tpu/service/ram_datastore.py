@@ -22,14 +22,32 @@ def _copy(proto):
     return out
 
 
+# Trial states the suggest hot path scans for. The open/undone indexes
+# below exist because even a filter-before-copy listing still iterates a
+# study's whole history per call — measured as the residual O(n) after the
+# copy cost was removed (suggest 0.4 -> 2.9 ms/round from 0 to 5k trials).
+_OPEN_TRIAL_STATES = frozenset(
+    (study_pb2.Trial.ACTIVE, study_pb2.Trial.REQUESTED)
+)
+
+
 class _StudyNode:
     def __init__(self, study: study_pb2.Study):
         self.study = study
         self.trials: Dict[int, study_pb2.Trial] = {}
+        # ids of trials currently in an open (ACTIVE/REQUESTED) state —
+        # kept in sync by every trial write under the datastore lock.
+        self.open_trial_ids: set = set()
         # client_id -> {operation_number -> Operation}
         self.suggestion_ops: Dict[str, Dict[int, vizier_service_pb2.Operation]] = (
             collections.defaultdict(dict)
         )
+        # client_id -> op numbers with done == False, same sync contract.
+        self.undone_op_numbers: Dict[str, set] = collections.defaultdict(set)
+        # Tracked maxima (the per-suggest id-allocation reads): updated on
+        # create, recomputed only when the current max is deleted.
+        self.max_trial: int = 0
+        self.max_op_number: Dict[str, int] = collections.defaultdict(int)
         # trial_id -> EarlyStoppingOperation
         self.early_stopping_ops: Dict[str, vizier_service_pb2.EarlyStoppingOperation] = {}
 
@@ -90,6 +108,9 @@ class NestedDictRAMDataStore(datastore.DataStore):
             if r.trial_id in node.trials:
                 raise datastore.AlreadyExistsError(f"Trial exists: {trial.name}")
             node.trials[r.trial_id] = _copy(trial)
+            if trial.state in _OPEN_TRIAL_STATES:
+                node.open_trial_ids.add(r.trial_id)
+            node.max_trial = max(node.max_trial, r.trial_id)
         return trial.name
 
     def get_trial(self, trial_name: str) -> study_pb2.Trial:
@@ -107,6 +128,10 @@ class NestedDictRAMDataStore(datastore.DataStore):
             if r.trial_id not in node.trials:
                 raise datastore.NotFoundError(f"No such trial: {trial.name}")
             node.trials[r.trial_id] = _copy(trial)
+            if trial.state in _OPEN_TRIAL_STATES:
+                node.open_trial_ids.add(r.trial_id)
+            else:
+                node.open_trial_ids.discard(r.trial_id)
         return trial.name
 
     def delete_trial(self, trial_name: str) -> None:
@@ -116,15 +141,25 @@ class NestedDictRAMDataStore(datastore.DataStore):
             if r.trial_id not in node.trials:
                 raise datastore.NotFoundError(f"No such trial: {trial_name}")
             del node.trials[r.trial_id]
+            node.open_trial_ids.discard(r.trial_id)
+            if r.trial_id == node.max_trial:
+                node.max_trial = max(node.trials.keys(), default=0)
 
     def list_trials(
         self, study_name: str, *, states: Optional[tuple] = None
     ) -> List[study_pb2.Trial]:
         with self._lock:
             node = self._node(study_name)
-            # States filter before the copy (same rationale as the op done
-            # filter: completed history dominates a long study, and the
-            # suggest path only wants ACTIVE/REQUESTED rows).
+            if states is not None and _OPEN_TRIAL_STATES.issuperset(states):
+                # Hot path (suggest): walk only the open index — O(open),
+                # not O(history).
+                return [
+                    _copy(node.trials[tid])
+                    for tid in sorted(node.open_trial_ids)
+                    if node.trials[tid].state in states
+                ]
+            # General listings filter before the copy (completed history
+            # dominates a long study).
             return [
                 _copy(t)
                 for _, t in sorted(node.trials.items())
@@ -133,8 +168,7 @@ class NestedDictRAMDataStore(datastore.DataStore):
 
     def max_trial_id(self, study_name: str) -> int:
         with self._lock:
-            node = self._node(study_name)
-            return max(node.trials.keys(), default=0)
+            return self._node(study_name).max_trial
 
     # -- suggestion operations --------------------------------------------
 
@@ -150,6 +184,11 @@ class NestedDictRAMDataStore(datastore.DataStore):
             if r.operation_number in ops:
                 raise datastore.AlreadyExistsError(f"Operation exists: {operation.name}")
             ops[r.operation_number] = _copy(operation)
+            if not operation.done:
+                node.undone_op_numbers[r.client_id].add(r.operation_number)
+            node.max_op_number[r.client_id] = max(
+                node.max_op_number[r.client_id], r.operation_number
+            )
         return operation.name
 
     def get_suggestion_operation(
@@ -173,6 +212,10 @@ class NestedDictRAMDataStore(datastore.DataStore):
             if r.operation_number not in ops:
                 raise datastore.NotFoundError(f"No such operation: {operation.name}")
             ops[r.operation_number] = _copy(operation)
+            if operation.done:
+                node.undone_op_numbers[r.client_id].discard(r.operation_number)
+            else:
+                node.undone_op_numbers[r.client_id].add(r.operation_number)
         return operation.name
 
     def list_suggestion_operations(
@@ -185,6 +228,16 @@ class NestedDictRAMDataStore(datastore.DataStore):
     ) -> List[vizier_service_pb2.Operation]:
         with self._lock:
             node = self._node(study_name)
+            client_ops = node.suggestion_ops.get(client_id, {})
+            if done is False:
+                # Hot path (suggest dedup): walk only the undone index —
+                # O(undone), not O(session history).
+                candidates = [
+                    client_ops[num]
+                    for num in sorted(node.undone_op_numbers.get(client_id, ()))
+                ]
+            else:
+                candidates = [op for _, op in sorted(client_ops.items())]
             # Filter BEFORE copying: op protos embed their suggested trials,
             # so copy-then-filter makes every SuggestTrials dedup check
             # deep-copy the study's entire operation history (O(n) copies
@@ -195,7 +248,7 @@ class NestedDictRAMDataStore(datastore.DataStore):
             # in-tree callers are pure predicates like `not op.done`).
             ops = [
                 _copy(op)
-                for _, op in sorted(node.suggestion_ops.get(client_id, {}).items())
+                for op in candidates
                 if (done is None or op.done == done)
                 and (filter_fn is None or filter_fn(op))
             ]
@@ -204,7 +257,7 @@ class NestedDictRAMDataStore(datastore.DataStore):
     def max_suggestion_operation_number(self, study_name: str, client_id: str) -> int:
         with self._lock:
             node = self._node(study_name)
-            return max(node.suggestion_ops.get(client_id, {}).keys(), default=0)
+            return node.max_op_number.get(client_id, 0)
 
     # -- early stopping operations ----------------------------------------
 
