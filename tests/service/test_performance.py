@@ -143,3 +143,59 @@ class TestSharedChannelLifecycle:
             assert entry.broken
         finally:
             srv.stop(0)
+
+
+class TestSuggestScalesConstantTime:
+    """Regression gate for the round-5 open/undone indexes: the per-suggest
+    datastore work must not grow with completed history. Counted in proto
+    copies (deterministic) rather than wall time (flaky)."""
+
+    def test_copies_per_suggest_independent_of_history(self, monkeypatch):
+        from tests.service.test_service import _make_servicer
+        from vizier_tpu.service import proto_converters as pcv
+        from vizier_tpu.service import ram_datastore
+        from vizier_tpu.service.protos import study_pb2, vizier_service_pb2 as V
+        from vizier_tpu.testing import stress
+
+        servicer = _make_servicer()
+        study = pcv.study_to_proto(
+            stress.stress_study_config(), "owners/p/studies/s"
+        )
+        servicer.CreateStudy(V.CreateStudyRequest(parent="owners/p", study=study))
+        name = "owners/p/studies/s"
+
+        def round_():
+            op = servicer.SuggestTrials(
+                V.SuggestTrialsRequest(
+                    parent=name, suggestion_count=1, client_id="w"
+                )
+            )
+            assert not op.error, op.error
+            t = op.response.trials[0]
+            m = study_pb2.Measurement()
+            m.metrics.add(name="obj", value=0.5)
+            servicer.CompleteTrial(
+                V.CompleteTrialRequest(name=t.name, final_measurement=m)
+            )
+
+        counter = {"copies": 0}
+        real_copy = ram_datastore._copy
+
+        def counting_copy(proto):
+            counter["copies"] += 1
+            return real_copy(proto)
+
+        monkeypatch.setattr(ram_datastore, "_copy", counting_copy)
+
+        def copies_for_round():
+            counter["copies"] = 0
+            round_()
+            return counter["copies"]
+
+        baseline = max(copies_for_round() for _ in range(3))
+        for _ in range(300):  # grow the completed history
+            round_()
+        at_scale = max(copies_for_round() for _ in range(3))
+        # Identical datastore work regardless of history size; allow +2
+        # copies of slack for incidental bookkeeping.
+        assert at_scale <= baseline + 2, (baseline, at_scale)
