@@ -123,7 +123,7 @@ class TestSharedChannelLifecycle:
         finally:
             srv.stop(0)
 
-    def test_transient_failure_marks_entry_broken(self):
+    def test_only_shutdown_marks_entry_broken(self):
         import grpc as grpc_lib
 
         from vizier_tpu.service import grpc_stubs
@@ -133,12 +133,15 @@ class TestSharedChannelLifecycle:
             grpc_stubs.create_vizier_stub(srv.endpoint)
             entry = grpc_stubs._CHANNELS[srv.endpoint]
             assert not entry.broken
+            # TRANSIENT_FAILURE is a normal reconnect state (server restart
+            # blip): it must NOT flag the channel — evicting on it would
+            # close() the channel underneath every stub sharing it while
+            # gRPC's auto-reconnect would have recovered.
             entry._watch(grpc_lib.ChannelConnectivity.TRANSIENT_FAILURE)
-            assert entry.broken
-            # READY clears the flag: TRANSIENT_FAILURE during a reconnect
-            # blip must not get a healthy channel evicted later.
+            assert not entry.broken
             entry._watch(grpc_lib.ChannelConnectivity.READY)
             assert not entry.broken
+            # Only SHUTDOWN (the channel is permanently dead) flags it.
             entry._watch(grpc_lib.ChannelConnectivity.SHUTDOWN)
             assert entry.broken
         finally:

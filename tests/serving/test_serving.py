@@ -1,0 +1,448 @@
+"""Serving runtime: cache TTL/LRU/invalidation, coalescing, serving stats."""
+
+import threading
+import time
+
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.pythia import policy as policy_lib
+from vizier_tpu.serving import ServingConfig
+from vizier_tpu.serving import ServingStats
+from vizier_tpu.serving.coalescer import RequestCoalescer
+from vizier_tpu.serving.designer_cache import DesignerStateCache
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import pythia_service, vizier_service
+from vizier_tpu.service.protos import vizier_service_pb2
+
+STUDY = "owners/o/studies/s"
+
+
+def _study_config(algorithm="DEFAULT", num_params=2):
+    config = vz.StudyConfig(algorithm=algorithm)
+    for d in range(num_params):
+        config.search_space.root.add_float_param(f"x{d}", 0.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+def _make_service(policy_factory=None, serving_config=None):
+    servicer = vizier_service.VizierServicer()
+    pythia = pythia_service.PythiaServicer(
+        servicer, policy_factory, serving_config=serving_config
+    )
+    servicer.set_pythia(pythia)
+    return servicer, pythia
+
+
+def _create_study(servicer, config=None, name=STUDY):
+    study = pc.study_to_proto(config or _study_config(), name)
+    servicer.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(parent="owners/o", study=study)
+    )
+
+
+def _complete_some_trials(servicer, n=3, name=STUDY):
+    from vizier_tpu.service.protos import study_pb2
+
+    for i in range(n):
+        created = servicer.CreateTrial(
+            vizier_service_pb2.CreateTrialRequest(parent=name, trial=study_pb2.Trial())
+        )
+        req = vizier_service_pb2.CompleteTrialRequest(name=created.name)
+        m = req.final_measurement.metrics.add()
+        m.name, m.value = "obj", 0.1 * i
+        servicer.CompleteTrial(req)
+
+
+class TestServingStats:
+    def test_increment_and_snapshot(self):
+        stats = ServingStats()
+        stats.increment("cache_hits")
+        stats.increment("warm_trains", 3)
+        snap = stats.snapshot()
+        assert snap["cache_hits"] == 1
+        assert snap["warm_trains"] == 3
+        assert snap["cold_trains"] == 0
+
+    def test_unknown_counter_rejected(self):
+        with pytest.raises(KeyError):
+            ServingStats().increment("cache_hit")  # singular: a typo
+
+
+class TestDesignerStateCache:
+    def test_miss_then_hit(self):
+        cache = DesignerStateCache()
+        built = []
+
+        def factory():
+            built.append(1)
+            return object()
+
+        e1 = cache.get_or_create("s1", factory)
+        e2 = cache.get_or_create("s1", factory)
+        assert e1 is e2
+        assert len(built) == 1
+        assert cache.stats.get("cache_misses") == 1
+        assert cache.stats.get("cache_hits") == 1
+
+    def test_ttl_eviction(self):
+        clock = [0.0]
+        cache = DesignerStateCache(ttl_seconds=10.0, time_fn=lambda: clock[0])
+        first = cache.get_or_create("s1", object)
+        clock[0] = 5.0
+        assert cache.get_or_create("s1", object) is first  # within TTL
+        clock[0] = 16.0  # idle > TTL since last use at t=5
+        fresh = cache.get_or_create("s1", object)
+        assert fresh is not first
+        assert cache.stats.get("cache_evictions_ttl") == 1
+
+    def test_lru_eviction(self):
+        cache = DesignerStateCache(max_entries=2)
+        cache.get_or_create("s1", object)
+        cache.get_or_create("s2", object)
+        cache.get_or_create("s1", object)  # s1 now most recent
+        cache.get_or_create("s3", object)  # evicts s2 (least recent)
+        assert cache.study_names() == ["s1", "s3"]
+        assert cache.stats.get("cache_evictions_lru") == 1
+
+    def test_invalidate(self):
+        cache = DesignerStateCache()
+        cache.get_or_create("s1", object)
+        assert cache.invalidate("s1")
+        assert not cache.invalidate("s1")  # already gone
+        assert len(cache) == 0
+        assert cache.stats.get("cache_invalidations") == 1
+
+    def test_entry_holds_warm_params_and_ids(self):
+        cache = DesignerStateCache()
+        entry = cache.get_or_create("s1", object)
+        entry.warm_params = {"amplitude": 1.0}
+        entry.incorporated_trial_ids.update([1, 2])
+        again = cache.get_or_create("s1", object)
+        assert again.warm_params == {"amplitude": 1.0}
+        assert again.incorporated_trial_ids == {1, 2}
+
+
+class TestRequestCoalescer:
+    def test_concurrent_callers_share_one_computation(self):
+        coalescer = RequestCoalescer()
+        calls = []
+        release = threading.Event()
+        results = []
+
+        def compute():
+            calls.append(1)
+            release.wait(timeout=10)
+            return {"v": 42}
+
+        def run():
+            results.append(coalescer.coalesce("k", compute, clone=dict))
+
+        threads = [threading.Thread(target=run) for _ in range(5)]
+        for t in threads:
+            t.start()
+        # Wait until the leader is inside compute and followers queued.
+        deadline = time.time() + 10
+        while len(coalescer.inflight_keys()) < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)  # let followers reach the wait
+        release.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(calls) == 1
+        assert len(results) == 5
+        assert all(r == {"v": 42} for r in results)
+        # Followers got clones, not the shared object.
+        assert len({id(r) for r in results}) == 5
+        assert coalescer._stats.get("coalesced_requests") == 4
+
+    def test_sequential_calls_do_not_share(self):
+        coalescer = RequestCoalescer()
+        calls = []
+        coalescer.coalesce("k", lambda: calls.append(1))
+        coalescer.coalesce("k", lambda: calls.append(1))
+        assert len(calls) == 2
+
+    def test_leader_error_propagates_to_followers(self):
+        coalescer = RequestCoalescer()
+        entered = threading.Event()
+        release = threading.Event()
+        errors = []
+
+        def compute():
+            entered.set()
+            release.wait(timeout=10)
+            raise RuntimeError("boom")
+
+        def leader():
+            try:
+                coalescer.coalesce("k", compute)
+            except RuntimeError as e:
+                errors.append(str(e))
+
+        def follower():
+            entered.wait(timeout=10)
+            try:
+                coalescer.coalesce("k", compute)
+            except RuntimeError as e:
+                errors.append(str(e))
+
+        t1 = threading.Thread(target=leader)
+        t2 = threading.Thread(target=follower)
+        t1.start()
+        t2.start()
+        entered.wait(timeout=10)
+        time.sleep(0.1)
+        release.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert errors == ["boom", "boom"]
+
+
+class TestServingConfig:
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("VIZIER_SERVING_CACHE", "0")
+        monkeypatch.setenv("VIZIER_SERVING_WARM_START", "0")
+        cfg = ServingConfig.from_env()
+        assert not cfg.designer_cache
+        assert not cfg.warm_start
+        assert cfg.coalescing
+
+    def test_disabled(self):
+        cfg = ServingConfig.disabled()
+        assert not (cfg.designer_cache or cfg.warm_start or cfg.coalescing)
+
+
+class TestBudgetPolicyValidation:
+    def test_factory_rejects_bad_metadata_value_early(self):
+        from vizier_tpu.service.policy_factory import DefaultPolicyFactory
+
+        problem = vz.ProblemStatement()
+        problem.search_space.root.add_float_param("x", 0.0, 1.0)
+        problem.metric_information.append(
+            vz.MetricInformation(
+                name="o", goal=vz.ObjectiveMetricGoal.MAXIMIZE
+            )
+        )
+        problem.metadata.ns("gp_ucb_pe")["acquisition_budget_policy"] = "per_pik"
+        with pytest.raises(ValueError, match="acquisition_budget_policy.*per_pik"):
+            DefaultPolicyFactory()(problem, "DEFAULT", None, STUDY)
+
+
+class _CountingPolicyFactory:
+    """A deterministic slow policy: counts designer computations."""
+
+    def __init__(self, delay_s: float = 1.0):
+        self.computations = 0
+        self.delay_s = delay_s
+        self._lock = threading.Lock()
+
+    def __call__(self, problem, algorithm, supporter, study_name):
+        outer = self
+
+        class _P(policy_lib.Policy):
+            def suggest(self, request):
+                with outer._lock:
+                    outer.computations += 1
+                time.sleep(outer.delay_s)
+                suggestions = [
+                    vz.TrialSuggestion(parameters={"x0": 0.25, "x1": 0.75})
+                    for _ in range(request.count)
+                ]
+                return policy_lib.SuggestDecision(suggestions=suggestions)
+
+        return _P()
+
+
+class TestSuggestCoalescing:
+    def test_n_concurrent_suggests_one_computation(self):
+        """Acceptance: N concurrent SuggestTrials -> exactly 1 designer
+        computation; every caller receives a valid suggestion."""
+        factory = _CountingPolicyFactory(delay_s=1.5)
+        servicer, pythia = _make_service(policy_factory=factory)
+        _create_study(servicer)
+
+        n = 6
+        ops = [None] * n
+        barrier = threading.Barrier(n)
+
+        def worker(i):
+            barrier.wait(timeout=10)
+            ops[i] = servicer.SuggestTrials(
+                vizier_service_pb2.SuggestTrialsRequest(
+                    parent=STUDY, suggestion_count=1, client_id=f"client-{i}"
+                )
+            )
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+
+        assert factory.computations == 1
+        ids = set()
+        for op in ops:
+            assert op is not None and op.done and not op.error
+            assert len(op.response.trials) == 1
+            trial = op.response.trials[0]
+            ids.add(trial.id)
+            # Identical results: every caller got the shared computation's
+            # suggested point (as its own distinct trial).
+            values = {p.name: p.value.double_value for p in trial.parameters}
+            assert values == {"x0": 0.25, "x1": 0.75}
+        assert len(ids) == n  # distinct trials, one per caller
+        snap = pythia.serving_stats()
+        assert snap["coalesced_requests"] == n - 1
+        assert snap["coalesced_computations"] == 1
+
+    def test_coalescing_disabled_by_config(self):
+        factory = _CountingPolicyFactory(delay_s=0.3)
+        servicer, pythia = _make_service(
+            policy_factory=factory,
+            serving_config=ServingConfig(coalescing=False),
+        )
+        _create_study(servicer)
+        n = 3
+        ops = [None] * n
+        barrier = threading.Barrier(n)
+
+        def worker(i):
+            barrier.wait(timeout=10)
+            ops[i] = servicer.SuggestTrials(
+                vizier_service_pb2.SuggestTrialsRequest(
+                    parent=STUDY, suggestion_count=1, client_id=f"client-{i}"
+                )
+            )
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        for op in ops:
+            assert op.done and not op.error
+        assert factory.computations == n
+        assert pythia.serving_stats()["coalesced_requests"] == 0
+
+
+@pytest.fixture(scope="module")
+def fast_gp_kwargs():
+    """Keeps the real-GP serving tests' designers cheap on CPU."""
+    from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+
+    return dict(
+        max_acquisition_evaluations=300,
+        ard_restarts=2,
+        ard_optimizer=lbfgs_lib.LbfgsOptimizer(maxiter=5),
+    )
+
+
+class _FastGPFactory:
+    """DEFAULT -> a cheap VizierGPUCBPEBandit, routed through serving."""
+
+    def __init__(self, serving_runtime, designer_kwargs):
+        self._serving = serving_runtime
+        self._kwargs = designer_kwargs
+
+    def __call__(self, problem, algorithm, supporter, study_name):
+        from vizier_tpu.designers import gp_ucb_pe
+        from vizier_tpu.serving.policy import CachedDesignerStatePolicy
+
+        kwargs = dict(self._kwargs)
+        cfg = self._serving.config
+        kwargs["use_warm_start_ard"] = cfg.warm_start
+        if cfg.warm_start:
+            kwargs["warm_ard_restarts"] = cfg.warm_ard_restarts
+        return CachedDesignerStatePolicy(
+            supporter,
+            lambda p, **kw: gp_ucb_pe.VizierGPUCBPEBandit(p, **kwargs),
+            self._serving,
+            study_name,
+            use_seeding=True,
+        )
+
+
+def _gp_service(fast_gp_kwargs, serving_config=None):
+    servicer = vizier_service.VizierServicer()
+    pythia = pythia_service.PythiaServicer(servicer, serving_config=serving_config)
+    pythia._policy_factory = _FastGPFactory(pythia.serving_runtime, fast_gp_kwargs)
+    servicer.set_pythia(pythia)
+    return servicer, pythia
+
+
+class TestServingWithRealDesigner:
+    def test_warm_cold_counters_and_cache_reuse(self, fast_gp_kwargs):
+        servicer, pythia = _gp_service(fast_gp_kwargs)
+        _create_study(servicer)
+        _complete_some_trials(servicer, 3)
+
+        for step in range(3):
+            op = servicer.SuggestTrials(
+                vizier_service_pb2.SuggestTrialsRequest(
+                    parent=STUDY, suggestion_count=1, client_id=f"w{step}"
+                )
+            )
+            assert op.done and not op.error, op.error
+            req = vizier_service_pb2.CompleteTrialRequest(
+                name=op.response.trials[0].name
+            )
+            m = req.final_measurement.metrics.add()
+            m.name, m.value = "obj", 0.5
+            servicer.CompleteTrial(req)
+
+        snap = pythia.serving_stats()
+        # First suggest builds + cold-trains; later suggests hit the cached
+        # designer and warm-train from its previous optimum.
+        assert snap["cache_misses"] == 1
+        assert snap["cache_hits"] == 2
+        assert snap["cold_trains"] == 1
+        assert snap["warm_trains"] == 2
+        assert snap["cached_studies"] == 1
+        # The cache entry mirrors the trained unconstrained ARD params.
+        entry = pythia.serving_runtime.designer_cache.get_or_create(
+            STUDY, lambda: None
+        )
+        assert entry.warm_params is not None
+
+    def test_delete_study_invalidates_cache(self, fast_gp_kwargs):
+        servicer, pythia = _gp_service(fast_gp_kwargs)
+        _create_study(servicer)
+        _complete_some_trials(servicer, 3)
+        op = servicer.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent=STUDY, suggestion_count=1, client_id="w0"
+            )
+        )
+        assert op.done and not op.error, op.error
+        assert pythia.serving_stats()["cached_studies"] == 1
+        servicer.DeleteStudy(vizier_service_pb2.DeleteStudyRequest(name=STUDY))
+        snap = pythia.serving_stats()
+        assert snap["cached_studies"] == 0
+        assert snap["cache_invalidations"] == 1
+
+    def test_warm_start_disabled_stays_cold(self, fast_gp_kwargs):
+        servicer, pythia = _gp_service(
+            fast_gp_kwargs, serving_config=ServingConfig(warm_start=False)
+        )
+        _create_study(servicer)
+        _complete_some_trials(servicer, 3)
+        for step in range(2):
+            op = servicer.SuggestTrials(
+                vizier_service_pb2.SuggestTrialsRequest(
+                    parent=STUDY, suggestion_count=1, client_id=f"w{step}"
+                )
+            )
+            assert op.done and not op.error, op.error
+            req = vizier_service_pb2.CompleteTrialRequest(
+                name=op.response.trials[0].name
+            )
+            m = req.final_measurement.metrics.add()
+            m.name, m.value = "obj", 0.5
+            servicer.CompleteTrial(req)
+        snap = pythia.serving_stats()
+        assert snap["warm_trains"] == 0
+        assert snap["cold_trains"] == 2

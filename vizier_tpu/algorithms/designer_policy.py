@@ -7,6 +7,12 @@ a designer per request and replays all trials; the serializable variants
 checkpoint designer state + an incorporated-trial-id cache into study
 metadata namespace ``designer_policy_v0`` and feed only *new* completed
 trials, falling back to full replay on ``DecodeError``.
+
+The production suggest path does NOT use the stateless wrapper: the
+service's policy factory routes GP algorithms through
+``vizier_tpu.serving.CachedDesignerStatePolicy`` (per-study designer cache
+with TTL/LRU + warm-started ARD) unless serving is disabled, in which case
+``DesignerPolicy`` below is the reference-parity fallback.
 """
 
 from __future__ import annotations
@@ -210,7 +216,11 @@ class InRamDesignerPolicy(policy_lib.Policy):
     """Keeps one designer instance alive in process memory across requests.
 
     Useful for benchmarking (``should_be_cached`` = True); incremental
-    updates without serialization overhead.
+    updates without serialization overhead. For SERVING use
+    ``vizier_tpu.serving.CachedDesignerStatePolicy`` instead: same
+    incremental-update idea, but the designer lives in a shared TTL/LRU
+    cache with explicit invalidation on study deletion rather than for
+    whatever lifetime the Pythia servicer keeps this policy object.
     """
 
     def __init__(

@@ -143,9 +143,14 @@ class NoisyExperimenter(_Wrapper):
         noise_type: str,
         seed: Optional[int] = None,
     ) -> "NoisyExperimenter":
-        """Builds the named BBOB-noisy model (reference ``from_type``)."""
+        """Builds the named BBOB-noisy model (reference ``from_type``).
+
+        ``seed=None`` defaults to 0, matching the reference's
+        ``np.random.default_rng(seed or 0)`` — default runs must be
+        reproducible, not OS-entropy seeded.
+        """
         dim = len(exptr.problem_statement().search_space.parameters)
-        self = cls(exptr, seed=seed)
+        self = cls(exptr, seed=seed or 0)
         self._noise_fn = make_noise_fn(noise_type, dimension=dim, rng=self._rng)
         return self
 

@@ -141,6 +141,15 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     rng_seed: int = 0
     # Injectable ARD optimizer (tests swap in a cheaper one; must be hashable).
     ard_optimizer: Optional[lbfgs_lib.Optimizer] = None
+    # Carry the previous suggest's trained params into the next train as
+    # restart seed 0. False restores the reference's per-request cold train
+    # (restart 0 stays a fixed random init, trained params are discarded).
+    use_warm_start_ard: bool = True
+    # Restart budget for a WARM train (one with trained seed params). None
+    # keeps the full ``ard_restarts`` budget; the serving runtime sets 1 so
+    # steady-state suggests pay one early-exiting L-BFGS run instead of
+    # ``ard_restarts`` full cold starts (A/B: WARM_START_AB.json).
+    warm_ard_restarts: Optional[int] = None
     # Multi-chip data plane: None = auto (build a mesh over all devices when
     # more than one exists and route ARD restarts + acquisition pools through
     # vizier_tpu.parallel); True/False force it on/off.
@@ -211,6 +220,11 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         self._warm_params = self._model.param_collection().random_init_unconstrained(
             jax.random.PRNGKey(self.rng_seed + 1)
         )
+        # True once _warm_params holds genuinely TRAINED params (vs the
+        # random placeholder above) — gates the reduced warm restart budget
+        # and the warm/cold accounting below.
+        self._warm_is_trained = False
+        self._ard_train_counts = {"warm": 0, "cold": 0}
 
     # -- Designer ----------------------------------------------------------
 
@@ -233,21 +247,60 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         rng: Array,
         ensemble_size: int,
         warm_start: Optional[gp_lib.Params] = None,
+        num_restarts: Optional[int] = None,
     ) -> gp_lib.GPState:
-        """ARD train; restarts shard over the mesh when one is present."""
+        """ARD train; restarts shard over the mesh when one is present.
+
+        ``num_restarts`` overrides ``self.ard_restarts`` (the warm-started
+        steady-state path trains with ``warm_ard_restarts``); it is floored
+        at ``ensemble_size`` so the top-k ensemble selection stays valid.
+        """
+        restarts = max(num_restarts or self.ard_restarts, ensemble_size)
         if self._mesh is None:
             return _train_gp(
                 self._model, self._ard, data, rng,
-                self.ard_restarts, ensemble_size, warm_start,
+                restarts, ensemble_size, warm_start,
             )
         from vizier_tpu import parallel
 
         ndev = self._mesh_size()
-        restarts = -(-self.ard_restarts // ndev) * ndev  # ceil to mesh multiple
+        restarts = -(-restarts // ndev) * ndev  # ceil to mesh multiple
         return parallel.train_gp_sharded(
             self._model, self._ard, data, rng,
             restarts, ensemble_size, self._mesh, warm_start,
         )
+
+    def _warm_restart_budget(self) -> Optional[int]:
+        """Restart override for the NEXT train: set only when a trained warm
+        seed exists and a reduced warm budget is configured."""
+        if (
+            self.use_warm_start_ard
+            and self._warm_is_trained
+            and self.warm_ard_restarts is not None
+        ):
+            return self.warm_ard_restarts
+        return None
+
+    def _record_train(self) -> None:
+        self._ard_train_counts[
+            "warm" if (self.use_warm_start_ard and self._warm_is_trained) else "cold"
+        ] += 1
+
+    # -- serving warm-start surface (vizier_tpu.serving) --------------------
+
+    def warm_start_state(self) -> Optional[gp_lib.Params]:
+        """Last trained unconstrained ARD params (None before first train)."""
+        return self._warm_params if self._warm_is_trained else None
+
+    def set_warm_start_state(self, params: gp_lib.Params) -> None:
+        """Injects trained unconstrained params as the next restart seed 0."""
+        self._warm_params = params
+        self._warm_is_trained = True
+
+    @property
+    def ard_train_counts(self) -> dict:
+        """Copies of the warm/cold ARD train counters (serving stats)."""
+        return dict(self._ard_train_counts)
 
     def _maximize(
         self,
@@ -341,14 +394,21 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             data = gp_lib.GPData.from_model_data(self._warped_model_data())
         with profiler.timeit("train_gp"):
             states = self._train(
-                data, self._next_rng(), self.ensemble_size, self._warm_params
+                data,
+                self._next_rng(),
+                self.ensemble_size,
+                self._warm_params,
+                num_restarts=self._warm_restart_budget(),
             )
-        # Warm-start the next suggest from this one's best member
-        # (states.params are constrained; map back through the bijectors).
-        coll = self._model.param_collection()
-        self._warm_params = coll.unconstrain(
-            jax.tree_util.tree_map(lambda a: a[0], states.params)
-        )
+        self._record_train()
+        if self.use_warm_start_ard:
+            # Warm-start the next suggest from this one's best member
+            # (states.params are constrained; map back through the bijectors).
+            coll = self._model.param_collection()
+            self._warm_params = coll.unconstrain(
+                jax.tree_util.tree_map(lambda a: a[0], states.params)
+            )
+            self._warm_is_trained = True
         predictive = gp_lib.EnsemblePredictive(states)
         self._last_predictive = predictive
 
@@ -453,6 +513,9 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
                 self._next_rng(),
                 num_restarts=self.ard_restarts,
             )
+        # Stacked-residual training has no warm-start path (priors retrain
+        # the whole stack); it always counts as a cold train.
+        self._ard_train_counts["cold"] += 1
         self._last_predictive = stack  # duck-typed .predict
         best_label = jnp.max(jnp.where(data.row_mask, data.labels, -jnp.inf))
         scoring = acquisitions.ScoringFunction(
@@ -510,6 +573,9 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             states = _train_gp_per_metric(
                 self._model, self._ard, batched, self._next_rng(), self.ard_restarts
             )
+        # Per-metric vmapped training is not warm-started (GP-UCB-PE owns
+        # the warm multimetric path); cold by definition.
+        self._ard_train_counts["cold"] += 1
         m = len(objective_idx)
         directions = jnp.abs(
             jax.random.normal(self._next_rng(), (64, m), dtype=jnp.float32)

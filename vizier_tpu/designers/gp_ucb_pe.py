@@ -626,6 +626,17 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         # Per-pick sweep optimizers under the per_batch budget policy, keyed
         # by their per-pick evaluation budget.
         self._pick_opt_cache: dict = {}
+        # Per-objective warm-start seeds for the independent-GP path,
+        # random-initialized so the ARD program's pytree structure is
+        # stable from the first suggest (same trick as the base class's
+        # scalar `_warm_params`). The multitask (SEPARABLE) trainer has no
+        # warm-start path and always counts as a cold train.
+        coll = self._model.param_collection()
+        n_obj = len(self._objective_indices())
+        keys = jax.random.split(jax.random.PRNGKey(self.rng_seed + 2), max(n_obj, 1))
+        self._warm_params_me = [
+            coll.random_init_unconstrained(k) for k in keys[:n_obj]
+        ]
 
     def _split_vec_opt(self, num_picks: int) -> vectorized_lib.VectorizedOptimizer:
         """One full budget split evenly across ``num_picks`` picks."""
@@ -732,17 +743,57 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
                     mt_model, self._ard, mt_data, self._next_rng(),
                     restarts, ensemble, self._mesh,
                 )
+            self._ard_train_counts["cold"] += 1
             self._cached_states = (states, datas)
             return self._cached_states
         # Mesh-aware: restarts shard over devices when a mesh is present.
+        # Each metric's train is seeded with ITS previous optimum (restart
+        # 0); with a trained seed and a configured warm budget the restart
+        # count drops to ``warm_ard_restarts`` — the steady-state serving
+        # win (hyperparameters move little between suggests, so the seeded
+        # restart early-exits the L-BFGS while random restarts burn the
+        # full budget).
+        warm_budget = self._warm_restart_budget()
         states_list = [
-            self._train(data, self._next_rng(), ensemble) for data in datas
+            self._train(
+                data,
+                self._next_rng(),
+                ensemble,
+                warm_start=self._warm_params_me[j],
+                num_restarts=warm_budget,
+            )
+            for j, data in enumerate(datas)
         ]
+        self._record_train()
+        if self.use_warm_start_ard:
+            coll = self._model.param_collection()
+            self._warm_params_me = [
+                coll.unconstrain(
+                    jax.tree_util.tree_map(lambda a: a[0], states.params)
+                )
+                for states in states_list
+            ]
+            self._warm_is_trained = True
         states_me = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *states_list
         )
         self._cached_states = (states_me, datas)
         return self._cached_states
+
+    # -- serving warm-start surface (vizier_tpu.serving) --------------------
+
+    def warm_start_state(self) -> Optional[List]:
+        """Per-objective trained unconstrained params (independent path)."""
+        return list(self._warm_params_me) if self._warm_is_trained else None
+
+    def set_warm_start_state(self, params: List) -> None:
+        if len(params) != len(self._warm_params_me):
+            raise ValueError(
+                f"Expected {len(self._warm_params_me)} per-metric param "
+                f"pytrees, got {len(params)}."
+            )
+        self._warm_params_me = list(params)
+        self._warm_is_trained = True
 
     def _use_multitask(self, num_metrics: int) -> bool:
         return (

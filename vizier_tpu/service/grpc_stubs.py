@@ -171,17 +171,14 @@ class _ChannelEntry:
         channel.subscribe(self._watch, try_to_connect=False)
 
     def _watch(self, state: grpc.ChannelConnectivity) -> None:
-        if state in (
-            grpc.ChannelConnectivity.SHUTDOWN,
-            grpc.ChannelConnectivity.TRANSIENT_FAILURE,
-        ):
+        # ONLY SHUTDOWN marks a channel broken. TRANSIENT_FAILURE is a
+        # normal intermediate state (a failed connect attempt during a
+        # server restart, before gRPC's auto-reconnect succeeds); treating
+        # it as broken made a _shared_channel call racing a brief outage
+        # evict-and-close() the channel underneath every stub already
+        # sharing it — permanently killing stubs gRPC would have recovered.
+        if state is grpc.ChannelConnectivity.SHUTDOWN:
             self.broken = True
-        elif state is grpc.ChannelConnectivity.READY:
-            # TRANSIENT_FAILURE is a normal intermediate state (a failed
-            # connect attempt before gRPC's auto-reconnect succeeds); once
-            # the channel reaches READY it is healthy again, and evicting
-            # it would close() it underneath every stub already sharing it.
-            self.broken = False
 
 
 _CHANNELS: Dict[str, _ChannelEntry] = {}

@@ -13,8 +13,51 @@ from vizier_tpu.pythia import policy as policy_lib
 from vizier_tpu.pythia import policy_supporter as supporter_lib
 
 
+_ALLOWED_BUDGET_POLICIES = ("first_pick_full", "per_batch", "per_pick")
+
+
 class DefaultPolicyFactory:
-    """Maps well-known algorithm names to policies."""
+    """Maps well-known algorithm names to policies.
+
+    With a ``serving_runtime`` (``vizier_tpu.serving.ServingRuntime``), the
+    GP algorithms route through the per-study designer-state cache
+    (``CachedDesignerStatePolicy``) instead of the stateless
+    fresh-designer-per-request ``DesignerPolicy``, and the designers are
+    configured for warm-started ARD per the runtime's config.
+    """
+
+    def __init__(self, serving_runtime=None):
+        self._serving = serving_runtime
+
+    def _gp_designer_kwargs(self) -> dict:
+        """Serving-config-driven designer knobs for the GP algorithms."""
+        if self._serving is None:
+            return {}
+        cfg = self._serving.config
+        kwargs = {"use_warm_start_ard": cfg.warm_start}
+        if cfg.warm_start:
+            kwargs["warm_ard_restarts"] = cfg.warm_ard_restarts
+        return kwargs
+
+    def _gp_policy(
+        self, policy_supporter, factory, study_name: str
+    ) -> policy_lib.Policy:
+        """Cache-backed policy when serving is on; stateless otherwise."""
+        from vizier_tpu.algorithms import designer_policy
+
+        if self._serving is not None and self._serving.config.designer_cache:
+            from vizier_tpu.serving import policy as serving_policy
+
+            return serving_policy.CachedDesignerStatePolicy(
+                policy_supporter,
+                factory,
+                self._serving,
+                study_name,
+                use_seeding=True,
+            )
+        return designer_policy.DesignerPolicy(
+            policy_supporter, factory, use_seeding=True
+        )
 
     def __call__(
         self,
@@ -28,8 +71,23 @@ class DefaultPolicyFactory:
 
         algorithm = (algorithm or "DEFAULT").upper()
         if algorithm in ("DEFAULT", "GP_UCB_PE", "ALGORITHM_UNSPECIFIED"):
+            # Validate the metadata override HERE, at policy construction:
+            # a client typo must surface as one descriptive error on the
+            # first suggest, not a deep ValueError inside every designer
+            # construction for the study's lifetime.
+            requested_policy = problem_statement.metadata.ns("gp_ucb_pe").get(
+                "acquisition_budget_policy", cls=str
+            )
+            if requested_policy and requested_policy not in _ALLOWED_BUDGET_POLICIES:
+                raise ValueError(
+                    "Invalid study metadata ns 'gp_ucb_pe' key "
+                    f"'acquisition_budget_policy': {requested_policy!r}. "
+                    f"Allowed values: {', '.join(_ALLOWED_BUDGET_POLICIES)}."
+                )
             try:
                 from vizier_tpu.designers import gp_ucb_pe
+
+                serving_kwargs = self._gp_designer_kwargs()
 
                 def factory(p, **kw):
                     # gRPC clients can request reference acquisition
@@ -37,7 +95,7 @@ class DefaultPolicyFactory:
                     # code path to the designer kwarg: study metadata
                     # ns 'gp_ucb_pe' key 'acquisition_budget_policy' =
                     # per_pick | per_batch | first_pick_full (default).
-                    kwargs = {}
+                    kwargs = dict(serving_kwargs)
                     requested = p.metadata.ns("gp_ucb_pe").get(
                         "acquisition_budget_policy", cls=str
                     )
@@ -49,16 +107,15 @@ class DefaultPolicyFactory:
                 from vizier_tpu.designers import gp_bandit
 
                 factory = lambda p, **kw: gp_bandit.VizierGPBandit(p)
-            return designer_policy.DesignerPolicy(
-                policy_supporter, factory, use_seeding=True
-            )
+            return self._gp_policy(policy_supporter, factory, study_name)
         if algorithm in ("GAUSSIAN_PROCESS_BANDIT",):
             from vizier_tpu.designers import gp_bandit
 
-            return designer_policy.DesignerPolicy(
+            serving_kwargs = self._gp_designer_kwargs()
+            return self._gp_policy(
                 policy_supporter,
-                lambda p, **kw: gp_bandit.VizierGPBandit(p),
-                use_seeding=True,
+                lambda p, **kw: gp_bandit.VizierGPBandit(p, **serving_kwargs),
+                study_name,
             )
         if algorithm == "RANDOM_SEARCH":
             return random_policy.RandomPolicy(policy_supporter)

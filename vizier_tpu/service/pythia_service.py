@@ -24,9 +24,17 @@ _logger = logging.getLogger(__name__)
 
 
 class PythiaServicer:
-    def __init__(self, vizier_service=None, policy_factory=None):
+    def __init__(self, vizier_service=None, policy_factory=None, serving_config=None):
+        from vizier_tpu.serving import runtime as serving_runtime_lib
+
         self._vizier = vizier_service
-        self._policy_factory = policy_factory or policy_factory_lib.DefaultPolicyFactory()
+        # The stateful serving runtime (designer cache + coalescer + stats);
+        # ``serving_config`` (a vizier_tpu.serving.ServingConfig) disables
+        # parts or all of it. None -> defaults with env-var overrides.
+        self._serving = serving_runtime_lib.ServingRuntime(serving_config)
+        self._policy_factory = policy_factory or policy_factory_lib.DefaultPolicyFactory(
+            serving_runtime=self._serving
+        )
         # Cache for policies that declare should_be_cached.
         self._policy_cache = {}
         # Early-stopping policies cached per study (regression rule holds a
@@ -35,6 +43,21 @@ class PythiaServicer:
 
     def connect_to_vizier(self, vizier_service) -> None:
         self._vizier = vizier_service
+
+    @property
+    def serving_runtime(self):
+        return self._serving
+
+    def serving_stats(self) -> dict:
+        """Snapshot of the serving counters + current cache population."""
+        return self._serving.snapshot()
+
+    def invalidate_study(self, study_name: str) -> None:
+        """Drops every piece of per-study serving state (study deleted)."""
+        self._serving.invalidate_study(study_name)
+        self._stopping_policies.pop(study_name, None)
+        for key in [k for k in self._policy_cache if k[0] == study_name]:
+            del self._policy_cache[key]
 
     def _get_policy(
         self, study_config: vz.StudyConfig, algorithm: str, study_name: str
@@ -54,6 +77,32 @@ class PythiaServicer:
 
     def Suggest(
         self, request: pythia_service_pb2.PythiaSuggestRequest, context=None
+    ) -> pythia_service_pb2.PythiaSuggestResponse:
+        if not self._serving.config.coalescing:
+            return self._suggest_compute(request)
+        # Compute-level request coalescing: concurrent suggests against the
+        # SAME study state (name, algorithm, trial frontier, count) collapse
+        # onto one designer computation; followers receive their own copy of
+        # the response (protos are mutable and cross servicer threads).
+        key = (
+            "suggest",
+            request.study_name,
+            request.algorithm,
+            int(request.study_descriptor.max_trial_id),
+            int(request.count),
+        )
+
+        def clone(resp):
+            out = pythia_service_pb2.PythiaSuggestResponse()
+            out.CopyFrom(resp)
+            return out
+
+        return self._serving.coalescer.coalesce(
+            key, lambda: self._suggest_compute(request), clone=clone
+        )
+
+    def _suggest_compute(
+        self, request: pythia_service_pb2.PythiaSuggestRequest
     ) -> pythia_service_pb2.PythiaSuggestResponse:
         response = pythia_service_pb2.PythiaSuggestResponse()
         try:

@@ -30,6 +30,7 @@ class DefaultVizierServer:
         database_url: Optional[str] = None,
         policy_factory=None,
         port: Optional[int] = None,
+        serving_config=None,
     ):
         from vizier_tpu.service import grpc_stubs
         from vizier_tpu.service import pythia_service
@@ -37,8 +38,14 @@ class DefaultVizierServer:
 
         self._port = port or _pick_port()
         self._servicer = vizier_service.VizierServicer(database_url=database_url)
+        # ``serving_config`` (vizier_tpu.serving.ServingConfig) tunes or
+        # disables the stateful serving runtime — designer cache, warm ARD
+        # starts, request coalescing. None -> defaults + env overrides
+        # (VIZIER_SERVING_CACHE / _WARM_START / _COALESCING = 0);
+        # ServingConfig.disabled() restores the reference's stateless
+        # cold-train-per-request behavior.
         self._pythia_servicer = pythia_service.PythiaServicer(
-            self._servicer, policy_factory
+            self._servicer, policy_factory, serving_config=serving_config
         )
         self._servicer.set_pythia(self._pythia_servicer)
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=30))
@@ -56,6 +63,14 @@ class DefaultVizierServer:
     def servicer(self):
         """The in-process servicer (for no-network clients)."""
         return self._servicer
+
+    @property
+    def pythia_servicer(self):
+        return self._pythia_servicer
+
+    def serving_stats(self) -> dict:
+        """Serving counters: cache hits/misses, warm/cold trains, coalescing."""
+        return self._pythia_servicer.serving_stats()
 
     def stop(self, grace: Optional[float] = None) -> None:
         # grpc.Server.stop is non-blocking (returns an event); wait for the
@@ -92,6 +107,7 @@ class DistributedPythiaVizierServer:
         host: str = "localhost",
         database_url: Optional[str] = None,
         policy_factory=None,
+        serving_config=None,
     ):
         from vizier_tpu.service import grpc_stubs
         from vizier_tpu.service import pythia_service
@@ -105,10 +121,12 @@ class DistributedPythiaVizierServer:
         self._vizier_server.add_insecure_port(self._vizier_endpoint)
         self._vizier_server.start()
 
-        # Pythia server (reads trials back through the Vizier stub).
+        # Pythia server (reads trials back through the Vizier stub). Note
+        # DeleteStudy invalidation cannot reach a remote Pythia's designer
+        # cache (no invalidation RPC); its TTL bounds staleness there.
         vizier_stub = grpc_stubs.create_vizier_stub(self._vizier_endpoint)
         self._pythia_servicer = pythia_service.PythiaServicer(
-            vizier_stub, policy_factory
+            vizier_stub, policy_factory, serving_config=serving_config
         )
         self._pythia_server = grpc.server(futures.ThreadPoolExecutor(max_workers=1))
         grpc_stubs.add_pythia_servicer_to_server(

@@ -24,7 +24,7 @@ import datetime
 import logging
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -99,6 +99,16 @@ class VizierServicer:
         self, request: vizier_service_pb2.DeleteStudyRequest, context=None
     ) -> vizier_service_pb2.Empty:
         self.datastore.delete_study(request.name)
+        # Explicitly drop the study's serving state (cached designer, warm
+        # ARD params, stopping policies): a reused study name must never
+        # see its predecessor's designer. In-process Pythia only — a remote
+        # Pythia stub has no invalidation RPC and relies on the cache TTL.
+        invalidate = getattr(self._pythia, "invalidate_study", None)
+        if invalidate is not None:
+            try:
+                invalidate(request.name)
+            except Exception as e:  # deletion must not fail on cache cleanup
+                _logger.warning("Serving-state invalidation failed: %s", e)
         return vizier_service_pb2.Empty()
 
     def SetStudyState(
@@ -147,25 +157,35 @@ class VizierServicer:
             self.datastore.create_suggestion_operation(op)
             self._inflight_ops.add(op.name)
 
-            try:
-                trials = self._suggest_locked(study, study_name, client_id, request)
-                op.response.trials.extend(trials)
-            except Exception as e:  # captured into the long-running op
-                op.error = f"{type(e).__name__}: {e}"
-            finally:
-                op.done = True
-                self.datastore.update_suggestion_operation(op)
-                self._inflight_ops.discard(op.name)
-            return op
+        # The Pythia dispatch runs OUTSIDE the study lock (see _suggest):
+        # the lock protects datastore read-modify-write windows, not the
+        # designer computation. Concurrent clients therefore reach Pythia
+        # with the same trial frontier and coalesce onto ONE computation
+        # (vizier_tpu.serving); a same-client retry meanwhile sees the
+        # not-done op above and polls GetOperation, the reference's
+        # long-running-operation contract.
+        try:
+            trials = self._suggest(study, study_name, client_id, request)
+            op.response.trials.extend(trials)
+        except Exception as e:  # captured into the long-running op
+            op.error = f"{type(e).__name__}: {e}"
+        finally:
+            op.done = True
+            self.datastore.update_suggestion_operation(op)
+            self._inflight_ops.discard(op.name)
+        return op
 
-    def _suggest_locked(
-        self,
-        study: study_pb2.Study,
-        study_name: str,
-        client_id: str,
-        request: vizier_service_pb2.SuggestTrialsRequest,
-    ) -> List[study_pb2.Trial]:
-        count = request.suggestion_count or 1
+    def _claim_open_trials(
+        self, study_name: str, client_id: str, count: int, *, reuse_active: bool = True
+    ) -> Tuple[List[study_pb2.Trial], bool]:
+        """Under the study lock: ACTIVE reuse, then REQUESTED-pool drain.
+
+        Returns ``(trials, reused)``: ``reused`` means the client's
+        existing ACTIVE trials were returned (no pool mutation).
+        ``reuse_active=False`` skips that branch — the post-compute
+        re-drain must not hand the client back the trials it claimed in
+        phase 1.
+        """
         # Only ACTIVE/REQUESTED rows matter here; the storage-level filter
         # keeps this scan O(open trials) instead of O(study history)
         # (measured: RANDOM_SEARCH suggest throughput fell 430→50/s over a
@@ -176,18 +196,18 @@ class VizierServicer:
         )
 
         # 1. Reuse this client's ACTIVE trials.
-        active_for_client = [
-            t
-            for t in open_trials
-            if t.state == study_pb2.Trial.ACTIVE and t.assigned_worker == client_id
-        ]
-        if active_for_client:
-            return active_for_client[:count]
-
-        out: List[study_pb2.Trial] = []
-        sr = resources.StudyResource.from_name(study_name)
+        if reuse_active:
+            active_for_client = [
+                t
+                for t in open_trials
+                if t.state == study_pb2.Trial.ACTIVE
+                and t.assigned_worker == client_id
+            ]
+            if active_for_client:
+                return active_for_client[:count], True
 
         # 2. Drain the REQUESTED pool.
+        out: List[study_pb2.Trial] = []
         for t in open_trials:
             if len(out) >= count:
                 break
@@ -196,15 +216,28 @@ class VizierServicer:
                 t.assigned_worker = client_id
                 self.datastore.update_trial(t)
                 out.append(t)
-        if len(out) >= count:
-            return out
+        return out, False
 
-        # 3. Ask Pythia for the remainder.
+    def _suggest(
+        self,
+        study: study_pb2.Study,
+        study_name: str,
+        client_id: str,
+        request: vizier_service_pb2.SuggestTrialsRequest,
+    ) -> List[study_pb2.Trial]:
+        count = request.suggestion_count or 1
+        with self._study_locks[study_name]:
+            out, reused = self._claim_open_trials(study_name, client_id, count)
+            if reused or len(out) >= count:
+                return out
+            max_id = self.datastore.max_trial_id(study_name)
+
+        # 3. Ask Pythia for the remainder — lock released, so concurrent
+        # clients' identical requests can coalesce at the compute level.
         if self._pythia is None:
             raise RuntimeError("No Pythia endpoint connected to the Vizier service.")
         from vizier_tpu.service.protos import pythia_service_pb2
 
-        max_id = self.datastore.max_trial_id(study_name)
         preq = pythia_service_pb2.PythiaSuggestRequest(
             count=count - len(out),
             algorithm=study.study_spec.algorithm,
@@ -217,41 +250,61 @@ class VizierServicer:
         if presp.error:
             raise RuntimeError(f"Pythia error: {presp.error}")
 
-        # Materialize suggestions as trials: the first `remaining` become
-        # ACTIVE for this client; extras (policy over-produced) stay REQUESTED.
-        remaining = count - len(out)
-        next_id = self.datastore.max_trial_id(study_name)
-        for i, suggestion in enumerate(presp.suggestions):
-            next_id += 1
-            t = study_pb2.Trial()
-            t.CopyFrom(suggestion)
-            t.id = next_id
-            t.name = sr.trial_resource(next_id).name
-            t.creation_time_secs = time.time()
-            if i < remaining:
-                t.state = study_pb2.Trial.ACTIVE
-                t.assigned_worker = client_id
-            else:
-                t.state = study_pb2.Trial.REQUESTED
-            self.datastore.create_trial(t)
-            if i < remaining:
-                out.append(t)
+        sr = resources.StudyResource.from_name(study_name)
+        with self._study_locks[study_name]:
+            # Re-drain first: a coalesced peer that shared this computation
+            # may have materialized extras as REQUESTED while we waited —
+            # claiming those avoids creating duplicate trials for the same
+            # suggested points.
+            refill, _ = self._claim_open_trials(
+                study_name, client_id, count - len(out), reuse_active=False
+            )
+            redrained = bool(refill)
+            out.extend(refill)
 
-        # Persist policy metadata deltas AFTER trial creation so deltas
-        # addressed to the new suggestions' ids resolve; a bad delta must
-        # not lose the suggestion batch.
-        study_kvs, trial_kvs = [], []
-        for delta in presp.metadata_deltas:
-            for kv in delta.key_values:
-                if delta.trial_id == 0:
-                    study_kvs.append(kv)
+            # Materialize suggestions as trials: the first `remaining`
+            # become ACTIVE for this client; extras (policy over-produced)
+            # stay REQUESTED. When the re-drain supplied trials, only the
+            # shortfall is materialized — the shared computation's points
+            # already exist as the peer's trials.
+            remaining = count - len(out)
+            to_create = (
+                list(presp.suggestions)[:remaining]
+                if redrained
+                else list(presp.suggestions)
+            )
+            next_id = self.datastore.max_trial_id(study_name)
+            for i, suggestion in enumerate(to_create):
+                next_id += 1
+                t = study_pb2.Trial()
+                t.CopyFrom(suggestion)
+                t.id = next_id
+                t.name = sr.trial_resource(next_id).name
+                t.creation_time_secs = time.time()
+                if i < remaining:
+                    t.state = study_pb2.Trial.ACTIVE
+                    t.assigned_worker = client_id
                 else:
-                    trial_kvs.append((int(delta.trial_id), kv))
-        if study_kvs or trial_kvs:
-            try:
-                self.datastore.update_metadata(study_name, study_kvs, trial_kvs)
-            except datastore_lib.NotFoundError as e:
-                _logger.warning("Dropping policy metadata delta: %s", e)
+                    t.state = study_pb2.Trial.REQUESTED
+                self.datastore.create_trial(t)
+                if i < remaining:
+                    out.append(t)
+
+            # Persist policy metadata deltas AFTER trial creation so deltas
+            # addressed to the new suggestions' ids resolve; a bad delta must
+            # not lose the suggestion batch.
+            study_kvs, trial_kvs = [], []
+            for delta in presp.metadata_deltas:
+                for kv in delta.key_values:
+                    if delta.trial_id == 0:
+                        study_kvs.append(kv)
+                    else:
+                        trial_kvs.append((int(delta.trial_id), kv))
+            if study_kvs or trial_kvs:
+                try:
+                    self.datastore.update_metadata(study_name, study_kvs, trial_kvs)
+                except datastore_lib.NotFoundError as e:
+                    _logger.warning("Dropping policy metadata delta: %s", e)
         return out
 
     def GetOperation(

@@ -1,0 +1,38 @@
+"""Stateful serving runtime: designer cache, request coalescing, stats.
+
+The reference serves every ``SuggestTrials`` with a cold-constructed
+designer and a from-scratch ARD train (``designer_policy.py``'s stateless
+``DesignerPolicy``). This package keeps per-study designer state alive
+between requests instead:
+
+- :class:`DesignerStateCache` — live designer + last trained unconstrained
+  ARD params per study, TTL/LRU-evicted, invalidated on study deletion;
+- :class:`RequestCoalescer` — concurrent identical suggest computations
+  collapse onto one in-flight designer run;
+- :class:`CachedDesignerStatePolicy` — the Pythia policy that routes
+  through the cache with incremental trial updates and warm-started ARD;
+- :class:`ServingStats` — cache hit/miss, warm/cold train, and coalescing
+  counters behind a small snapshot API;
+- :class:`ServingConfig` — the knobs (all on by default; env-overridable).
+
+See ``docs/guides/serving.md`` for semantics and the intentional deviation
+from the reference's per-request cold train (PARITY.md).
+"""
+
+from vizier_tpu.serving.config import ServingConfig
+from vizier_tpu.serving.coalescer import RequestCoalescer
+from vizier_tpu.serving.designer_cache import CachedDesignerEntry
+from vizier_tpu.serving.designer_cache import DesignerStateCache
+from vizier_tpu.serving.policy import CachedDesignerStatePolicy
+from vizier_tpu.serving.runtime import ServingRuntime
+from vizier_tpu.serving.stats import ServingStats
+
+__all__ = [
+    "CachedDesignerEntry",
+    "CachedDesignerStatePolicy",
+    "DesignerStateCache",
+    "RequestCoalescer",
+    "ServingConfig",
+    "ServingRuntime",
+    "ServingStats",
+]

@@ -1,0 +1,89 @@
+"""Request coalescing: concurrent identical computations share one run.
+
+The service already deduplicates at the *operation* level (an unfinished
+op for the same client is returned as-is); this lifts deduplication to the
+*compute* level: N concurrent suggest computations for the same study
+state run ONE designer computation, and the result is fanned back out to
+every waiter.
+
+Correctness hinges on the key: callers must include everything the
+computation depends on (study name, algorithm, ``max_trial_id``, count) so
+only requests that would produce an identical answer coalesce. A request
+arriving after the leader finished starts a fresh computation — results
+are never cached beyond the in-flight window, only shared within it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple, TypeVar
+
+from vizier_tpu.serving import stats as stats_lib
+
+T = TypeVar("T")
+
+
+class _Inflight:
+    def __init__(self):
+        self.done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.followers = 0
+
+
+class RequestCoalescer:
+    """Collapses concurrent calls with equal keys onto one computation."""
+
+    def __init__(self, stats: Optional[stats_lib.ServingStats] = None):
+        self._stats = stats or stats_lib.ServingStats()
+        self._lock = threading.Lock()
+        self._inflight: Dict[Hashable, _Inflight] = {}
+
+    def coalesce(
+        self,
+        key: Hashable,
+        compute: Callable[[], T],
+        clone: Optional[Callable[[T], T]] = None,
+    ) -> T:
+        """Runs ``compute`` once per concurrent key; fans the result out.
+
+        The first caller for a key becomes the leader and runs ``compute``;
+        callers arriving while it is in flight block until it finishes and
+        receive the same result (``clone`` applied for followers when the
+        result is mutable — proto responses must not be shared across
+        servicer threads). A leader's exception propagates to every waiter.
+        """
+        with self._lock:
+            entry = self._inflight.get(key)
+            if entry is not None:
+                entry.followers += 1
+                leader = False
+            else:
+                entry = _Inflight()
+                self._inflight[key] = entry
+                leader = True
+        if not leader:
+            entry.done.wait()
+            self._stats.increment("coalesced_requests")
+            if entry.error is not None:
+                raise entry.error
+            return clone(entry.result) if clone is not None else entry.result
+        try:
+            entry.result = compute()
+        except BaseException as e:
+            entry.error = e
+            raise
+        finally:
+            # Unregister BEFORE waking waiters: a new request arriving after
+            # the computation finished must start fresh, not adopt a result
+            # computed against stale study state.
+            with self._lock:
+                del self._inflight[key]
+                if entry.followers:
+                    self._stats.increment("coalesced_computations")
+            entry.done.set()
+        return entry.result
+
+    def inflight_keys(self) -> Tuple[Hashable, ...]:
+        with self._lock:
+            return tuple(self._inflight)

@@ -1,0 +1,56 @@
+"""Serving runtime knobs.
+
+Everything defaults ON; each knob can be forced off per-process via the
+environment (useful for A/B runs and for restoring the reference's
+cold-train-per-request behavior without code changes):
+
+- ``VIZIER_SERVING_CACHE=0``      — no designer-state cache (stateless
+  ``DesignerPolicy`` per request, the reference shape);
+- ``VIZIER_SERVING_WARM_START=0`` — cache designers but cold-train ARD on
+  every suggest (full restart budget from random inits);
+- ``VIZIER_SERVING_COALESCING=0`` — every Pythia suggest computes its own
+  designer run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def _env_on(name: str) -> bool:
+    return os.environ.get(name, "1") not in ("0", "false", "False", "")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Knobs for the stateful serving runtime."""
+
+    # Keep live designers + trained ARD params per study.
+    designer_cache: bool = True
+    # Inject the previous suggest's trained params as restart seed 0 and
+    # shrink the restart budget to ``warm_ard_restarts``.
+    warm_start: bool = True
+    # Collapse concurrent identical Pythia suggest computations.
+    coalescing: bool = True
+    # Cache sizing: LRU beyond max_entries, TTL on idle entries.
+    cache_max_entries: int = 64
+    cache_ttl_seconds: float = 3600.0
+    # Restart budget for a warm-started ARD train (cold trains keep the
+    # designer's full ``ard_restarts``). The A/B evidence for 1 restart is
+    # WARM_START_AB.json (latency + regret parity).
+    warm_ard_restarts: int = 1
+
+    @classmethod
+    def from_env(cls) -> "ServingConfig":
+        """The default config with per-knob environment overrides applied."""
+        return cls(
+            designer_cache=_env_on("VIZIER_SERVING_CACHE"),
+            warm_start=_env_on("VIZIER_SERVING_WARM_START"),
+            coalescing=_env_on("VIZIER_SERVING_COALESCING"),
+        )
+
+    @classmethod
+    def disabled(cls) -> "ServingConfig":
+        """Reference behavior: stateless, cold, uncoalesced."""
+        return cls(designer_cache=False, warm_start=False, coalescing=False)

@@ -1,0 +1,125 @@
+"""The serving policy: cached designer + incremental updates + warm ARD.
+
+Stateless per-request object over shared state: the policy itself is
+rebuilt per Pythia request (cheap), while the designer, its trained ARD
+params, and the incorporated-trial-id set live in the process-wide
+:class:`~vizier_tpu.serving.designer_cache.DesignerStateCache`. Contrast
+with ``algorithms.designer_policy.DesignerPolicy`` (fresh designer + full
+trial replay per request — the reference shape) and
+``InRamDesignerPolicy`` (lives only as long as the policy object the
+Pythia servicer happens to cache, no TTL/LRU/invalidation).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, List, Optional, Sequence
+
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.algorithms import designer_policy
+from vizier_tpu.pythia import policy as policy_lib
+from vizier_tpu.pythia import policy_supporter as supporter_lib
+from vizier_tpu.pyvizier import base_study_config
+from vizier_tpu.pyvizier import trial as trial_
+from vizier_tpu.serving import designer_cache as cache_lib
+from vizier_tpu.serving import runtime as runtime_lib
+
+_logger = logging.getLogger(__name__)
+
+
+class CachedDesignerStatePolicy(policy_lib.Policy):
+    """Routes suggests through the shared per-study designer cache."""
+
+    def __init__(
+        self,
+        supporter: supporter_lib.PolicySupporter,
+        designer_factory: Callable[[base_study_config.ProblemStatement], Any],
+        runtime: runtime_lib.ServingRuntime,
+        study_name: str,
+        *,
+        use_seeding: bool = False,
+    ):
+        self._supporter = supporter
+        self._designer_factory = designer_factory
+        self._runtime = runtime
+        self._study_name = study_name
+        self._use_seeding = use_seeding
+
+    def suggest(self, request: policy_lib.SuggestRequest) -> policy_lib.SuggestDecision:
+        if self._use_seeding and request.max_trial_id == 0:
+            seed = designer_policy.default_suggestion(
+                request.study_config.to_problem()
+            )
+            rest: Sequence[trial_.TrialSuggestion] = []
+            if request.count > 1:
+                rest = self._run_designer(request, request.count - 1)
+            return policy_lib.SuggestDecision(suggestions=[seed] + list(rest))
+        return policy_lib.SuggestDecision(
+            suggestions=list(self._run_designer(request, request.count))
+        )
+
+    def _run_designer(
+        self, request: policy_lib.SuggestRequest, count: int
+    ) -> List[trial_.TrialSuggestion]:
+        problem = request.study_config.to_problem()
+        cache = self._runtime.designer_cache
+        entry = cache.get_or_create(
+            self._study_name, lambda: self._designer_factory(problem)
+        )
+        with entry.lock:
+            try:
+                return self._update_and_suggest(entry, count)
+            except Exception:
+                # A designer whose live state went bad (e.g. an update that
+                # died halfway) must not poison every later suggest for the
+                # study: drop the entry so the next request rebuilds from a
+                # clean full replay, then surface this request's error.
+                cache.invalidate(self._study_name)
+                _logger.warning(
+                    "Serving designer for %s failed; cache entry invalidated.",
+                    self._study_name,
+                )
+                raise
+
+    def _update_and_suggest(
+        self, entry: cache_lib.CachedDesignerEntry, count: int
+    ) -> List[trial_.TrialSuggestion]:
+        designer = entry.designer
+        completed = self._supporter.GetTrials(
+            status_matches=trial_.TrialStatus.COMPLETED
+        )
+        new_completed = [
+            t for t in completed if t.id not in entry.incorporated_trial_ids
+        ]
+        active = self._supporter.GetTrials(status_matches=trial_.TrialStatus.ACTIVE)
+        before = self._train_counts(designer)
+        designer.update(
+            core_lib.CompletedTrials(new_completed), core_lib.ActiveTrials(active)
+        )
+        entry.incorporated_trial_ids.update(t.id for t in new_completed)
+        suggestions = list(designer.suggest(count))
+        self._account_trains(before, self._train_counts(designer))
+        # Mirror the trained unconstrained ARD params into the entry: the
+        # stats/inspection surface for "what would seed the next train",
+        # and the hand-off if the designer is ever rebuilt around them.
+        get_state = getattr(designer, "warm_start_state", None)
+        if get_state is not None:
+            entry.warm_params = get_state()
+        entry.num_suggests += 1
+        return suggestions
+
+    @staticmethod
+    def _train_counts(designer: Any) -> Optional[dict]:
+        counts = getattr(designer, "ard_train_counts", None)
+        return dict(counts) if counts is not None else None
+
+    def _account_trains(self, before: Optional[dict], after: Optional[dict]) -> None:
+        if before is None or after is None:
+            return
+        stats = self._runtime.stats
+        warm = after.get("warm", 0) - before.get("warm", 0)
+        cold = after.get("cold", 0) - before.get("cold", 0)
+        if warm > 0:
+            stats.increment("warm_trains", warm)
+        if cold > 0:
+            stats.increment("cold_trains", cold)

@@ -1,0 +1,43 @@
+"""ServingRuntime: one object bundling cache + coalescer + stats + config.
+
+The Pythia servicer owns one runtime per process; the policy factory and
+the serving policy share it so every counter lands in one place and
+``DeleteStudy`` invalidation reaches the real cache.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from vizier_tpu.serving import coalescer as coalescer_lib
+from vizier_tpu.serving import config as config_lib
+from vizier_tpu.serving import designer_cache as cache_lib
+from vizier_tpu.serving import stats as stats_lib
+
+
+class ServingRuntime:
+    """Shared serving state for one Pythia servicer."""
+
+    def __init__(
+        self,
+        config: Optional[config_lib.ServingConfig] = None,
+        stats: Optional[stats_lib.ServingStats] = None,
+    ):
+        self.config = config or config_lib.ServingConfig.from_env()
+        self.stats = stats or stats_lib.ServingStats()
+        self.designer_cache = cache_lib.DesignerStateCache(
+            max_entries=self.config.cache_max_entries,
+            ttl_seconds=self.config.cache_ttl_seconds,
+            stats=self.stats,
+        )
+        self.coalescer = coalescer_lib.RequestCoalescer(stats=self.stats)
+
+    def invalidate_study(self, study_name: str) -> bool:
+        """Drops the study's designer state (called on study deletion)."""
+        return self.designer_cache.invalidate(study_name)
+
+    def snapshot(self) -> Dict[str, int]:
+        """All counters plus the current cache population."""
+        out = self.stats.snapshot()
+        out["cached_studies"] = len(self.designer_cache)
+        return out

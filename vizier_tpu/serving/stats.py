@@ -1,0 +1,53 @@
+"""Serving-level counters: cache, warm/cold ARD trains, coalescing.
+
+One process-wide mutex guards all counters; increments happen on the
+suggest control path (microseconds against a multi-ms designer run), so a
+finer-grained scheme buys nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class ServingStats:
+    """Thread-safe monotonic counters with a dict snapshot API."""
+
+    # The fixed counter vocabulary: a typo'd increment should fail loudly
+    # rather than mint a new counter nobody reads.
+    FIELDS = (
+        "cache_hits",
+        "cache_misses",
+        "cache_evictions_ttl",
+        "cache_evictions_lru",
+        "cache_invalidations",
+        "coalesced_requests",  # followers served from a shared computation
+        "coalesced_computations",  # leader runs that had >= 1 follower
+        "warm_trains",
+        "cold_trains",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {f: 0 for f in self.FIELDS}
+
+    def increment(self, field: str, amount: int = 1) -> None:
+        if field not in self._counts:
+            raise KeyError(f"Unknown serving counter: {field!r}")
+        with self._lock:
+            self._counts[field] += amount
+
+    def get(self, field: str) -> int:
+        with self._lock:
+            return self._counts[field]
+
+    def snapshot(self) -> Dict[str, int]:
+        """A point-in-time copy of every counter."""
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            for f in self._counts:
+                self._counts[f] = 0
