@@ -2,6 +2,11 @@
 # Regenerates the protobuf message stubs (messages only; the thin gRPC
 # method stubs are hand-written in vizier_tpu/service/grpc_stubs.py since
 # grpcio-tools is not available in this image).
+#
+# No protoc either? `python tools/regen_protos.py` applies schema additions
+# declared there directly to the serialized descriptors in the pb2 modules
+# (that is how SuggestTrialsRequest/PythiaSuggestRequest.deadline_secs were
+# added); keep the .proto sources, that script, and the pb2 files in sync.
 set -euo pipefail
 cd "$(dirname "$0")/vizier_tpu/service/protos"
 protoc --python_out=. key_value.proto study.proto vizier_service.proto pythia_service.proto

@@ -1,0 +1,96 @@
+"""Shared fixtures for the reliability/chaos tests: tiny in-process stacks."""
+
+import time
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import designer_policy
+from vizier_tpu.pythia import policy as policy_lib
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import pythia_service, vizier_client, vizier_service
+from vizier_tpu.service.protos import vizier_service_pb2
+
+STUDY = "owners/o/studies/s"
+
+
+def study_config(algorithm="RANDOM_SEARCH"):
+    config = vz.StudyConfig(algorithm=algorithm)
+    config.search_space.root.add_float_param("x", 0.0, 1.0)
+    config.search_space.root.add_float_param("y", -1.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+class DesignerPolicyFactory:
+    """Routes every algorithm to a DesignerPolicy over ``designer_factory``."""
+
+    def __init__(self, designer_factory):
+        self._designer_factory = designer_factory
+
+    def __call__(self, problem, algorithm, supporter, study_name):
+        return designer_policy.DesignerPolicy(
+            supporter, lambda p, **kw: self._designer_factory(p)
+        )
+
+
+class SlowPolicyFactory:
+    """A policy whose suggest sleeps ``delay_secs`` (deadline tests)."""
+
+    def __init__(self, delay_secs):
+        self.delay_secs = delay_secs
+        self.computations = 0
+
+    def __call__(self, problem, algorithm, supporter, study_name):
+        outer = self
+
+        class _Slow(policy_lib.Policy):
+            def suggest(self, request):
+                outer.computations += 1
+                time.sleep(outer.delay_secs)
+                return policy_lib.SuggestDecision(
+                    suggestions=[
+                        vz.TrialSuggestion(parameters={"x": 0.5, "y": 0.0})
+                        for _ in range(request.count)
+                    ]
+                )
+
+        return _Slow()
+
+
+def make_stack(
+    policy_factory=None,
+    *,
+    reliability=None,
+    client_reliability="same",
+    config=None,
+    client_service=None,
+):
+    """(servicer, pythia, client) wired in-process around one study.
+
+    ``client_service`` lets callers interpose a chaos stub between client
+    and servicer; ``client_reliability="same"`` mirrors the service config.
+    """
+    servicer = vizier_service.VizierServicer(reliability_config=reliability)
+    pythia = pythia_service.PythiaServicer(
+        servicer, policy_factory, reliability_config=reliability
+    )
+    servicer.set_pythia(pythia)
+    servicer.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(
+            parent="owners/o",
+            study=pc.study_to_proto(config or study_config(), STUDY),
+        )
+    )
+    if client_reliability == "same":
+        client_reliability = reliability
+    client = vizier_client.VizierClient(
+        client_service or servicer, STUDY, "c1", reliability=client_reliability
+    )
+    return servicer, pythia, client
+
+
+def complete(client, trial, value=1.0):
+    client.complete_trial(
+        trial.id, vz.Measurement(metrics={"obj": value})
+    )

@@ -1,0 +1,114 @@
+"""Circuit breaker: automaton transitions, registry, stats wiring."""
+
+from vizier_tpu.reliability.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    CircuitBreakerRegistry,
+)
+from vizier_tpu.serving import ServingStats
+
+
+def _breaker(clock, **kwargs):
+    defaults = dict(failure_threshold=3, window_secs=60.0, cooldown_secs=30.0)
+    defaults.update(kwargs)
+    return CircuitBreaker(time_fn=lambda: clock[0], **defaults)
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_within_window(self):
+        clock = [0.0]
+        breaker = _breaker(clock)
+        for _ in range(2):
+            breaker.record_failure()
+        assert breaker.state == CLOSED
+        assert breaker.allow()
+        breaker.record_failure()
+        assert breaker.state == OPEN
+        assert not breaker.allow()
+
+    def test_window_slides(self):
+        clock = [0.0]
+        breaker = _breaker(clock, window_secs=10.0)
+        breaker.record_failure()
+        breaker.record_failure()
+        clock[0] = 11.0  # first two failures age out of the window
+        breaker.record_failure()
+        assert breaker.state == CLOSED
+
+    def test_success_clears_window(self):
+        clock = [0.0]
+        breaker = _breaker(clock)
+        breaker.record_failure()
+        breaker.record_failure()
+        breaker.record_success()
+        breaker.record_failure()
+        assert breaker.state == CLOSED
+
+    def test_half_open_after_cooldown_then_close_on_success(self):
+        clock = [0.0]
+        breaker = _breaker(clock)
+        for _ in range(3):
+            breaker.record_failure()
+        assert breaker.state == OPEN
+        clock[0] = 29.0
+        assert not breaker.allow()
+        clock[0] = 31.0
+        assert breaker.allow()  # the probe
+        assert breaker.state == HALF_OPEN
+        assert not breaker.allow()  # only one probe admitted
+        breaker.record_success()
+        assert breaker.state == CLOSED
+        assert breaker.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = [0.0]
+        breaker = _breaker(clock)
+        for _ in range(3):
+            breaker.record_failure()
+        clock[0] = 31.0
+        assert breaker.allow()
+        breaker.record_failure()
+        assert breaker.state == OPEN
+        # Fresh cooldown from the probe failure.
+        clock[0] = 60.0
+        assert not breaker.allow()
+        clock[0] = 62.0
+        assert breaker.allow()
+
+
+class TestRegistry:
+    def test_per_study_isolation(self):
+        registry = CircuitBreakerRegistry(failure_threshold=1)
+        registry.get("s1").record_failure()
+        assert registry.get("s1").state == OPEN
+        assert registry.get("s2").state == CLOSED
+        assert registry.open_count() == 1
+        assert registry.states() == {"s1": OPEN, "s2": CLOSED}
+
+    def test_invalidate_drops_breaker(self):
+        registry = CircuitBreakerRegistry(failure_threshold=1)
+        registry.get("s1").record_failure()
+        assert registry.invalidate("s1")
+        assert not registry.invalidate("s1")
+        assert registry.get("s1").state == CLOSED  # fresh breaker
+
+    def test_transitions_counted_in_stats(self):
+        stats = ServingStats()
+        clock = [0.0]
+        registry = CircuitBreakerRegistry(
+            failure_threshold=1,
+            cooldown_secs=5.0,
+            stats=stats,
+            time_fn=lambda: clock[0],
+        )
+        breaker = registry.get("s")
+        breaker.record_failure()  # closed -> open
+        clock[0] = 6.0
+        assert breaker.allow()  # open -> half_open (probe)
+        breaker.record_success()  # half_open -> closed
+        snap = stats.snapshot()
+        assert snap["breaker_open_transitions"] == 1
+        assert snap["breaker_half_open_transitions"] == 1
+        assert snap["breaker_close_transitions"] == 1

@@ -1,0 +1,203 @@
+"""Chaos suite: seeded fault injection end-to-end through the service stack.
+
+Acceptance scenario: with 10% injected designer-failure probability
+(seeded), a 50-trial study completes every trial with reliability on —
+fallback trials carrying the metadata marker — and fails with reliability
+off. Transport and datastore chaos exercise the client retry path.
+"""
+
+import pytest
+
+from tests.reliability import harness
+from vizier_tpu.reliability import ReliabilityConfig, is_fallback_suggestion
+from vizier_tpu.service import vizier_client as vizier_client_lib
+from vizier_tpu.testing import chaos
+from vizier_tpu.testing import failing
+
+
+@pytest.fixture(autouse=True)
+def _fast_polling(monkeypatch):
+    monkeypatch.setattr(
+        vizier_client_lib.environment_variables, "polling_delay_secs", 0.005
+    )
+
+
+def _chaos_stack(monkey, reliability, **stack_kwargs):
+    from vizier_tpu.designers import random as random_designer
+
+    factory = harness.DesignerPolicyFactory(
+        chaos.chaos_designer_factory(
+            lambda p, **kw: random_designer.RandomDesigner(p.search_space, seed=0),
+            monkey,
+        )
+    )
+    return harness.make_stack(factory, reliability=reliability, **stack_kwargs)
+
+
+class TestChaosMonkey:
+    def test_same_seed_same_fault_sequence(self):
+        def pattern(seed):
+            monkey = chaos.ChaosMonkey(seed=seed, failure_prob=0.3)
+            out = []
+            for _ in range(100):
+                try:
+                    monkey.strike("site")
+                    out.append(0)
+                except chaos.InjectedFaultError:
+                    out.append(1)
+            return out
+
+        assert pattern(7) == pattern(7)
+        assert pattern(7) != pattern(8)
+
+    def test_fault_rate_tracks_probability(self):
+        monkey = chaos.ChaosMonkey(seed=0, failure_prob=0.1)
+        faults = 0
+        for _ in range(1000):
+            try:
+                monkey.strike("s")
+            except chaos.InjectedFaultError:
+                faults += 1
+        assert 60 <= faults <= 140  # ~10% with seeded slack
+        assert monkey.counts()["s"]["calls"] == 1000
+        assert monkey.counts()["s"]["faults"] == faults
+
+    def test_latency_injection_uses_sleep_fn(self):
+        slept = []
+        monkey = chaos.ChaosMonkey(
+            seed=0,
+            failure_prob=0.0,
+            latency_prob=1.0,
+            latency_secs=0.25,
+            sleep_fn=slept.append,
+        )
+        monkey.strike("s")
+        assert slept == [0.25]
+
+    def test_zero_prob_never_faults(self):
+        monkey = chaos.ChaosMonkey(seed=0, failure_prob=0.0)
+        for _ in range(100):
+            monkey.strike("s")
+        assert monkey.total_faults() == 0
+
+
+class TestChaosDesigner:
+    def test_injected_fault_surfaces_as_designer_failure(self):
+        from vizier_tpu.designers import random as random_designer
+
+        problem = harness.study_config().to_problem()
+        designer = chaos.ChaosDesigner(
+            random_designer.RandomDesigner(problem.search_space, seed=0),
+            chaos.ChaosMonkey(seed=0, failure_prob=1.0),
+        )
+        with pytest.raises(failing.FailedSuggestError, match="designer.suggest"):
+            designer.suggest(1)
+
+
+class TestChaosStudyCompletion:
+    """The acceptance scenario (50 trials, 10% designer faults, seeded)."""
+
+    TRIALS = 50
+    SEED = 11
+
+    def test_reliability_on_completes_all_trials_with_bounded_fallback(self):
+        # Breaker off in this scenario: isolated 10% faults should be
+        # absorbed 1:1 by fallback; the breaker's open/half-open behavior
+        # under *sustained* failure has its own scenario below.
+        monkey = chaos.ChaosMonkey(seed=self.SEED, failure_prob=0.1)
+        servicer, pythia, client = _chaos_stack(
+            monkey, ReliabilityConfig(breaker=False)
+        )
+        fallback_trials = 0
+        for i in range(1, self.TRIALS + 1):
+            (trial,) = client.get_suggestions(1)
+            assert trial.id == i
+            if is_fallback_suggestion(trial.metadata):
+                fallback_trials += 1
+            harness.complete(client, trial, value=0.01 * i)
+
+        stats = pythia.serving_stats()
+        injected = monkey.counts()["designer.suggest"]["faults"]
+        assert injected > 0, "seed produced no faults; scenario is vacuous"
+        # Every trial completed; every injected fault became exactly one
+        # marked fallback trial; the degradation rate stays bounded.
+        assert fallback_trials == injected == stats["fallbacks"]
+        assert stats["designer_failures"] == injected
+        assert fallback_trials / self.TRIALS <= 0.25
+
+    def test_reliability_off_fails_the_study(self):
+        monkey = chaos.ChaosMonkey(seed=self.SEED, failure_prob=0.1)
+        servicer, pythia, client = _chaos_stack(
+            monkey, ReliabilityConfig.disabled()
+        )
+        completed = 0
+        with pytest.raises(RuntimeError, match="chaos: injected fault"):
+            for i in range(1, self.TRIALS + 1):
+                (trial,) = client.get_suggestions(1)
+                harness.complete(client, trial)
+                completed += 1
+        assert completed < self.TRIALS
+        assert pythia.serving_stats()["fallbacks"] == 0
+
+    def test_sustained_failure_opens_then_half_opens_breaker(self):
+        """Breaker lifecycle under 100% faults, via serving_stats()."""
+        monkey = chaos.ChaosMonkey(seed=0, failure_prob=1.0)
+        reliability = ReliabilityConfig(
+            breaker_failure_threshold=3, breaker_cooldown_secs=0.15
+        )
+        servicer, pythia, client = _chaos_stack(monkey, reliability)
+        for _ in range(5):
+            (trial,) = client.get_suggestions(1)
+            assert is_fallback_suggestion(trial.metadata)
+            harness.complete(client, trial)
+        stats = pythia.serving_stats()
+        assert stats["breaker_open_transitions"] == 1
+        assert stats["designer_failures"] == 3  # then short-circuited
+        assert stats["breaker_short_circuits"] == 2
+
+        import time
+
+        time.sleep(0.2)  # past the cooldown: next suggest is the probe
+        (trial,) = client.get_suggestions(1)
+        harness.complete(client, trial)
+        stats = pythia.serving_stats()
+        assert stats["breaker_half_open_transitions"] == 1
+        assert stats["designer_failures"] == 4
+        assert stats["breaker_open_transitions"] == 2  # probe failed: reopen
+
+
+class TestTransportChaos:
+    def test_client_retries_absorb_rpc_faults(self):
+        monkey = chaos.ChaosMonkey(seed=3, failure_prob=0.15)
+        reliability = ReliabilityConfig(retry_base_delay_secs=0.001)
+        servicer, pythia, client = _chaos_stack(monkey, reliability)
+        flaky = chaos.ChaosServiceStub(servicer, monkey)
+        client = vizier_client_lib.VizierClient(
+            flaky, harness.STUDY, "c1", reliability=reliability
+        )
+        for i in range(1, 21):
+            (trial,) = client.get_suggestions(1)
+            harness.complete(client, trial)
+        rpc_faults = sum(
+            counts["faults"]
+            for site, counts in monkey.counts().items()
+            if site.startswith("rpc.")
+        )
+        assert rpc_faults > 0, "seed produced no transport faults"
+        assert pythia.serving_stats()["retries"] >= rpc_faults
+
+    def test_datastore_chaos_is_absorbed_end_to_end(self):
+        monkey = chaos.ChaosMonkey(seed=5, failure_prob=0.1)
+        reliability = ReliabilityConfig(retry_base_delay_secs=0.001)
+        servicer, pythia, client = _chaos_stack(monkey, reliability)
+        servicer.datastore = chaos.ChaosDataStore(servicer.datastore, monkey)
+        for i in range(1, 16):
+            (trial,) = client.get_suggestions(1)
+            harness.complete(client, trial)
+        datastore_faults = sum(
+            counts["faults"]
+            for site, counts in monkey.counts().items()
+            if site.startswith("datastore.")
+        )
+        assert datastore_faults > 0, "seed produced no datastore faults"
+        assert servicer.datastore.max_trial_id(harness.STUDY) == 15

@@ -1,0 +1,109 @@
+"""Deadline budgets: arithmetic, propagation, typed DEADLINE_EXCEEDED ops."""
+
+import pytest
+
+from tests.reliability import harness
+from vizier_tpu.reliability import DeadlineExceededError, ReliabilityConfig
+from vizier_tpu.reliability.deadline import Deadline
+from vizier_tpu.service import vizier_client as vizier_client_lib
+
+
+class TestDeadline:
+    def test_budget_arithmetic(self):
+        clock = [100.0]
+        deadline = Deadline.from_budget(10.0, clock=lambda: clock[0])
+        assert deadline.is_set
+        assert deadline.remaining() == pytest.approx(10.0)
+        clock[0] = 104.0
+        assert deadline.remaining() == pytest.approx(6.0)
+        assert deadline.wire_budget() == pytest.approx(6.0)
+        assert not deadline.expired
+        clock[0] = 111.0
+        assert deadline.expired
+        assert deadline.wire_budget() == 0.0
+
+    def test_none_never_expires(self):
+        deadline = Deadline.none()
+        assert not deadline.is_set
+        assert deadline.remaining() == float("inf")
+        assert deadline.wire_budget() == 0.0
+        deadline.check("anything")  # no raise
+
+    def test_zero_budget_means_no_deadline(self):
+        assert not Deadline.from_budget(0.0).is_set
+        assert not Deadline.from_budget(-1.0).is_set
+
+    def test_check_raises_typed_marked_error(self):
+        clock = [0.0]
+        deadline = Deadline.from_budget(1.0, clock=lambda: clock[0])
+        clock[0] = 2.0
+        with pytest.raises(DeadlineExceededError, match="TRANSIENT: DEADLINE_EXCEEDED"):
+            deadline.check("the GP train")
+
+
+class TestServiceDeadline:
+    def test_over_budget_computation_completes_op_with_typed_error(self, monkeypatch):
+        """A slow designer fails the op at the deadline, not at the 600 s poll."""
+        factory = harness.SlowPolicyFactory(delay_secs=0.6)
+        servicer, pythia, client = harness.make_stack(
+            factory, reliability=ReliabilityConfig(retries=False)
+        )
+        monkeypatch.setattr(
+            vizier_client_lib.environment_variables, "polling_delay_secs", 0.01
+        )
+        with pytest.raises(RuntimeError) as excinfo:
+            client.get_suggestions(1, deadline_secs=0.15)
+        message = str(excinfo.value)
+        assert "TRANSIENT:" in message
+        assert "DEADLINE_EXCEEDED" in message
+        assert pythia.serving_stats()["deadline_exceeded"] >= 1
+        # The computation ran once; its result was discarded, not returned.
+        assert factory.computations == 1
+
+    def test_generous_deadline_succeeds(self, monkeypatch):
+        factory = harness.SlowPolicyFactory(delay_secs=0.05)
+        servicer, pythia, client = harness.make_stack(
+            factory, reliability=ReliabilityConfig()
+        )
+        monkeypatch.setattr(
+            vizier_client_lib.environment_variables, "polling_delay_secs", 0.01
+        )
+        trials = client.get_suggestions(1, deadline_secs=30.0)
+        assert len(trials) == 1
+        assert pythia.serving_stats()["deadline_exceeded"] == 0
+
+    def test_deadlines_off_restores_fail_slow_behavior(self, monkeypatch):
+        """With deadlines off the op completes normally despite a tiny budget."""
+        factory = harness.SlowPolicyFactory(delay_secs=0.1)
+        servicer, pythia, client = harness.make_stack(
+            factory, reliability=ReliabilityConfig(deadlines=False)
+        )
+        monkeypatch.setattr(
+            vizier_client_lib.environment_variables, "polling_delay_secs", 0.01
+        )
+        trials = client.get_suggestions(1, deadline_secs=0.01)
+        assert len(trials) == 1
+
+    def test_expired_budget_rejected_before_compute(self):
+        """A request arriving with zero budget never runs the designer."""
+        from vizier_tpu.service.protos import pythia_service_pb2
+
+        factory = harness.SlowPolicyFactory(delay_secs=0.0)
+        servicer, pythia, client = harness.make_stack(
+            factory, reliability=ReliabilityConfig()
+        )
+        preq = pythia_service_pb2.PythiaSuggestRequest(
+            count=1,
+            algorithm="RANDOM_SEARCH",
+            study_name=harness.STUDY,
+            deadline_secs=1e-9,
+        )
+        import time
+
+        config_proto = servicer.datastore.load_study(harness.STUDY).study_spec
+        preq.study_descriptor.config.CopyFrom(config_proto)
+        preq.study_descriptor.guid = harness.STUDY
+        time.sleep(0.01)  # the budget has certainly elapsed
+        presp = pythia.Suggest(preq)
+        assert "DEADLINE_EXCEEDED" in presp.error
+        assert factory.computations == 0

@@ -1,0 +1,170 @@
+"""RetryPolicy: schedules, classification, client RPC retries, ResponseWaiter."""
+
+import random
+
+import pytest
+
+from vizier_tpu.reliability import (
+    DeadlineExceededError,
+    ReliabilityConfig,
+    RetryPolicy,
+    TransientError,
+    format_op_error,
+    has_transient_marker,
+    is_transient_exception,
+    mark_transient,
+)
+from vizier_tpu.reliability.deadline import Deadline
+from vizier_tpu.service.pythia_util import ResponseWaiter
+
+
+class TestClassification:
+    @pytest.mark.parametrize(
+        "error",
+        [
+            TransientError("x"),
+            DeadlineExceededError("x"),
+            TimeoutError("x"),
+            ConnectionError("x"),
+            RuntimeError("Pythia error: TRANSIENT: TimeoutError: y"),
+        ],
+    )
+    def test_transient(self, error):
+        assert is_transient_exception(error)
+
+    @pytest.mark.parametrize(
+        "error", [ValueError("bad search space"), RuntimeError("permanent"), KeyError("k")]
+    )
+    def test_permanent(self, error):
+        assert not is_transient_exception(error)
+
+    def test_marker_survives_nesting_and_is_not_doubled(self):
+        text = mark_transient("TimeoutError: x")
+        assert text.startswith("TRANSIENT:")
+        assert mark_transient(text) == text
+        wrapped = f"RuntimeError: Pythia error: {text}"
+        assert has_transient_marker(wrapped)
+
+    def test_format_op_error(self):
+        assert format_op_error(ValueError("bad")) == "ValueError: bad"
+        marked = format_op_error(TimeoutError("slow"))
+        assert marked == "TRANSIENT: TimeoutError: slow"
+        # Already-marked text is not double-prefixed.
+        rewrapped = format_op_error(TransientError("TRANSIENT: inner"))
+        assert rewrapped.count("TRANSIENT:") == 1
+
+
+class TestRetryPolicy:
+    def test_deterministic_schedule(self):
+        a = RetryPolicy(max_attempts=4, base_delay_secs=0.1, max_delay_secs=10.0,
+                        rng=random.Random(7))
+        b = RetryPolicy(max_attempts=4, base_delay_secs=0.1, max_delay_secs=10.0,
+                        rng=random.Random(7))
+        assert list(a.delays()) == list(b.delays())
+
+    def test_full_jitter_bounds(self):
+        policy = RetryPolicy(max_attempts=10, base_delay_secs=0.1,
+                             max_delay_secs=0.5, rng=random.Random(3))
+        for attempt, delay in enumerate(policy.delays()):
+            assert 0.0 <= delay <= min(0.5, 0.1 * 2**attempt)
+
+    def test_no_jitter_is_pure_exponential_with_cap(self):
+        policy = RetryPolicy(max_attempts=5, base_delay_secs=0.1,
+                             max_delay_secs=0.4, jitter=False)
+        assert list(policy.delays()) == [0.1, 0.2, 0.4, 0.4]
+
+    def test_retries_transient_then_succeeds(self):
+        sleeps = []
+        policy = RetryPolicy(max_attempts=3, base_delay_secs=0.01,
+                             sleep_fn=sleeps.append, rng=random.Random(0))
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("flaky")
+            return "ok"
+
+        retried = []
+        assert policy.call(flaky, on_retry=lambda e, a: retried.append(a)) == "ok"
+        assert len(calls) == 3
+        assert retried == [0, 1]
+        assert len(sleeps) == 2
+
+    def test_permanent_error_not_retried(self):
+        policy = RetryPolicy(max_attempts=5, sleep_fn=lambda s: None)
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            policy.call(broken)
+        assert len(calls) == 1
+
+    def test_attempts_exhausted_reraises(self):
+        policy = RetryPolicy(max_attempts=2, base_delay_secs=0.0,
+                             sleep_fn=lambda s: None)
+        with pytest.raises(ConnectionError):
+            policy.call(lambda: (_ for _ in ()).throw(ConnectionError("down")))
+
+    def test_deadline_stops_retry_loop(self):
+        clock = [0.0]
+        deadline = Deadline.from_budget(0.05, clock=lambda: clock[0])
+        policy = RetryPolicy(max_attempts=5, base_delay_secs=10.0, jitter=False,
+                             sleep_fn=lambda s: None)
+        calls = []
+
+        def failing():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        # First retry delay (10 s) exceeds the 0.05 s budget: no retry.
+        with pytest.raises(ConnectionError):
+            policy.call(failing, deadline=deadline)
+        assert len(calls) == 1
+
+    def test_from_config_respects_off_switch(self):
+        on = RetryPolicy.from_config(ReliabilityConfig(), seed=0)
+        off = RetryPolicy.from_config(ReliabilityConfig.disabled(), seed=0)
+        assert on.max_attempts > 1
+        assert off.max_attempts == 1
+
+
+class TestResponseWaiter:
+    def test_timeout_names_the_operation(self):
+        waiter = ResponseWaiter(operation_name="owners/o/ops/7")
+        with pytest.raises(TimeoutError, match="owners/o/ops/7"):
+            waiter.WaitForResponse(timeout=0.01)
+
+    def test_timeout_without_name_still_raises(self):
+        with pytest.raises(TimeoutError, match="Timed out waiting"):
+            ResponseWaiter().WaitForResponse(timeout=0.01)
+
+    def test_cross_thread_error_preserves_traceback_text(self):
+        waiter = ResponseWaiter(operation_name="op")
+
+        def compute():
+            raise RuntimeError("designer blew up")
+
+        try:
+            compute()
+        except RuntimeError as e:
+            waiter.ReportError(e)
+
+        with pytest.raises(RuntimeError) as excinfo:
+            waiter.WaitForResponse(timeout=1)
+        message = str(excinfo.value)
+        assert "designer blew up" in message
+        # The reporting thread's frames survive the hop, and ``from None``
+        # suppressed the re-raise context.
+        assert "in compute" in message
+        assert excinfo.value.__suppress_context__
+
+    def test_report_after_completion_rejected(self):
+        waiter = ResponseWaiter()
+        waiter.Report("done")
+        with pytest.raises(RuntimeError, match="already completed"):
+            waiter.Report("again")
+        assert waiter.WaitForResponse(timeout=1) == "done"

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Chaos A/B: study completion under injected faults, reliability on vs off.
+
+Runs the same seeded fault schedule (probabilistic designer failures plus
+transport faults between client and service) against two arms:
+
+- **reliability_on** — retries + deadline propagation + circuit breaker +
+  quasi-random fallback (the vizier_tpu.reliability defaults, with the
+  breaker window compressed to match test-speed suggest rates);
+- **reliability_off** — ``ReliabilityConfig.disabled()``, the seed's
+  fail-hard behavior.
+
+Evidence lands in ``CHAOS_AB.json``: completed trials, fallback rate,
+retry/breaker/deadline counters, and per-site injection counts. The
+expected shape: the ON arm completes every trial with a bounded fallback
+rate (≈ the injected designer-fault rate); the OFF arm dies at the first
+injected fault that reaches the client.
+
+Usage:  python tools/chaos_ab.py [--trials 50] [--seed 11] [--fault-prob 0.1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("VIZIER_DISABLE_MESH", "1")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import designer_policy
+from vizier_tpu.designers import random as random_designer
+from vizier_tpu.reliability import ReliabilityConfig, is_fallback_suggestion
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import pythia_service, vizier_client, vizier_service
+from vizier_tpu.service.protos import vizier_service_pb2
+from vizier_tpu.testing import chaos
+
+STUDY = "owners/chaos/studies/ab"
+
+
+def _study_config() -> vz.StudyConfig:
+    config = vz.StudyConfig(algorithm="RANDOM_SEARCH")
+    config.search_space.root.add_float_param("x", 0.0, 1.0)
+    config.search_space.root.add_float_param("y", -1.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+class _ChaosPolicyFactory:
+    def __init__(self, monkey: chaos.ChaosMonkey):
+        self._monkey = monkey
+
+    def __call__(self, problem, algorithm, supporter, study_name):
+        return designer_policy.DesignerPolicy(
+            supporter,
+            chaos.chaos_designer_factory(
+                lambda p, **kw: random_designer.RandomDesigner(
+                    p.search_space, seed=0
+                ),
+                self._monkey,
+            ),
+        )
+
+
+def run_arm(
+    *, trials: int, seed: int, fault_prob: float, reliability: ReliabilityConfig
+) -> dict:
+    monkey = chaos.ChaosMonkey(seed=seed, failure_prob=fault_prob)
+    servicer = vizier_service.VizierServicer(reliability_config=reliability)
+    pythia = pythia_service.PythiaServicer(
+        servicer, _ChaosPolicyFactory(monkey), reliability_config=reliability
+    )
+    servicer.set_pythia(pythia)
+    servicer.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(
+            parent="owners/chaos",
+            study=pc.study_to_proto(_study_config(), STUDY),
+        )
+    )
+    client = vizier_client.VizierClient(
+        chaos.ChaosServiceStub(servicer, monkey),
+        STUDY,
+        "chaos-worker",
+        reliability=reliability,
+    )
+
+    completed = fallback_trials = 0
+    error = None
+    start = time.perf_counter()
+    try:
+        for i in range(trials):
+            (trial,) = client.get_suggestions(1)
+            if is_fallback_suggestion(trial.metadata):
+                fallback_trials += 1
+            client.complete_trial(
+                trial.id, vz.Measurement(metrics={"obj": 0.01 * i})
+            )
+            completed += 1
+    except Exception as e:  # the OFF arm is expected to land here
+        error = f"{type(e).__name__}: {e}"
+    elapsed = time.perf_counter() - start
+
+    stats = pythia.serving_stats()
+    return {
+        "completed_trials": completed,
+        "target_trials": trials,
+        "failed": error is not None,
+        "error": error,
+        "fallback_trials": fallback_trials,
+        "fallback_rate": fallback_trials / max(1, completed),
+        "elapsed_secs": round(elapsed, 3),
+        "serving_stats": {k: v for k, v in sorted(stats.items()) if v},
+        "injected": monkey.counts(),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--trials", type=int, default=50)
+    parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--fault-prob", type=float, default=0.1)
+    parser.add_argument(
+        "--out",
+        default=str(pathlib.Path(__file__).resolve().parent.parent / "CHAOS_AB.json"),
+    )
+    args = parser.parse_args()
+
+    # Fast client backoffs: the A/B measures completion/fallback behavior,
+    # not wall-clock sleeps.
+    vizier_client.environment_variables.polling_delay_secs = 0.005
+
+    arms = {
+        "reliability_on": ReliabilityConfig(
+            retry_base_delay_secs=0.01,
+            retry_max_delay_secs=0.1,
+            # The breaker's sliding window assumes production suggest rates
+            # (designer runs are seconds apart); at test speed 50 suggests
+            # land inside one 60 s window, so the window is compressed to
+            # keep "N failures within a window" meaning the same thing.
+            breaker_window_secs=0.5,
+            breaker_cooldown_secs=0.2,
+        ),
+        "reliability_off": ReliabilityConfig.disabled(),
+    }
+    report = {
+        "config": {
+            "trials": args.trials,
+            "seed": args.seed,
+            "designer_fault_prob": args.fault_prob,
+            "transport_fault_prob": args.fault_prob,
+            "algorithm": "RANDOM_SEARCH (chaos-wrapped designer)",
+        },
+        "arms": {},
+    }
+    for name, reliability in arms.items():
+        print(f"[chaos_ab] running arm: {name}")
+        report["arms"][name] = run_arm(
+            trials=args.trials,
+            seed=args.seed,
+            fault_prob=args.fault_prob,
+            reliability=reliability,
+        )
+
+    on, off = report["arms"]["reliability_on"], report["arms"]["reliability_off"]
+    report["verdict"] = {
+        "on_completed_all": on["completed_trials"] == args.trials,
+        "on_fallback_rate": round(on["fallback_rate"], 4),
+        "off_failed": off["failed"],
+        "off_completed": off["completed_trials"],
+    }
+    pathlib.Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report["verdict"], indent=2))
+    print(f"[chaos_ab] wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Fault tolerance for the suggestion path: retries, deadlines, breaker, fallback.
+
+The seed's failure story was fail-hard everywhere: one designer exception
+failed the ``SuggestTrials`` op, clients polled with a fixed sleep and no
+retries, and nothing bounded how long a wedged GP train could hold a
+study's frontier. This package threads graceful degradation through
+client → VizierService → Pythia → designer:
+
+- :class:`RetryPolicy` — exponential backoff + full jitter over transient
+  errors, applied to client RPCs and op polling;
+- :class:`Deadline` — a budget attached at the client, decremented across
+  hops, enforced around the designer computation; over-budget work completes
+  the op with a typed ``TRANSIENT: DEADLINE_EXCEEDED:`` error;
+- :class:`CircuitBreaker` / :class:`CircuitBreakerRegistry` — per-study
+  closed/open/half-open automaton over a sliding designer-failure window;
+- :func:`suggest_fallback` — on designer failure or open circuit, seeded
+  quasi-random suggestions stamped ``reliability:fallback=quasi_random``
+  keep the study moving (auditable degradation, arxiv 2408.11527 §the
+  production service; regret-preserving fill-in per arxiv 1206.6402);
+- :class:`ReliabilityConfig` — the knobs; ``VIZIER_RELIABILITY=0`` restores
+  the seed's fail-hard behavior (see ``docs/guides/reliability.md``).
+
+Counters land in the serving stats (``PythiaServicer.serving_stats()``):
+retries, fallbacks, breaker transitions, deadline hits. The deterministic
+chaos harness exercising all of this is ``vizier_tpu.testing.chaos``.
+"""
+
+from vizier_tpu.reliability.breaker import CircuitBreaker
+from vizier_tpu.reliability.breaker import CircuitBreakerRegistry
+from vizier_tpu.reliability.config import ReliabilityConfig
+from vizier_tpu.reliability.deadline import Deadline
+from vizier_tpu.reliability.errors import CircuitOpenError
+from vizier_tpu.reliability.errors import DeadlineExceededError
+from vizier_tpu.reliability.errors import TRANSIENT_MARKER
+from vizier_tpu.reliability.errors import TransientError
+from vizier_tpu.reliability.errors import format_op_error
+from vizier_tpu.reliability.errors import has_transient_marker
+from vizier_tpu.reliability.errors import is_transient_exception
+from vizier_tpu.reliability.errors import mark_transient
+from vizier_tpu.reliability.fallback import FALLBACK_NAMESPACE
+from vizier_tpu.reliability.fallback import is_fallback_suggestion
+from vizier_tpu.reliability.fallback import suggest_fallback
+from vizier_tpu.reliability.retry import RetryPolicy
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitBreakerRegistry",
+    "CircuitOpenError",
+    "Deadline",
+    "DeadlineExceededError",
+    "FALLBACK_NAMESPACE",
+    "ReliabilityConfig",
+    "RetryPolicy",
+    "TRANSIENT_MARKER",
+    "TransientError",
+    "format_op_error",
+    "has_transient_marker",
+    "is_fallback_suggestion",
+    "is_transient_exception",
+    "mark_transient",
+    "suggest_fallback",
+]

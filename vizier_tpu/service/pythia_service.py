@@ -15,6 +15,9 @@ from typing import Optional
 
 from vizier_tpu import pyvizier as vz
 from vizier_tpu.pythia import policy as policy_lib
+from vizier_tpu.reliability import deadline as deadline_lib
+from vizier_tpu.reliability import errors as errors_lib
+from vizier_tpu.reliability import fallback as fallback_lib
 from vizier_tpu.service import policy_factory as policy_factory_lib
 from vizier_tpu.service import proto_converters as pc
 from vizier_tpu.service import service_policy_supporter
@@ -24,14 +27,24 @@ _logger = logging.getLogger(__name__)
 
 
 class PythiaServicer:
-    def __init__(self, vizier_service=None, policy_factory=None, serving_config=None):
+    def __init__(
+        self,
+        vizier_service=None,
+        policy_factory=None,
+        serving_config=None,
+        reliability_config=None,
+    ):
         from vizier_tpu.serving import runtime as serving_runtime_lib
 
         self._vizier = vizier_service
-        # The stateful serving runtime (designer cache + coalescer + stats);
-        # ``serving_config`` (a vizier_tpu.serving.ServingConfig) disables
-        # parts or all of it. None -> defaults with env-var overrides.
-        self._serving = serving_runtime_lib.ServingRuntime(serving_config)
+        # The stateful serving runtime (designer cache + coalescer + stats +
+        # per-study circuit breakers); ``serving_config`` (a
+        # vizier_tpu.serving.ServingConfig) and ``reliability_config`` (a
+        # vizier_tpu.reliability.ReliabilityConfig) disable parts or all of
+        # it. None -> defaults with env-var overrides.
+        self._serving = serving_runtime_lib.ServingRuntime(
+            serving_config, reliability=reliability_config
+        )
         self._policy_factory = policy_factory or policy_factory_lib.DefaultPolicyFactory(
             serving_runtime=self._serving
         )
@@ -105,6 +118,12 @@ class PythiaServicer:
         self, request: pythia_service_pb2.PythiaSuggestRequest
     ) -> pythia_service_pb2.PythiaSuggestResponse:
         response = pythia_service_pb2.PythiaSuggestResponse()
+        reliability = self._serving.reliability
+        stats = self._serving.stats
+
+        # Config parsing and policy construction fail HARD: an invalid
+        # search space or unknown algorithm is permanent — retrying or
+        # falling back would serve a misconfigured study forever.
         try:
             config = pc.study_config_from_proto(request.study_descriptor.config)
             config.algorithm = request.algorithm or config.algorithm
@@ -114,17 +133,123 @@ class PythiaServicer:
                 guid=request.study_descriptor.guid,
                 max_trial_id=int(request.study_descriptor.max_trial_id),
             )
+        except Exception as e:
+            _logger.warning("Pythia Suggest setup failed: %s", traceback.format_exc())
+            response.error = errors_lib.format_op_error(e)
+            return response
+
+        deadline = (
+            deadline_lib.Deadline.from_budget(request.deadline_secs)
+            if reliability.deadlines_on
+            else deadline_lib.Deadline.none()
+        )
+        breaker = (
+            self._serving.breakers.get(request.study_name)
+            if reliability.breaker_on
+            else None
+        )
+
+        # Open circuit: skip the designer computation entirely (it would
+        # very likely fail and burn the client's budget) and degrade.
+        if breaker is not None and not breaker.allow():
+            stats.increment("breaker_short_circuits")
+            if reliability.fallback_on:
+                return self._fallback_response(config, request, "circuit_open")
+            response.error = errors_lib.format_op_error(
+                errors_lib.CircuitOpenError(
+                    errors_lib.mark_transient(
+                        f"CIRCUIT_OPEN: breaker for study "
+                        f"{request.study_name!r} is open; designer "
+                        "computation skipped."
+                    )
+                )
+            )
+            return response
+
+        try:
+            # Budget already burned upstream (queueing, drain, transport):
+            # not a designer failure, so no breaker record.
+            deadline.check(f"suggest dispatch for {request.study_name!r}")
+        except errors_lib.DeadlineExceededError as e:
+            stats.increment("deadline_exceeded")
+            response.error = errors_lib.format_op_error(e)
+            return response
+
+        try:
             decision = policy.suggest(
                 policy_lib.SuggestRequest(
                     study_descriptor=descriptor, count=int(request.count)
                 )
             )
-            for s in decision.suggestions:
-                response.suggestions.add().CopyFrom(pc.trial_suggestion_to_proto(s))
-            self._append_metadata_deltas(response, decision.metadata)
+            # The over-budget computation completes the op with a typed
+            # error: the client stopped waiting at its deadline, so
+            # returning suggestions now would hand out trials nobody runs.
+            # A chronically slow designer also counts against the breaker.
+            deadline.check(
+                f"suggest computation for {request.study_name!r}"
+            )
+        except errors_lib.DeadlineExceededError as e:
+            stats.increment("deadline_exceeded")
+            if breaker is not None:
+                breaker.record_failure()
+            response.error = errors_lib.format_op_error(e)
+            return response
         except Exception as e:
             _logger.warning("Pythia Suggest failed: %s", traceback.format_exc())
-            response.error = f"{type(e).__name__}: {e}"
+            stats.increment("designer_failures")
+            if breaker is not None:
+                breaker.record_failure()
+            if reliability.fallback_on:
+                return self._fallback_response(
+                    config, request, f"designer_error:{type(e).__name__}"
+                )
+            response.error = errors_lib.format_op_error(e)
+            return response
+
+        if breaker is not None:
+            breaker.record_success()
+        for s in decision.suggestions:
+            response.suggestions.add().CopyFrom(pc.trial_suggestion_to_proto(s))
+        self._append_metadata_deltas(response, decision.metadata)
+        return response
+
+    def _fallback_response(
+        self,
+        config: vz.StudyConfig,
+        request: pythia_service_pb2.PythiaSuggestRequest,
+        reason: str,
+    ) -> pythia_service_pb2.PythiaSuggestResponse:
+        """Graceful degradation: seeded quasi-random, stamped + counted."""
+        response = pythia_service_pb2.PythiaSuggestResponse()
+        try:
+            suggestions = fallback_lib.suggest_fallback(
+                config.to_problem(),
+                max(1, int(request.count)),
+                study_name=request.study_name,
+                max_trial_id=int(request.study_descriptor.max_trial_id),
+                reason=reason,
+            )
+        except Exception as e:  # fallback itself failed: surface as transient
+            _logger.warning(
+                "Quasi-random fallback failed: %s", traceback.format_exc()
+            )
+            response.error = errors_lib.format_op_error(
+                errors_lib.TransientError(
+                    errors_lib.mark_transient(
+                        f"FALLBACK_FAILED ({reason}): {type(e).__name__}: {e}"
+                    )
+                )
+            )
+            return response
+        self._serving.stats.increment("fallbacks", len(suggestions))
+        _logger.warning(
+            "Serving %d quasi-random fallback suggestion(s) for %s (%s).",
+            len(suggestions),
+            request.study_name,
+            reason,
+        )
+        for s in suggestions:
+            response.suggestions.add().CopyFrom(pc.trial_suggestion_to_proto(s))
         return response
 
     def EarlyStop(
@@ -182,7 +307,7 @@ class PythiaServicer:
                 dp.reason = d.reason
         except Exception as e:
             _logger.warning("Pythia EarlyStop failed: %s", traceback.format_exc())
-            response.error = f"{type(e).__name__}: {e}"
+            response.error = errors_lib.format_op_error(e)
         return response
 
     def Ping(

@@ -13,6 +13,10 @@ import time
 from typing import Any, Dict, List, Optional, Union
 
 from vizier_tpu import pyvizier as vz
+from vizier_tpu.reliability import config as reliability_config_lib
+from vizier_tpu.reliability import deadline as deadline_lib
+from vizier_tpu.reliability import errors as errors_lib
+from vizier_tpu.reliability import retry as retry_lib
 from vizier_tpu.service import proto_converters as pc
 from vizier_tpu.service import resources
 from vizier_tpu.service.protos import study_pb2, vizier_service_pb2
@@ -26,6 +30,8 @@ class EnvironmentVariables:
 
     server_endpoint: str = NO_ENDPOINT
     servicer_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Initial GetOperation poll delay; grows by bounded exponential backoff
+    # (doubling with jitter, capped at 8x) while an op stays not-done.
     polling_delay_secs: float = 0.1
     polling_timeout_secs: float = 600.0
 
@@ -61,12 +67,61 @@ def create_service_stub(endpoint: Optional[str] = None):
 
 
 class VizierClient:
-    """Study-scoped RPC wrapper."""
+    """Study-scoped RPC wrapper.
 
-    def __init__(self, service, study_name: str, client_id: str):
+    Every RPC goes through a :class:`~vizier_tpu.reliability.RetryPolicy`
+    (exponential backoff + full jitter over transient transport errors),
+    and ``get_suggestions`` attaches a deadline budget to the request,
+    polls with bounded exponential backoff, and retries ops that failed
+    with a ``TRANSIENT:``-marked error. ``VIZIER_RELIABILITY=0`` (or a
+    ``reliability`` config with everything off) restores the seed's
+    fail-hard, fixed-sleep behavior.
+    """
+
+    def __init__(
+        self,
+        service,
+        study_name: str,
+        client_id: str,
+        *,
+        reliability: Optional[reliability_config_lib.ReliabilityConfig] = None,
+    ):
         self._service = service
         self._study_name = study_name
         self._client_id = client_id
+        self._reliability = (
+            reliability or reliability_config_lib.ReliabilityConfig.from_env()
+        )
+        self._retry = retry_lib.RetryPolicy.from_config(self._reliability)
+
+    # -- reliability plumbing ----------------------------------------------
+
+    def _count_retry(self, error: BaseException, attempt: int) -> None:
+        del error, attempt
+        # Surfaces in serving_stats() when the service is in-process; a
+        # remote stub has no retry-accounting RPC, so this is best-effort.
+        record = getattr(self._service, "record_client_retry", None)
+        if record is not None:
+            try:
+                record(1)
+            except Exception:
+                pass
+
+    def _call(self, method_name: str, request, deadline=None):
+        """One RPC with transient-error retries (when reliability is on).
+
+        At-least-once semantics: a transient failure on the response path
+        of a mutating RPC can re-apply it (a duplicated measurement, or a
+        "already completed" error on a replayed CompleteTrial). The
+        service's idempotent paths (op dedup, ACTIVE-trial reuse) absorb
+        the suggest-side cases; the rest is the standard retry tradeoff.
+        """
+        method = getattr(self._service, method_name)
+        if not self._reliability.retries_on:
+            return method(request)
+        return self._retry.call(
+            lambda: method(request), on_retry=self._count_retry, deadline=deadline
+        )
 
     @property
     def study_name(self) -> str:
@@ -112,26 +167,91 @@ class VizierClient:
 
     # -- suggestions -------------------------------------------------------
 
-    def get_suggestions(self, suggestion_count: int) -> List[vz.Trial]:
-        """Requests suggestions, polling the long-running operation."""
-        op = self._service.SuggestTrials(
+    def get_suggestions(
+        self, suggestion_count: int, *, deadline_secs: Optional[float] = None
+    ) -> List[vz.Trial]:
+        """Requests suggestions, polling the long-running operation.
+
+        The whole exchange — RPCs, polling, and op-level retries — is
+        bounded by ``polling_timeout_secs``. With deadlines on, a budget
+        (``deadline_secs`` or the config default, never more than the
+        remaining polling window) rides on each request so the service can
+        complete an over-budget computation with a typed
+        ``TRANSIENT: DEADLINE_EXCEEDED:`` error instead of silently burning
+        this client's polling timeout. Ops that fail with a
+        ``TRANSIENT:``-marked error are retried with backoff; permanent
+        errors raise immediately.
+        """
+        cfg = self._reliability
+        overall = deadline_lib.Deadline.from_budget(
+            environment_variables.polling_timeout_secs
+        )
+        attempts = max(1, cfg.retry_max_attempts) if cfg.retries_on else 1
+        op = None
+        for attempt in range(attempts):
+            op = self._poll_suggest_op(suggestion_count, overall, deadline_secs)
+            if not op.error:
+                return [pc.trial_from_proto(t) for t in op.response.trials]
+            transient = errors_lib.has_transient_marker(op.error)
+            last_attempt = attempt == attempts - 1
+            if not transient or last_attempt:
+                break
+            delay = self._retry.delay_for_attempt(attempt)
+            if overall.remaining() <= delay:
+                break
+            self._count_retry(RuntimeError(op.error), attempt)
+            self._retry.sleep_fn(delay)
+        raise RuntimeError(f"SuggestTrials failed: {op.error}")
+
+    def _poll_suggest_op(
+        self,
+        suggestion_count: int,
+        overall: deadline_lib.Deadline,
+        deadline_secs: Optional[float],
+    ) -> vizier_service_pb2.Operation:
+        """One SuggestTrials round-trip: issue the op, poll it to done."""
+        budget = 0.0
+        if self._reliability.deadlines_on:
+            budget = (
+                deadline_secs
+                if deadline_secs is not None
+                else self._reliability.default_deadline_secs
+            )
+            # Never promise the service more budget than this client will
+            # actually wait.
+            budget = min(budget, max(0.0, overall.remaining()))
+        op = self._call(
+            "SuggestTrials",
             vizier_service_pb2.SuggestTrialsRequest(
                 parent=self._study_name,
                 suggestion_count=suggestion_count,
                 client_id=self._client_id,
-            )
+                deadline_secs=budget,
+            ),
+            deadline=overall,
         )
-        deadline = time.time() + environment_variables.polling_timeout_secs
+        # Bounded exponential backoff on the poll (satellite of the fixed
+        # 100 ms sleep): doubles per not-done poll, jittered, capped at 8x
+        # the base delay — cutting idle GetOperation load at scale while
+        # keeping first-response latency identical.
+        base = environment_variables.polling_delay_secs
+        delay = base
         while not op.done:
-            if time.time() > deadline:
+            if overall.expired:
                 raise TimeoutError(f"Suggestion operation timed out: {op.name}")
-            time.sleep(environment_variables.polling_delay_secs)
-            op = self._service.GetOperation(
-                vizier_service_pb2.GetOperationRequest(name=op.name)
+            jittered = (
+                self._retry.rng.uniform(0.5 * delay, delay)
+                if self._retry.jitter
+                else delay
             )
-        if op.error:
-            raise RuntimeError(f"SuggestTrials failed: {op.error}")
-        return [pc.trial_from_proto(t) for t in op.response.trials]
+            time.sleep(min(jittered, max(0.0, overall.remaining())))
+            op = self._call(
+                "GetOperation",
+                vizier_service_pb2.GetOperationRequest(name=op.name),
+                deadline=overall,
+            )
+            delay = min(delay * 2.0, base * 8.0)
+        return op
 
     # -- trials ------------------------------------------------------------
 
@@ -142,20 +262,20 @@ class VizierClient:
 
     def create_trial(self, trial: vz.Trial) -> vz.Trial:
         proto = pc.trial_to_proto(trial)
-        out = self._service.CreateTrial(
+        out = self._call("CreateTrial",
             vizier_service_pb2.CreateTrialRequest(parent=self._study_name, trial=proto)
         )
         return pc.trial_from_proto(out)
 
     def get_trial(self, trial_id: int) -> vz.Trial:
         return pc.trial_from_proto(
-            self._service.GetTrial(
+            self._call("GetTrial",
                 vizier_service_pb2.GetTrialRequest(name=self._trial_name(trial_id))
             )
         )
 
     def list_trials(self) -> List[vz.Trial]:
-        response = self._service.ListTrials(
+        response = self._call("ListTrials",
             vizier_service_pb2.ListTrialsRequest(parent=self._study_name)
         )
         return [pc.trial_from_proto(t) for t in response.trials]
@@ -163,7 +283,7 @@ class VizierClient:
     def report_intermediate_objective_value(
         self, trial_id: int, measurement: vz.Measurement
     ) -> vz.Trial:
-        out = self._service.AddTrialMeasurement(
+        out = self._call("AddTrialMeasurement",
             vizier_service_pb2.AddTrialMeasurementRequest(
                 trial_name=self._trial_name(trial_id),
                 measurement=pc.measurement_to_proto(measurement),
@@ -187,10 +307,10 @@ class VizierClient:
             request.final_measurement.CopyFrom(
                 pc.measurement_to_proto(final_measurement)
             )
-        return pc.trial_from_proto(self._service.CompleteTrial(request))
+        return pc.trial_from_proto(self._call("CompleteTrial", request))
 
     def should_trial_stop(self, trial_id: int) -> bool:
-        response = self._service.CheckTrialEarlyStoppingState(
+        response = self._call("CheckTrialEarlyStoppingState",
             vizier_service_pb2.CheckTrialEarlyStoppingStateRequest(
                 trial_name=self._trial_name(trial_id)
             )
@@ -199,20 +319,20 @@ class VizierClient:
 
     def stop_trial(self, trial_id: int) -> vz.Trial:
         return pc.trial_from_proto(
-            self._service.StopTrial(
+            self._call("StopTrial",
                 vizier_service_pb2.StopTrialRequest(name=self._trial_name(trial_id))
             )
         )
 
     def delete_trial(self, trial_id: int) -> None:
-        self._service.DeleteTrial(
+        self._call("DeleteTrial",
             vizier_service_pb2.DeleteTrialRequest(name=self._trial_name(trial_id))
         )
 
     # -- study -------------------------------------------------------------
 
     def get_study_config(self, study_name: Optional[str] = None) -> vz.StudyConfig:
-        study = self._service.GetStudy(
+        study = self._call("GetStudy",
             vizier_service_pb2.GetStudyRequest(name=study_name or self._study_name)
         )
         return pc.study_config_from_proto(study.study_spec)
@@ -238,19 +358,19 @@ class VizierClient:
             vz.StudyState.ABORTED: study_pb2.Study.INACTIVE,
             vz.StudyState.COMPLETED: study_pb2.Study.COMPLETED,
         }
-        self._service.SetStudyState(
+        self._call("SetStudyState",
             vizier_service_pb2.SetStudyStateRequest(
                 name=self._study_name, state=state_map[state], reason=reason
             )
         )
 
     def delete_study(self) -> None:
-        self._service.DeleteStudy(
+        self._call("DeleteStudy",
             vizier_service_pb2.DeleteStudyRequest(name=self._study_name)
         )
 
     def list_optimal_trials(self) -> List[vz.Trial]:
-        response = self._service.ListOptimalTrials(
+        response = self._call("ListOptimalTrials",
             vizier_service_pb2.ListOptimalTrialsRequest(parent=self._study_name)
         )
         return [pc.trial_from_proto(t) for t in response.optimal_trials]
@@ -266,6 +386,6 @@ class VizierClient:
                 unit = request.deltas.add()
                 unit.trial_id = trial_id
                 unit.key_value.CopyFrom(kv)
-        response = self._service.UpdateMetadata(request)
+        response = self._call("UpdateMetadata", request)
         if response.error_details:
             raise KeyError(response.error_details)

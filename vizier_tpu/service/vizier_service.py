@@ -31,8 +31,12 @@ import numpy as np
 _logger = logging.getLogger(__name__)
 
 from vizier_tpu import pyvizier as vz
+from vizier_tpu.reliability import config as reliability_config_lib
+from vizier_tpu.reliability import deadline as deadline_lib
+from vizier_tpu.reliability import errors as errors_lib
 from vizier_tpu.service import datastore as datastore_lib
 from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import pythia_util
 from vizier_tpu.service import ram_datastore
 from vizier_tpu.service import resources
 from vizier_tpu.service import sql_datastore
@@ -47,12 +51,16 @@ class VizierServicer:
         *,
         database_url: Optional[str] = None,
         early_stop_recycle_period: datetime.timedelta = datetime.timedelta(seconds=60),
+        reliability_config: Optional[reliability_config_lib.ReliabilityConfig] = None,
     ):
         if database_url is None:
             self.datastore: datastore_lib.DataStore = ram_datastore.NestedDictRAMDataStore()
         else:
             self.datastore = sql_datastore.SQLDataStore(database_url)
         self._early_stop_recycle_period = early_stop_recycle_period
+        self._reliability = (
+            reliability_config or reliability_config_lib.ReliabilityConfig.from_env()
+        )
         self._study_locks: Dict[str, threading.Lock] = collections.defaultdict(
             threading.Lock
         )
@@ -65,6 +73,30 @@ class VizierServicer:
     def set_pythia(self, pythia) -> None:
         """Connects a Pythia endpoint (in-process servicer or gRPC stub)."""
         self._pythia = pythia
+
+    # -- observability (in-process Pythia only) ----------------------------
+
+    def _serving_stats_sink(self):
+        """The connected Pythia's ServingStats, or None (remote stub)."""
+        runtime = getattr(self._pythia, "serving_runtime", None)
+        return runtime.stats if runtime is not None else None
+
+    def serving_stats(self) -> dict:
+        """Delegates to the in-process Pythia servicer's counters."""
+        snapshot = getattr(self._pythia, "serving_stats", None)
+        return snapshot() if snapshot is not None else {}
+
+    def record_client_retry(self, amount: int = 1) -> None:
+        """Client-side retry accounting (no-op without in-process Pythia).
+
+        Clients of the in-process servicer report their RPC/suggest retries
+        here so they surface in ``serving_stats()`` next to the server-side
+        fallback/breaker counters; a remote client's retries are only
+        observable client-side.
+        """
+        stats = self._serving_stats_sink()
+        if stats is not None:
+            stats.increment("retries", amount)
 
     # -- studies -----------------------------------------------------------
 
@@ -164,11 +196,23 @@ class VizierServicer:
         # (vizier_tpu.serving); a same-client retry meanwhile sees the
         # not-done op above and polls GetOperation, the reference's
         # long-running-operation contract.
+        #
+        # The client's deadline budget (request.deadline_secs, remaining
+        # seconds) becomes a Deadline here and is decremented across every
+        # hop below; transient failures are marked TRANSIENT: in op.error
+        # so client retry logic can tell them from permanent errors.
+        deadline = (
+            deadline_lib.Deadline.from_budget(request.deadline_secs)
+            if self._reliability.deadlines_on
+            else deadline_lib.Deadline.none()
+        )
         try:
-            trials = self._suggest(study, study_name, client_id, request)
+            trials = self._suggest(
+                study, study_name, client_id, request, deadline, op.name
+            )
             op.response.trials.extend(trials)
         except Exception as e:  # captured into the long-running op
-            op.error = f"{type(e).__name__}: {e}"
+            op.error = errors_lib.format_op_error(e)
         finally:
             op.done = True
             self.datastore.update_suggestion_operation(op)
@@ -224,6 +268,8 @@ class VizierServicer:
         study_name: str,
         client_id: str,
         request: vizier_service_pb2.SuggestTrialsRequest,
+        deadline: deadline_lib.Deadline = deadline_lib.Deadline.none(),
+        operation_name: str = "",
     ) -> List[study_pb2.Trial]:
         count = request.suggestion_count or 1
         with self._study_locks[study_name]:
@@ -238,16 +284,20 @@ class VizierServicer:
             raise RuntimeError("No Pythia endpoint connected to the Vizier service.")
         from vizier_tpu.service.protos import pythia_service_pb2
 
+        deadline.check(f"Pythia dispatch for operation {operation_name!r}")
         preq = pythia_service_pb2.PythiaSuggestRequest(
             count=count - len(out),
             algorithm=study.study_spec.algorithm,
             study_name=study_name,
+            deadline_secs=deadline.wire_budget(),
         )
         preq.study_descriptor.config.CopyFrom(study.study_spec)
         preq.study_descriptor.guid = study_name
         preq.study_descriptor.max_trial_id = max_id
-        presp = self._pythia.Suggest(preq)
+        presp = self._dispatch_pythia(preq, deadline, operation_name)
         if presp.error:
+            if errors_lib.has_transient_marker(presp.error):
+                raise errors_lib.TransientError(f"Pythia error: {presp.error}")
             raise RuntimeError(f"Pythia error: {presp.error}")
 
         sr = resources.StudyResource.from_name(study_name)
@@ -306,6 +356,45 @@ class VizierServicer:
                 except datastore_lib.NotFoundError as e:
                     _logger.warning("Dropping policy metadata delta: %s", e)
         return out
+
+    def _dispatch_pythia(self, preq, deadline: deadline_lib.Deadline, operation_name: str):
+        """Runs the Pythia Suggest, bounded by the remaining deadline.
+
+        With no deadline the call is synchronous (the seed's shape). With
+        one, the computation runs on a daemon thread reporting into a
+        ``ResponseWaiter`` and the wait is capped at the remaining budget:
+        a wedged designer can no longer hold the study's frontier past the
+        client's deadline — the op completes with a typed
+        ``TRANSIENT: DEADLINE_EXCEEDED:`` error while the abandoned
+        computation finishes (and is discarded) in the background.
+        """
+        if not deadline.is_set:
+            return self._pythia.Suggest(preq)
+        waiter: pythia_util.ResponseWaiter = pythia_util.ResponseWaiter(
+            operation_name=operation_name
+        )
+
+        def run():
+            try:
+                waiter.Report(self._pythia.Suggest(preq))
+            except BaseException as e:  # pragma: no cover - defensive
+                try:
+                    waiter.ReportError(e)
+                except RuntimeError:
+                    pass  # waiter already completed (should not happen)
+
+        threading.Thread(
+            target=run, daemon=True, name=f"pythia-suggest-{operation_name}"
+        ).start()
+        try:
+            return waiter.WaitForResponse(timeout=max(0.0, deadline.remaining()))
+        except TimeoutError as e:
+            stats = self._serving_stats_sink()
+            if stats is not None:
+                stats.increment("deadline_exceeded")
+            raise errors_lib.DeadlineExceededError(
+                errors_lib.mark_transient(f"DEADLINE_EXCEEDED: {e}")
+            ) from None
 
     def GetOperation(
         self, request: vizier_service_pb2.GetOperationRequest, context=None

@@ -2,13 +2,18 @@
 
 The Pythia servicer owns one runtime per process; the policy factory and
 the serving policy share it so every counter lands in one place and
-``DeleteStudy`` invalidation reaches the real cache.
+``DeleteStudy`` invalidation reaches the real cache. The reliability layer
+(per-study circuit breakers + its config) lives here too, so breaker
+transitions land in the same stats sink and study invalidation drops the
+breaker along with the designer state.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
+from vizier_tpu.reliability import breaker as breaker_lib
+from vizier_tpu.reliability import config as reliability_config_lib
 from vizier_tpu.serving import coalescer as coalescer_lib
 from vizier_tpu.serving import config as config_lib
 from vizier_tpu.serving import designer_cache as cache_lib
@@ -22,22 +27,35 @@ class ServingRuntime:
         self,
         config: Optional[config_lib.ServingConfig] = None,
         stats: Optional[stats_lib.ServingStats] = None,
+        reliability: Optional[reliability_config_lib.ReliabilityConfig] = None,
     ):
         self.config = config or config_lib.ServingConfig.from_env()
         self.stats = stats or stats_lib.ServingStats()
+        self.reliability = (
+            reliability or reliability_config_lib.ReliabilityConfig.from_env()
+        )
         self.designer_cache = cache_lib.DesignerStateCache(
             max_entries=self.config.cache_max_entries,
             ttl_seconds=self.config.cache_ttl_seconds,
             stats=self.stats,
         )
         self.coalescer = coalescer_lib.RequestCoalescer(stats=self.stats)
+        self.breakers = breaker_lib.CircuitBreakerRegistry(
+            failure_threshold=self.reliability.breaker_failure_threshold,
+            window_secs=self.reliability.breaker_window_secs,
+            cooldown_secs=self.reliability.breaker_cooldown_secs,
+            half_open_probes=self.reliability.breaker_half_open_probes,
+            stats=self.stats,
+        )
 
     def invalidate_study(self, study_name: str) -> bool:
-        """Drops the study's designer state (called on study deletion)."""
+        """Drops the study's designer state + breaker (study deleted)."""
+        self.breakers.invalidate(study_name)
         return self.designer_cache.invalidate(study_name)
 
     def snapshot(self) -> Dict[str, int]:
-        """All counters plus the current cache population."""
+        """All counters plus the current cache/breaker population."""
         out = self.stats.snapshot()
         out["cached_studies"] = len(self.designer_cache)
+        out["open_breakers"] = self.breakers.open_count()
         return out

@@ -26,6 +26,15 @@ class ServingStats:
         "coalesced_computations",  # leader runs that had >= 1 follower
         "warm_trains",
         "cold_trains",
+        # Reliability (vizier_tpu.reliability): retry/fallback/breaker/deadline.
+        "retries",  # client-side RPC / suggest retries
+        "designer_failures",  # designer computations that raised
+        "fallbacks",  # suggestions served by the quasi-random fallback
+        "breaker_open_transitions",
+        "breaker_half_open_transitions",
+        "breaker_close_transitions",
+        "breaker_short_circuits",  # suggests skipped because a circuit was open
+        "deadline_exceeded",  # ops completed with TRANSIENT: DEADLINE_EXCEEDED
     )
 
     def __init__(self):
