@@ -201,11 +201,24 @@ def main() -> None:
     t0 = time.perf_counter()
     one_suggest(0)  # compile
     _progress(f"compile: done in {time.perf_counter() - t0:.1f}s")
+    # Latency distribution via the observability histogram (fixed
+    # exponential buckets — the same estimator a Prometheus scrape of the
+    # serving process would apply), alongside the exact sample percentile
+    # that remains the longitudinal headline number: bucket interpolation
+    # error must not masquerade as a perf regression across rounds.
+    from vizier_tpu.observability import ObservabilityConfig, MetricsRegistry
+
+    obs_config = ObservabilityConfig.from_env()
+    bench_metrics = MetricsRegistry()
+    latency_hist = bench_metrics.histogram(
+        "bench_suggest_latency_seconds", help="bench.py device-side suggest"
+    )
     times = []
     for i in range(1, repeats + 1):
         t0 = time.perf_counter()
         one_suggest(i)
         times.append((time.perf_counter() - t0) * 1000.0)
+        latency_hist.observe(times[-1] / 1000.0)
         _progress(f"repeat {i}/{repeats}: {times[-1]:.1f} ms")
     p50 = float(np.percentile(times, 50))
 
@@ -243,6 +256,9 @@ def main() -> None:
     designer.suggest(batch_count)  # compile
     _progress(f"e2e compile: done in {time.perf_counter() - t0:.1f}s")
     e2e_times = []
+    e2e_hist = bench_metrics.histogram(
+        "bench_e2e_suggest_latency_seconds", help="bench.py e2e designer suggest"
+    )
     next_id = num_trials + 1
     for i in range(repeats):
         fresh = vz.Trial(
@@ -258,8 +274,13 @@ def main() -> None:
         designer.update(core_lib.CompletedTrials([fresh]))
         designer.suggest(batch_count)
         e2e_times.append((time.perf_counter() - t0) * 1000.0)
+        e2e_hist.observe(e2e_times[-1] / 1000.0)
         _progress(f"e2e repeat {i + 1}/{repeats}: {e2e_times[-1]:.1f} ms")
     e2e_p50 = float(np.percentile(e2e_times, 50))
+
+    def _hist_ms(hist, q):
+        value = hist.percentile(q)
+        return round(value * 1000.0, 1) if value is not None else None
 
     target_ms = 1000.0
     if scale == 1.0:
@@ -290,7 +311,17 @@ def main() -> None:
         "mfu": round(achieved / peak, 4),
         "static_flop_budget_gflop": round(budget["total_flops"] / 1e9, 1),
         "peak_flops_assumed": peak,
+        # Histogram-derived percentiles (vizier_tpu.observability buckets):
+        # the distribution a Prometheus scrape of the serving process would
+        # see, reported next to the exact-sample headline p50 above.
+        "hist_p50_ms": _hist_ms(latency_hist, 50),
+        "hist_p95_ms": _hist_ms(latency_hist, 95),
+        "hist_p99_ms": _hist_ms(latency_hist, 99),
         "e2e_default_designer_suggest_p50_ms": round(e2e_p50, 1),
+        "e2e_hist_p50_ms": _hist_ms(e2e_hist, 50),
+        "e2e_hist_p95_ms": _hist_ms(e2e_hist, 95),
+        "e2e_hist_p99_ms": _hist_ms(e2e_hist, 99),
+        "observability": obs_config.as_dict(),
         # Round-4 semantics (docs/guides/tpu_architecture.md): the default
         # "first_pick_full" spends one full budget on the exploitation pick
         # plus one split across the rest (~2 sweeps per suggest) — r1-r3

@@ -1,0 +1,83 @@
+"""device_phase: compile-vs-execute split, spans, disabled no-op."""
+
+import pytest
+
+from vizier_tpu.observability import config as config_lib
+from vizier_tpu.observability import jax_timing
+from vizier_tpu.observability import metrics as metrics_lib
+from vizier_tpu.observability import tracing as tracing_lib
+
+
+@pytest.fixture
+def fresh_state():
+    """Isolated tracer + registry + compile tracking per test."""
+    tracer = tracing_lib.Tracer()
+    old_tracer = tracing_lib.set_tracer(tracer)
+    registry = metrics_lib.MetricsRegistry()
+    old_registry_state = metrics_lib._default_registry
+    metrics_lib.set_default_registry(registry)
+    jax_timing.set_config(config_lib.ObservabilityConfig())
+    jax_timing.reset_compile_tracking()
+    yield tracer, registry
+    tracing_lib.set_tracer(old_tracer)
+    metrics_lib.set_default_registry(old_registry_state)
+    jax_timing.set_config(None)
+    jax_timing.reset_compile_tracking()
+
+
+class TestDevicePhase:
+    def test_first_call_is_compile_then_execute(self, fresh_state):
+        tracer, registry = fresh_state
+        for _ in range(3):
+            with jax_timing.device_phase("unit.phase"):
+                pass
+        hist = registry.get("vizier_jax_phase_seconds")
+        assert hist.count(phase="unit.phase", mode="compile") == 1
+        assert hist.count(phase="unit.phase", mode="execute") == 2
+
+    def test_phase_names_tracked_independently(self, fresh_state):
+        _, registry = fresh_state
+        with jax_timing.device_phase("a"):
+            pass
+        with jax_timing.device_phase("b"):
+            pass
+        hist = registry.get("vizier_jax_phase_seconds")
+        assert hist.count(phase="a", mode="compile") == 1
+        assert hist.count(phase="b", mode="compile") == 1
+
+    def test_span_carries_mode_attribute(self, fresh_state):
+        tracer, _ = fresh_state
+        with jax_timing.device_phase("unit.span"):
+            pass
+        with jax_timing.device_phase("unit.span"):
+            pass
+        spans = [s for s in tracer.finished_spans() if s.name == "jax.unit.span"]
+        assert [s.attributes["mode"] for s in spans] == ["compile", "execute"]
+        assert spans[0].attributes["first_call"] is True
+        assert spans[1].attributes["first_call"] is False
+
+    def test_block_syncs_jax_outputs(self, fresh_state):
+        import jax.numpy as jnp
+
+        with jax_timing.device_phase("unit.block") as phase:
+            out = phase.block(jnp.ones((4,)) * 2.0)
+        assert float(out.sum()) == 8.0
+
+    def test_exception_skips_observation_but_propagates(self, fresh_state):
+        _, registry = fresh_state
+        with pytest.raises(RuntimeError):
+            with jax_timing.device_phase("unit.err"):
+                raise RuntimeError("boom")
+        hist = registry.get("vizier_jax_phase_seconds")
+        # The failed phase was not observed (the family may not even exist).
+        assert hist is None or hist.count(phase="unit.err", mode="compile") == 0
+
+    def test_disabled_is_inert(self, fresh_state):
+        tracer, registry = fresh_state
+        jax_timing.set_config(config_lib.ObservabilityConfig.disabled())
+        with jax_timing.device_phase("unit.off") as phase:
+            # No device sync requested, no histogram, no span.
+            assert phase.block("anything") == "anything"
+            assert not phase.enabled
+        assert registry.get("vizier_jax_phase_seconds") is None
+        assert tracer.finished_spans() == []

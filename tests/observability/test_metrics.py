@@ -1,0 +1,148 @@
+"""Metrics registry: counters/gauges/histograms + Prometheus exposition."""
+
+import json
+import threading
+
+import pytest
+
+from vizier_tpu.observability import metrics as metrics_lib
+
+
+class TestCounter:
+    def test_inc_and_value(self):
+        registry = metrics_lib.MetricsRegistry()
+        c = registry.counter("requests", help="total requests")
+        c.inc()
+        c.inc(4)
+        assert c.value() == 5
+
+    def test_labeled_series_are_independent(self):
+        c = metrics_lib.MetricsRegistry().counter("hits")
+        c.inc(2, kind="a")
+        c.inc(3, kind="b")
+        assert c.value(kind="a") == 2
+        assert c.value(kind="b") == 3
+        assert c.value() == 0  # the unlabeled series is its own series
+
+    def test_negative_increment_rejected(self):
+        c = metrics_lib.MetricsRegistry().counter("c")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_reset(self):
+        c = metrics_lib.MetricsRegistry().counter("c")
+        c.inc(7, k="v")
+        c.reset()
+        assert c.value(k="v") == 0
+
+    def test_concurrent_increments_exact(self):
+        c = metrics_lib.MetricsRegistry().counter("c")
+        n, per_thread = 8, 500
+
+        def work():
+            for _ in range(per_thread):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == n * per_thread
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        g = metrics_lib.MetricsRegistry().gauge("inflight")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.value() == 6
+
+
+class TestHistogram:
+    def test_count_and_sum(self):
+        h = metrics_lib.MetricsRegistry().histogram("lat", buckets=[0.1, 1, 10])
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(55.55)
+
+    def test_percentile_interpolates_within_bucket(self):
+        h = metrics_lib.MetricsRegistry().histogram("lat", buckets=[1.0, 2.0, 4.0])
+        for _ in range(100):
+            h.observe(1.5)  # all mass in the (1, 2] bucket
+        p50 = h.percentile(50)
+        assert 1.0 <= p50 <= 2.0
+
+    def test_percentile_empty_is_none(self):
+        h = metrics_lib.MetricsRegistry().histogram("lat")
+        assert h.percentile(50) is None
+
+    def test_percentile_overflow_clamps_to_last_bound(self):
+        h = metrics_lib.MetricsRegistry().histogram("lat", buckets=[1.0, 2.0])
+        h.observe(100.0)
+        assert h.percentile(99) == 2.0
+
+    def test_percentile_ordering(self):
+        h = metrics_lib.MetricsRegistry().histogram(
+            "lat", buckets=metrics_lib.exponential_buckets(0.001, 1.3, 40)
+        )
+        for i in range(1, 101):
+            h.observe(i / 100.0)  # 0.01 .. 1.0
+        p50, p95, p99 = h.percentile(50), h.percentile(95), h.percentile(99)
+        assert p50 < p95 <= p99
+        assert 0.3 < p50 < 0.7
+
+    def test_exponential_buckets_shape(self):
+        b = metrics_lib.exponential_buckets(0.5, 2.0, 4)
+        assert b == [0.5, 1.0, 2.0, 4.0]
+        with pytest.raises(ValueError):
+            metrics_lib.exponential_buckets(0.0, 2.0, 4)
+
+
+class TestRegistry:
+    def test_get_or_create_returns_same_object(self):
+        registry = metrics_lib.MetricsRegistry()
+        assert registry.counter("x") is registry.counter("x")
+
+    def test_type_conflict_rejected(self):
+        registry = metrics_lib.MetricsRegistry()
+        registry.counter("x")
+        with pytest.raises(ValueError):
+            registry.gauge("x")
+
+    def test_prometheus_text_counter(self):
+        registry = metrics_lib.MetricsRegistry()
+        registry.counter("vizier_hits", help="hit count").inc(3, kind="warm")
+        text = registry.prometheus_text()
+        assert "# HELP vizier_hits hit count" in text
+        assert "# TYPE vizier_hits counter" in text
+        assert 'vizier_hits_total{kind="warm"} 3' in text
+
+    def test_prometheus_text_histogram(self):
+        registry = metrics_lib.MetricsRegistry()
+        h = registry.histogram("lat_seconds", buckets=[0.1, 1.0])
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = registry.prometheus_text()
+        assert "# TYPE lat_seconds histogram" in text
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="1"} 2' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+        assert "lat_seconds_count 3" in text
+
+    def test_label_escaping(self):
+        registry = metrics_lib.MetricsRegistry()
+        registry.counter("c").inc(1, name='we"ird\\stu\nff')
+        text = registry.prometheus_text()
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+
+    def test_snapshot_json_serializable(self):
+        registry = metrics_lib.MetricsRegistry()
+        registry.counter("c").inc(2, k="v")
+        registry.histogram("h").observe(0.2)
+        snap = json.loads(registry.dump_json())
+        assert snap["c"]["type"] == "counter"
+        assert snap["h"]["series"]["{}"]["count"] == 1

@@ -1,0 +1,80 @@
+"""obs_report: JSON-lines span file round-trip + breakdown rendering."""
+
+import json
+import pathlib
+import sys
+
+from vizier_tpu.observability import tracing as tracing_lib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2] / "tools"))
+import obs_report  # noqa: E402  (tools/ is not a package)
+
+
+def _trace_file(tmp_path) -> str:
+    tracer = tracing_lib.Tracer()
+    for _ in range(3):
+        with tracer.span("client.suggest"):
+            with tracer.span("designer.suggest"):
+                pass
+    path = tmp_path / "spans.jsonl"
+    tracer.dump_jsonl(str(path))
+    return str(path)
+
+
+class TestRoundTrip:
+    def test_dump_then_load(self, tmp_path):
+        path = _trace_file(tmp_path)
+        spans = obs_report.load_spans(path)
+        assert len(spans) == 6
+        assert {s["name"] for s in spans} == {"client.suggest", "designer.suggest"}
+        # Every span survived with its timing + identity intact.
+        for span in spans:
+            assert span["duration_secs"] > 0
+            assert span["trace_id"] and span["span_id"]
+
+    def test_corrupt_lines_skipped(self, tmp_path):
+        path = tmp_path / "bad.jsonl"
+        good = json.dumps(
+            {"name": "x", "trace_id": "t", "span_id": "s", "duration_secs": 0.1}
+        )
+        path.write_text(f"{good}\nnot json at all\n\n{good}\n")
+        assert len(obs_report.load_spans(str(path))) == 2
+
+
+class TestBreakdown:
+    def test_phase_table(self, tmp_path):
+        spans = obs_report.load_spans(_trace_file(tmp_path))
+        rows = obs_report.phase_breakdown(spans)
+        by_phase = {r["phase"]: r for r in rows}
+        assert by_phase["client.suggest"]["count"] == 3
+        row = by_phase["designer.suggest"]
+        assert 0 < row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"] <= row["max_ms"]
+        # The outer span contains the inner one, so it owns more total time.
+        assert (
+            by_phase["client.suggest"]["total_ms"]
+            >= by_phase["designer.suggest"]["total_ms"]
+        )
+        table = obs_report.render_table(rows)
+        assert "client.suggest" in table and "p99 ms" in table
+
+    def test_exact_percentiles(self):
+        spans = [
+            {"name": "p", "duration_secs": v / 1000.0} for v in range(1, 101)
+        ]
+        (row,) = obs_report.phase_breakdown(spans)
+        assert row["p50_ms"] == 50.5  # interpolated median of 1..100 ms
+        assert row["max_ms"] == 100.0
+
+    def test_trace_tree(self, tmp_path):
+        spans = obs_report.load_spans(_trace_file(tmp_path))
+        trace_id = spans[0]["trace_id"]
+        tree = obs_report.render_trace(spans, trace_id)
+        lines = tree.splitlines()
+        assert lines[0] == f"trace {trace_id}"
+        # Child indented under its parent.
+        assert any(l.startswith("  client.suggest") for l in lines)
+        assert any(l.startswith("    designer.suggest") for l in lines)
+
+    def test_trace_tree_missing(self, tmp_path):
+        spans = obs_report.load_spans(_trace_file(tmp_path))
+        assert "No spans" in obs_report.render_trace(spans, "nope")

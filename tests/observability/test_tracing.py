@@ -1,0 +1,214 @@
+"""Tracer: span nesting, propagation, ring buffer, no-op surface."""
+
+import json
+import threading
+
+from vizier_tpu.observability import tracing as tracing_lib
+
+
+class TestSpanNesting:
+    def test_parent_child_same_thread(self):
+        tracer = tracing_lib.Tracer()
+        with tracer.span("parent") as parent:
+            with tracer.span("child") as child:
+                assert child.trace_id == parent.trace_id
+                assert child.parent_id == parent.span_id
+            # After the child closes, the parent is current again.
+            assert tracer.current_span() is parent
+        assert tracer.current_span() is None
+        names = [s.name for s in tracer.finished_spans()]
+        assert names == ["child", "parent"]  # children end first
+
+    def test_fresh_trace_without_parent(self):
+        tracer = tracing_lib.Tracer()
+        with tracer.span("a") as a:
+            pass
+        with tracer.span("b") as b:
+            pass
+        assert a.trace_id != b.trace_id
+        assert a.parent_id is None
+
+    def test_explicit_parent_context(self):
+        tracer = tracing_lib.Tracer()
+        remote = tracing_lib.SpanContext("t" * 32, "s" * 16)
+        with tracer.span("child", parent=remote) as child:
+            assert child.trace_id == remote.trace_id
+            assert child.parent_id == remote.span_id
+
+    def test_use_context_attaches_remote_parent(self):
+        tracer = tracing_lib.Tracer()
+        remote = tracing_lib.SpanContext("trace1", "span1")
+        with tracer.use_context(remote):
+            assert tracer.current_context() == remote
+            with tracer.span("child") as child:
+                assert child.trace_id == "trace1"
+                assert child.parent_id == "span1"
+        assert tracer.current_context() is None
+
+    def test_exception_recorded_and_propagated(self):
+        tracer = tracing_lib.Tracer()
+        try:
+            with tracer.span("boom"):
+                raise ValueError("kapow")
+        except ValueError:
+            pass
+        (span,) = tracer.finished_spans()
+        assert span.status == "error"
+        assert span.attributes["error.type"] == "ValueError"
+        assert span.duration_secs is not None
+
+    def test_cross_thread_propagation(self):
+        tracer = tracing_lib.Tracer()
+        child_ids = {}
+
+        with tracer.span("root") as root:
+            ctx = tracer.current_context()
+
+            def worker():
+                # A fresh thread starts with no ambient span; re-attach.
+                assert tracer.current_span() is None
+                with tracer.use_context(ctx):
+                    with tracer.span("worker_span") as s:
+                        child_ids["trace"] = s.trace_id
+                        child_ids["parent"] = s.parent_id
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert child_ids["trace"] == root.trace_id
+        assert child_ids["parent"] == root.span_id
+
+
+class TestWireFormat:
+    def test_round_trip(self):
+        ctx = tracing_lib.SpanContext("abc123", "def456")
+        assert tracing_lib.parse_context(tracing_lib.format_context(ctx)) == ctx
+
+    def test_none_formats_empty(self):
+        assert tracing_lib.format_context(None) == ""
+
+    def test_malformed_degrades_to_none(self):
+        for bad in ("", "nodash", "-", "a-", "-b"):
+            assert tracing_lib.parse_context(bad) is None
+
+
+class TestEventsAndLinks:
+    def test_events_carry_offsets_and_attributes(self):
+        tracer = tracing_lib.Tracer()
+        with tracer.span("s") as span:
+            span.add_event("fallback", reason="circuit_open")
+        (span,) = tracer.finished_spans()
+        (event,) = span.events
+        assert event["name"] == "fallback"
+        assert event["attributes"]["reason"] == "circuit_open"
+        assert event["offset_secs"] >= 0
+
+    def test_links(self):
+        tracer = tracing_lib.Tracer()
+        leader = tracing_lib.SpanContext("t1", "s1")
+        with tracer.span("follower") as span:
+            span.add_link(leader, name="coalesced_leader")
+            span.add_link(None)  # ignored
+        (span,) = tracer.finished_spans()
+        assert span.links == [
+            {"trace_id": "t1", "span_id": "s1", "name": "coalesced_leader"}
+        ]
+
+    def test_add_current_event_helper(self):
+        tracer = tracing_lib.Tracer()
+        old = tracing_lib.set_tracer(tracer)
+        try:
+            tracing_lib.add_current_event("orphan")  # no active span: no-op
+            with tracer.span("s"):
+                tracing_lib.add_current_event("breaker.transition", to_state="open")
+            (span,) = tracer.finished_spans()
+            assert span.events[0]["name"] == "breaker.transition"
+        finally:
+            tracing_lib.set_tracer(old)
+
+
+class TestRingBufferAndExport:
+    def test_ring_buffer_bounded(self):
+        tracer = tracing_lib.Tracer(max_spans=5)
+        for i in range(12):
+            with tracer.span(f"s{i}"):
+                pass
+        spans = tracer.finished_spans()
+        assert len(spans) == 5
+        assert spans[0].name == "s7"  # oldest evicted
+
+    def test_drain_empties(self):
+        tracer = tracing_lib.Tracer()
+        with tracer.span("s"):
+            pass
+        assert len(tracer.drain()) == 1
+        assert tracer.finished_spans() == []
+
+    def test_dump_jsonl_round_trip(self, tmp_path):
+        tracer = tracing_lib.Tracer()
+        with tracer.span("outer", k="v"):
+            with tracer.span("inner"):
+                pass
+        path = tmp_path / "spans.jsonl"
+        assert tracer.dump_jsonl(str(path)) == 2
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert {l["name"] for l in lines} == {"outer", "inner"}
+        assert all(l["duration_secs"] > 0 for l in lines)
+
+    def test_export_path_sink(self, tmp_path):
+        path = tmp_path / "live.jsonl"
+        tracer = tracing_lib.Tracer(export_path=str(path))
+        with tracer.span("s"):
+            pass
+        tracer.close()
+        (line,) = path.read_text().splitlines()
+        assert json.loads(line)["name"] == "s"
+
+    def test_spans_for_trace_ordered(self):
+        tracer = tracing_lib.Tracer()
+        with tracer.span("a") as a:
+            with tracer.span("b"):
+                pass
+        spans = tracer.spans_for_trace(a.trace_id)
+        assert [s.name for s in spans] == ["a", "b"]  # start-time order
+
+
+class TestNoopTracer:
+    def test_full_api_surface(self):
+        tracer = tracing_lib.NOOP_TRACER
+        assert not tracer.enabled
+        with tracer.span("x", k="v") as span:
+            span.set_attribute("a", 1)
+            span.add_event("e")
+            span.add_link(None)
+            assert span.context() is None
+        assert tracer.current_span() is None
+        assert tracer.current_context() is None
+        assert tracer.finished_spans() == []
+        assert tracer.drain() == []
+        assert tracer.dump_jsonl("/nonexistent/never-written") == 0
+
+    def test_noop_span_is_shared_singleton(self):
+        with tracing_lib.NOOP_TRACER.span("a") as s1:
+            pass
+        with tracing_lib.NOOP_TRACER.span("b") as s2:
+            pass
+        assert s1 is s2 is tracing_lib.NOOP_SPAN
+
+
+class TestGlobalTracer:
+    def test_set_and_restore(self):
+        mine = tracing_lib.Tracer()
+        old = tracing_lib.set_tracer(mine)
+        try:
+            assert tracing_lib.get_tracer() is mine
+        finally:
+            tracing_lib.set_tracer(old)
+
+    def test_config_disabled_yields_noop(self):
+        from vizier_tpu.observability import config as config_lib
+
+        tracer = tracing_lib._tracer_from_config(
+            config_lib.ObservabilityConfig.disabled()
+        )
+        assert tracer is tracing_lib.NOOP_TRACER

@@ -1,0 +1,69 @@
+"""ServingStats vocabulary check under concurrency (satellite of PR 3).
+
+The pre-observability implementation checked field membership against a
+mutable dict outside the lock; the registry-backed version builds an
+immutable field→counter map once, making the check race-free by
+construction. This exercises the claim: concurrent valid increments stay
+exact while concurrent *invalid* increments every single time raise
+KeyError and never mint a counter.
+"""
+
+import threading
+
+import pytest
+
+from vizier_tpu.serving.stats import ServingStats
+
+
+class TestVocabularyCheckUnderConcurrency:
+    def test_concurrent_valid_and_invalid_increments(self):
+        stats = ServingStats()
+        n_threads, per_thread = 8, 300
+        key_errors = []
+        other_errors = []
+        barrier = threading.Barrier(n_threads * 2)
+
+        def valid_worker():
+            barrier.wait(timeout=10)
+            for _ in range(per_thread):
+                stats.increment("cache_hits")
+
+        def invalid_worker():
+            barrier.wait(timeout=10)
+            for _ in range(per_thread):
+                try:
+                    stats.increment("cache_hit")  # singular: a typo
+                except KeyError as e:
+                    key_errors.append(e)
+                except Exception as e:  # pragma: no cover - the bug
+                    other_errors.append(e)
+
+        threads = [threading.Thread(target=valid_worker) for _ in range(n_threads)]
+        threads += [threading.Thread(target=invalid_worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+
+        assert not other_errors
+        # Every invalid increment was rejected; none slipped through a race.
+        assert len(key_errors) == n_threads * per_thread
+        # Valid increments were neither lost nor double-counted.
+        assert stats.get("cache_hits") == n_threads * per_thread
+        # No counter was minted for the typo.
+        snap = stats.snapshot()
+        assert "cache_hit" not in snap
+        assert set(snap) == set(ServingStats.FIELDS)
+
+    def test_unknown_field_message_unchanged(self):
+        with pytest.raises(KeyError, match="Unknown serving counter"):
+            ServingStats().increment("nope")
+
+    def test_reset_and_registry_exposure(self):
+        stats = ServingStats()
+        stats.increment("fallbacks", 4)
+        assert "vizier_serving_fallbacks_total 4" in (
+            stats.registry.prometheus_text()
+        )
+        stats.reset()
+        assert stats.get("fallbacks") == 0

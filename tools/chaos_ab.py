@@ -36,6 +36,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from vizier_tpu import pyvizier as vz
 from vizier_tpu.algorithms import designer_policy
 from vizier_tpu.designers import random as random_designer
+from vizier_tpu.observability import MetricsRegistry, ObservabilityConfig
 from vizier_tpu.reliability import ReliabilityConfig, is_fallback_suggestion
 from vizier_tpu.service import proto_converters as pc
 from vizier_tpu.service import pythia_service, vizier_client, vizier_service
@@ -93,12 +94,20 @@ def run_arm(
         reliability=reliability,
     )
 
+    # Per-suggest latency distribution via the observability histogram —
+    # under injected faults the tail (retries, breaker cooldowns, fallback
+    # detours) is the story a bare mean would bury.
+    suggest_hist = MetricsRegistry().histogram(
+        "chaos_suggest_latency_seconds", help="chaos_ab per-suggest wall time"
+    )
     completed = fallback_trials = 0
     error = None
     start = time.perf_counter()
     try:
         for i in range(trials):
+            t0 = time.perf_counter()
             (trial,) = client.get_suggestions(1)
+            suggest_hist.observe(time.perf_counter() - t0)
             if is_fallback_suggestion(trial.metadata):
                 fallback_trials += 1
             client.complete_trial(
@@ -109,6 +118,10 @@ def run_arm(
         error = f"{type(e).__name__}: {e}"
     elapsed = time.perf_counter() - start
 
+    def _ms(q: float):
+        value = suggest_hist.percentile(q)
+        return round(value * 1000.0, 2) if value is not None else None
+
     stats = pythia.serving_stats()
     return {
         "completed_trials": completed,
@@ -118,6 +131,7 @@ def run_arm(
         "fallback_trials": fallback_trials,
         "fallback_rate": fallback_trials / max(1, completed),
         "elapsed_secs": round(elapsed, 3),
+        "suggest_latency_ms": {"p50": _ms(50), "p95": _ms(95), "p99": _ms(99)},
         "serving_stats": {k: v for k, v in sorted(stats.items()) if v},
         "injected": monkey.counts(),
     }
@@ -158,6 +172,7 @@ def main() -> None:
             "designer_fault_prob": args.fault_prob,
             "transport_fault_prob": args.fault_prob,
             "algorithm": "RANDOM_SEARCH (chaos-wrapped designer)",
+            "observability": ObservabilityConfig.from_env().as_dict(),
         },
         "arms": {},
     }

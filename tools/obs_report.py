@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Renders a per-phase latency breakdown from a JSON-lines span file.
+
+The input is what ``Tracer.dump_jsonl()`` (or the
+``VIZIER_OBSERVABILITY_SPAN_LOG`` sink) writes: one span per line. The
+report groups spans by name and prints count, p50/p95/p99/max wall time,
+and total time — the "where does a suggest spend its time" table.
+
+Usage:
+    python tools/obs_report.py SPANS.jsonl              # per-phase table
+    python tools/obs_report.py SPANS.jsonl --trace ID   # one trace's tree
+    python tools/obs_report.py SPANS.jsonl --json       # machine-readable
+
+Stdlib-only; percentiles here are exact (computed from the raw span
+durations, not histogram buckets — the spans ARE the samples).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_spans(path: str) -> List[dict]:
+    """Parses a JSON-lines span file; skips blank/corrupt lines loudly."""
+    spans: List[dict] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                span = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"[obs_report] skipping line {lineno}: {e}", file=sys.stderr)
+                continue
+            if isinstance(span, dict) and "name" in span:
+                spans.append(span)
+    return spans
+
+
+def _percentile(sorted_values: List[float], q: float) -> float:
+    """Linear-interpolated percentile of pre-sorted values (q in [0,100])."""
+    if not sorted_values:
+        return 0.0
+    if len(sorted_values) == 1:
+        return sorted_values[0]
+    rank = (q / 100.0) * (len(sorted_values) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(sorted_values) - 1)
+    frac = rank - lo
+    return sorted_values[lo] * (1 - frac) + sorted_values[hi] * frac
+
+
+def phase_breakdown(spans: List[dict]) -> List[dict]:
+    """Per-span-name latency stats, sorted by total time descending."""
+    by_name: Dict[str, List[float]] = {}
+    for span in spans:
+        duration = span.get("duration_secs")
+        if duration is None:
+            continue
+        by_name.setdefault(span["name"], []).append(float(duration))
+    out = []
+    for name, durations in by_name.items():
+        durations.sort()
+        out.append(
+            {
+                "phase": name,
+                "count": len(durations),
+                "p50_ms": _percentile(durations, 50) * 1e3,
+                "p95_ms": _percentile(durations, 95) * 1e3,
+                "p99_ms": _percentile(durations, 99) * 1e3,
+                "max_ms": durations[-1] * 1e3,
+                "total_ms": sum(durations) * 1e3,
+            }
+        )
+    out.sort(key=lambda row: row["total_ms"], reverse=True)
+    return out
+
+
+def render_table(rows: List[dict]) -> str:
+    header = f"{'phase':<34} {'count':>6} {'p50 ms':>9} {'p95 ms':>9} {'p99 ms':>9} {'max ms':>9} {'total ms':>10}"
+    lines = [header, "-" * len(header)]
+    for row in rows:
+        lines.append(
+            f"{row['phase']:<34} {row['count']:>6d} {row['p50_ms']:>9.2f} "
+            f"{row['p95_ms']:>9.2f} {row['p99_ms']:>9.2f} {row['max_ms']:>9.2f} "
+            f"{row['total_ms']:>10.2f}"
+        )
+    return "\n".join(lines)
+
+
+def render_trace(spans: List[dict], trace_id: str) -> str:
+    """One trace as an indented parent→child tree, time-ordered."""
+    trace = [s for s in spans if s.get("trace_id") == trace_id]
+    if not trace:
+        return f"No spans for trace {trace_id!r}."
+    trace.sort(key=lambda s: s.get("start_time", 0.0))
+    children: Dict[Optional[str], List[dict]] = {}
+    ids = {s["span_id"] for s in trace}
+    for span in trace:
+        parent = span.get("parent_id")
+        # A parent outside the file (ring buffer rolled) renders as a root.
+        children.setdefault(parent if parent in ids else None, []).append(span)
+
+    lines: List[str] = [f"trace {trace_id}"]
+
+    def walk(parent_key: Optional[str], depth: int) -> None:
+        for span in children.get(parent_key, []):
+            duration = span.get("duration_secs") or 0.0
+            status = "" if span.get("status", "ok") == "ok" else " [ERROR]"
+            events = span.get("events") or []
+            event_note = (
+                " events=" + ",".join(e["name"] for e in events) if events else ""
+            )
+            lines.append(
+                f"{'  ' * (depth + 1)}{span['name']} "
+                f"({duration * 1e3:.2f} ms){status}{event_note}"
+            )
+            walk(span["span_id"], depth + 1)
+
+    walk(None, 0)
+    return "\n".join(lines)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("path", help="JSON-lines span file")
+    parser.add_argument("--trace", help="Render one trace_id as a tree")
+    parser.add_argument(
+        "--json", action="store_true", help="Emit the breakdown as JSON"
+    )
+    args = parser.parse_args()
+
+    spans = load_spans(args.path)
+    if args.trace:
+        print(render_trace(spans, args.trace))
+        return
+    rows = phase_breakdown(spans)
+    if args.json:
+        print(json.dumps({"spans": len(spans), "phases": rows}, indent=2))
+    else:
+        print(f"{len(spans)} spans")
+        print(render_table(rows))
+
+
+if __name__ == "__main__":
+    main()

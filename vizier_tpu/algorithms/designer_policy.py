@@ -87,15 +87,27 @@ class DesignerPolicy(policy_lib.Policy):
     def _run_designer(
         self, request: policy_lib.SuggestRequest, count: int
     ) -> Sequence[trial_.TrialSuggestion]:
+        from vizier_tpu.observability import tracing as tracing_lib
+
+        tracer = tracing_lib.get_tracer()
         designer = self._designer_factory(request.study_config.to_problem())
         completed = self._supporter.GetTrials(
             status_matches=trial_.TrialStatus.COMPLETED
         )
         active = self._supporter.GetTrials(status_matches=trial_.TrialStatus.ACTIVE)
-        designer.update(
-            core_lib.CompletedTrials(completed), core_lib.ActiveTrials(active)
-        )
-        return designer.suggest(count)
+        with tracer.span(
+            "designer.update",
+            designer=type(designer).__name__,
+            new_completed=len(completed),
+            incremental=False,
+        ):
+            designer.update(
+                core_lib.CompletedTrials(completed), core_lib.ActiveTrials(active)
+            )
+        with tracer.span(
+            "designer.suggest", designer=type(designer).__name__, count=count
+        ):
+            return designer.suggest(count)
 
 
 class _SerializableDesignerPolicyBase(policy_lib.Policy):

@@ -38,6 +38,7 @@ from vizier_tpu.models import output_warpers
 from vizier_tpu.models import params as params_lib
 from vizier_tpu.optimizers import eagle as eagle_lib
 from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+from vizier_tpu.observability import jax_timing
 from vizier_tpu.optimizers import vectorized as vectorized_lib
 from vizier_tpu.pyvizier import base_study_config
 from vizier_tpu.pyvizier import trial as trial_
@@ -393,13 +394,19 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         with profiler.timeit("convert_trials"):
             data = gp_lib.GPData.from_model_data(self._warped_model_data())
         with profiler.timeit("train_gp"):
-            states = self._train(
-                data,
-                self._next_rng(),
-                self.ensemble_size,
-                self._warm_params,
-                num_restarts=self._warm_restart_budget(),
-            )
+            # Device-phase timing: block the trained states INSIDE the span
+            # so async dispatch cannot shift ARD device time onto whatever
+            # later op first synchronizes; the first call per process is
+            # recorded as compile, the rest as steady-state execute.
+            with jax_timing.device_phase("gp_bandit.train_gp") as phase:
+                states = self._train(
+                    data,
+                    self._next_rng(),
+                    self.ensemble_size,
+                    self._warm_params,
+                    num_restarts=self._warm_restart_budget(),
+                )
+                phase.block(states)
         self._record_train()
         if self.use_warm_start_ard:
             # Warm-start the next suggest from this one's best member
@@ -459,8 +466,10 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         )
         prior = self._prior_features(data)
         with profiler.timeit("acquisition_optimizer"):
-            result = self._maximize(scoring, self._next_rng(), count, prior)
-            jax.block_until_ready(result.scores)
+            with jax_timing.device_phase("gp_bandit.acquisition") as phase:
+                result = self._maximize(scoring, self._next_rng(), count, prior)
+                jax.block_until_ready(result.scores)
+                phase.block(result)
         with profiler.timeit("best_candidates_to_trials"):
             return self._decode_result(result, count, kind=self.acquisition)
 

@@ -50,6 +50,7 @@ from vizier_tpu.models import gp as gp_lib
 from vizier_tpu.models import kernels
 from vizier_tpu.models import multitask_gp as mtgp
 from vizier_tpu.models import output_warpers
+from vizier_tpu.observability import jax_timing
 from vizier_tpu.optimizers import eagle as eagle_lib
 from vizier_tpu.optimizers import vectorized as vectorized_lib
 from vizier_tpu.pyvizier import base_study_config
@@ -834,7 +835,12 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             return self._suggest_with_priors(count)
 
         with profiler.timeit("train_gp"):
-            states_me, datas = self._train_states_me()
+            # Device-attributed ARD timing (compile vs. steady-state): see
+            # gp_bandit.suggest for the rationale; no-op + no device sync
+            # when observability is off.
+            with jax_timing.device_phase("gp_ucb_pe.train_gp") as phase:
+                states_me, datas = self._train_states_me()
+                phase.block(states_me)
         is_mt = isinstance(states_me, mtgp.MultiTaskGPState)
         if is_mt:
             self._last_predictive = _MetricZeroMTPredictive(states_me)
@@ -880,7 +886,11 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             model = self._model
         prior_feats = self._prior_features(datas[0])
         results: List[Tuple] = []  # [(result, aux, rows)]
-        with profiler.timeit("acquisition_optimizer"):
+        # Device-attributed sweep timing; the block_until_ready calls on the
+        # batch scores below already pin device time inside this phase.
+        with profiler.timeit("acquisition_optimizer"), jax_timing.device_phase(
+            "gp_ucb_pe.acquisition"
+        ):
             if self.acquisition_budget_policy == "first_pick_full" and count > 1:
                 # Full budget on the exploitation-critical first pick; one
                 # further full budget split across the remaining picks.

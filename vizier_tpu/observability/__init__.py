@@ -1,0 +1,60 @@
+"""vizier_tpu.observability: tracing, metrics, and JAX-aware profiling.
+
+The window into the serving stack: where a SuggestTrials request spends
+its time (ARD train vs. acquisition sweep vs. lock/coalescer waits vs. RPC
+hops), as spans with cross-process ``trace_id`` propagation; counts and
+latency distributions as a Prometheus-dumpable metrics registry; and
+compile-vs-execute device timing for the designer hot path.
+
+Everything is stdlib-only and gated by :class:`ObservabilityConfig`
+(``VIZIER_OBSERVABILITY=0`` ≈ zero overhead). See
+``docs/guides/observability.md``.
+"""
+
+from vizier_tpu.observability.config import ObservabilityConfig
+from vizier_tpu.observability.jax_timing import device_phase
+from vizier_tpu.observability.metrics import (
+    Counter,
+    DEFAULT_LATENCY_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    exponential_buckets,
+    set_default_registry,
+)
+from vizier_tpu.observability.tracing import (
+    NOOP_TRACER,
+    NoopTracer,
+    Span,
+    SpanContext,
+    Tracer,
+    add_current_event,
+    format_context,
+    get_tracer,
+    parse_context,
+    set_tracer,
+)
+
+__all__ = [
+    "ObservabilityConfig",
+    "device_phase",
+    "Counter",
+    "DEFAULT_LATENCY_BUCKETS",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "exponential_buckets",
+    "set_default_registry",
+    "NOOP_TRACER",
+    "NoopTracer",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "add_current_event",
+    "format_context",
+    "get_tracer",
+    "parse_context",
+    "set_tracer",
+]

@@ -1,0 +1,82 @@
+"""Observability knobs (tracing, metrics, JAX phase profiling).
+
+Everything defaults ON; ``VIZIER_OBSERVABILITY=0`` turns the whole
+subsystem off wholesale (no-op tracer, no histogram observations, no
+device-sync in the JAX phase timers — ≈ zero overhead), and each
+mechanism has its own off-switch for A/B isolation:
+
+- ``VIZIER_OBSERVABILITY=0``         — master switch;
+- ``VIZIER_OBSERVABILITY_TRACING=0`` — no spans (counters/histograms stay);
+- ``VIZIER_OBSERVABILITY_METRICS=0`` — no latency histograms (the serving
+  counter vocabulary — ``ServingStats`` — is core behavior and stays on);
+- ``VIZIER_OBSERVABILITY_JAX=0``     — designer device-phase timers become
+  no-ops and stop forcing ``block_until_ready`` syncs;
+- ``VIZIER_OBSERVABILITY_SPAN_BUFFER=N`` — finished-span ring size;
+- ``VIZIER_OBSERVABILITY_SPAN_LOG=path`` — append every finished span to
+  ``path`` as one JSON line (off by default; the in-memory ring is always
+  available via ``Tracer.finished_spans()`` / ``dump_jsonl()``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict
+
+
+def _env_on(name: str) -> bool:
+    return os.environ.get(name, "1") not in ("0", "false", "False", "")
+
+
+@dataclasses.dataclass(frozen=True)
+class ObservabilityConfig:
+    """Knobs for the tracing/metrics/profiling subsystem."""
+
+    # Master switch; off ≈ zero overhead everywhere.
+    enabled: bool = True
+    # Per-mechanism switches (each effective only when ``enabled``).
+    tracing: bool = True
+    metrics: bool = True
+    jax_profiling: bool = True
+
+    # Finished spans kept in the tracer's bounded ring buffer.
+    span_buffer_size: int = 4096
+    # Optional JSON-lines sink ("" = in-memory ring only).
+    span_log_path: str = ""
+
+    # -- effective switches (master ANDed in) ------------------------------
+
+    @property
+    def tracing_on(self) -> bool:
+        return self.enabled and self.tracing
+
+    @property
+    def metrics_on(self) -> bool:
+        return self.enabled and self.metrics
+
+    @property
+    def jax_profiling_on(self) -> bool:
+        return self.enabled and self.jax_profiling
+
+    @classmethod
+    def from_env(cls) -> "ObservabilityConfig":
+        """The default config with per-knob environment overrides applied."""
+        return cls(
+            enabled=_env_on("VIZIER_OBSERVABILITY"),
+            tracing=_env_on("VIZIER_OBSERVABILITY_TRACING"),
+            metrics=_env_on("VIZIER_OBSERVABILITY_METRICS"),
+            jax_profiling=_env_on("VIZIER_OBSERVABILITY_JAX"),
+            span_buffer_size=int(
+                os.environ.get("VIZIER_OBSERVABILITY_SPAN_BUFFER", "4096")
+            ),
+            span_log_path=os.environ.get("VIZIER_OBSERVABILITY_SPAN_LOG", ""),
+        )
+
+    @classmethod
+    def disabled(cls) -> "ObservabilityConfig":
+        """Everything off: the pre-observability code paths."""
+        return cls(enabled=False)
+
+    def as_dict(self) -> Dict[str, Any]:
+        """JSON-ready form, for stamping into benchmark/report output."""
+        return dataclasses.asdict(self)

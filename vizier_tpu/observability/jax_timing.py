@@ -1,0 +1,143 @@
+"""JAX-aware phase timing: device-attributed spans, compile vs. execute.
+
+JAX dispatch is asynchronous — wall-clocking a jitted call measures
+*enqueue*, not device work, and the cost silently lands on whatever later
+op first blocks. :func:`device_phase` wraps a designer hot-path stage in a
+span and has the caller ``block()`` the stage's outputs *inside* it, so
+device time is attributed to the right phase:
+
+    with jax_timing.device_phase("gp_bandit.train_gp") as phase:
+        states = self._train(...)
+        phase.block(states)
+
+The first occurrence of a phase name in the process is recorded as
+``mode="compile"`` (trace + lower + compile dominates it), later ones as
+``mode="execute"`` — the steady-state serving number. Both land in the
+global metrics registry as ``vizier_jax_phase_seconds{phase=...,mode=...}``
+and on the span as attributes.
+
+With observability (or the JAX knob) off, the phase object is inert and —
+deliberately — does NOT ``block_until_ready``: the production path keeps
+JAX's async pipelining, so the off switch costs nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional, Set
+
+from vizier_tpu.observability import config as config_lib
+from vizier_tpu.observability import metrics as metrics_lib
+from vizier_tpu.observability import tracing as tracing_lib
+
+_seen_lock = threading.Lock()
+_seen_phases: Set[str] = set()
+
+_config: Optional[config_lib.ObservabilityConfig] = None
+
+
+def _jax_profiling_on() -> bool:
+    global _config
+    if _config is None:
+        _config = config_lib.ObservabilityConfig.from_env()
+    return _config.jax_profiling_on
+
+
+def set_config(config: Optional[config_lib.ObservabilityConfig]) -> None:
+    """Overrides the env-derived config (tests); None re-reads on next use."""
+    global _config
+    _config = config
+
+
+def reset_compile_tracking() -> None:
+    """Forgets which phases have run (tests)."""
+    with _seen_lock:
+        _seen_phases.clear()
+
+
+def _mark_seen(name: str) -> bool:
+    """True iff this is the first time ``name`` runs in this process."""
+    with _seen_lock:
+        if name in _seen_phases:
+            return False
+        _seen_phases.add(name)
+        return True
+
+
+class _Phase:
+    """Yielded by :func:`device_phase`; ``block()`` pins device time here."""
+
+    __slots__ = ("name", "enabled", "first_call")
+
+    def __init__(self, name: str, enabled: bool, first_call: bool):
+        self.name = name
+        self.enabled = enabled
+        self.first_call = first_call
+
+    def block(self, outputs: Any) -> Any:
+        """``jax.block_until_ready`` on ``outputs`` (pytree-ok), returned
+        unchanged. No-op — keeping async dispatch — when profiling is off."""
+        if self.enabled:
+            import jax
+
+            jax.block_until_ready(outputs)
+        return outputs
+
+
+_DISABLED_PHASE = _Phase("", enabled=False, first_call=False)
+
+
+class _PhaseCM:
+    __slots__ = ("_phase", "_registry", "_span_cm", "_span", "_t0")
+
+    def __init__(self, phase: _Phase, registry: metrics_lib.MetricsRegistry):
+        self._phase = phase
+        self._registry = registry
+        self._span_cm = tracing_lib.get_tracer().span(
+            f"jax.{phase.name}",
+            jax_phase=phase.name,
+            first_call=phase.first_call,
+        )
+        self._span = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> _Phase:
+        self._span = self._span_cm.__enter__()
+        self._t0 = time.perf_counter()
+        return self._phase
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        duration = time.perf_counter() - self._t0
+        mode = "compile" if self._phase.first_call else "execute"
+        if exc is None:
+            self._registry.histogram(
+                "vizier_jax_phase_seconds",
+                help="Designer JAX phase wall time, device-synced; "
+                "mode=compile is the first call per phase.",
+            ).observe(duration, phase=self._phase.name, mode=mode)
+        self._span.set_attribute("mode", mode)
+        return self._span_cm.__exit__(exc_type, exc, tb)
+
+
+class _DisabledPhaseCM:
+    __slots__ = ()
+
+    def __enter__(self) -> _Phase:
+        return _DISABLED_PHASE
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_DISABLED_CM = _DisabledPhaseCM()
+
+
+def device_phase(
+    name: str, registry: Optional[metrics_lib.MetricsRegistry] = None
+):
+    """Times one device phase (see module docstring for the contract)."""
+    if not _jax_profiling_on():
+        return _DISABLED_CM
+    phase = _Phase(name, enabled=True, first_call=_mark_seen(name))
+    return _PhaseCM(phase, registry or metrics_lib.default_registry())

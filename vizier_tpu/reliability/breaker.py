@@ -140,9 +140,16 @@ class CircuitBreakerRegistry:
         self._breakers: Dict[str, CircuitBreaker] = {}
 
     def _count_transition(self, old: str, new: str) -> None:
-        del old
         if self._stats is not None:
             self._stats.increment(_TRANSITION_COUNTERS[new])
+        # Transitions fire inside the suggest computation that tripped (or
+        # probed) the breaker — stamp them on that span. Lazy import:
+        # reliability must stay importable without the serving stack.
+        from vizier_tpu.observability import tracing as tracing_lib
+
+        tracing_lib.add_current_event(
+            "breaker.transition", from_state=old, to_state=new
+        )
 
     def get(self, study_name: str) -> CircuitBreaker:
         with self._lock:

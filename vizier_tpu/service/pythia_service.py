@@ -10,10 +10,12 @@ by design, unlike the reference's ``jax_enable_x64`` at ``:50-57``.)
 from __future__ import annotations
 
 import logging
+import time
 import traceback
 from typing import Optional
 
 from vizier_tpu import pyvizier as vz
+from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.pythia import policy as policy_lib
 from vizier_tpu.reliability import deadline as deadline_lib
 from vizier_tpu.reliability import errors as errors_lib
@@ -65,6 +67,10 @@ class PythiaServicer:
         """Snapshot of the serving counters + current cache population."""
         return self._serving.snapshot()
 
+    def prometheus_text(self) -> str:
+        """Serving counters + latency histograms, Prometheus text format."""
+        return self._serving.prometheus_text()
+
     def invalidate_study(self, study_name: str) -> None:
         """Drops every piece of per-study serving state (study deleted)."""
         self._serving.invalidate_study(study_name)
@@ -91,6 +97,32 @@ class PythiaServicer:
     def Suggest(
         self, request: pythia_service_pb2.PythiaSuggestRequest, context=None
     ) -> pythia_service_pb2.PythiaSuggestResponse:
+        # Trace parentage comes from the request's wire context, NOT the
+        # ambient contextvar: the deadline-bounded dispatch runs this method
+        # on a fresh worker thread (ResponseWaiter), and a remote stub
+        # crosses a process boundary — the proto field survives both.
+        tracer = tracing_lib.get_tracer()
+        parent = tracing_lib.parse_context(request.trace_context)
+        t0 = time.perf_counter()
+        with tracer.span(
+            "pythia.suggest",
+            parent=parent,
+            study=request.study_name,
+            algorithm=request.algorithm,
+            count=int(request.count),
+            deadline_remaining_secs=float(request.deadline_secs),
+        ) as span:
+            response = self._suggest_coalesced(request)
+            if response.error:
+                span.set_attribute("error", response.error.splitlines()[0][:200])
+        self._serving.observe_suggest_latency(
+            "pythia", time.perf_counter() - t0
+        )
+        return response
+
+    def _suggest_coalesced(
+        self, request: pythia_service_pb2.PythiaSuggestRequest
+    ) -> pythia_service_pb2.PythiaSuggestResponse:
         if not self._serving.config.coalescing:
             return self._suggest_compute(request)
         # Compute-level request coalescing: concurrent suggests against the
@@ -111,7 +143,10 @@ class PythiaServicer:
             return out
 
         return self._serving.coalescer.coalesce(
-            key, lambda: self._suggest_compute(request), clone=clone
+            key,
+            lambda: self._suggest_compute(request),
+            clone=clone,
+            span_name="pythia.suggest_compute",
         )
 
     def _suggest_compute(
@@ -153,6 +188,9 @@ class PythiaServicer:
         # very likely fail and burn the client's budget) and degrade.
         if breaker is not None and not breaker.allow():
             stats.increment("breaker_short_circuits")
+            tracing_lib.add_current_event(
+                "breaker.short_circuit", study=request.study_name
+            )
             if reliability.fallback_on:
                 return self._fallback_response(config, request, "circuit_open")
             response.error = errors_lib.format_op_error(
@@ -172,6 +210,7 @@ class PythiaServicer:
             deadline.check(f"suggest dispatch for {request.study_name!r}")
         except errors_lib.DeadlineExceededError as e:
             stats.increment("deadline_exceeded")
+            tracing_lib.add_current_event("deadline.exceeded", at="dispatch")
             response.error = errors_lib.format_op_error(e)
             return response
 
@@ -190,6 +229,7 @@ class PythiaServicer:
             )
         except errors_lib.DeadlineExceededError as e:
             stats.increment("deadline_exceeded")
+            tracing_lib.add_current_event("deadline.exceeded", at="computation")
             if breaker is not None:
                 breaker.record_failure()
             response.error = errors_lib.format_op_error(e)
@@ -197,6 +237,9 @@ class PythiaServicer:
         except Exception as e:
             _logger.warning("Pythia Suggest failed: %s", traceback.format_exc())
             stats.increment("designer_failures")
+            tracing_lib.add_current_event(
+                "designer.failure", error_type=type(e).__name__
+            )
             if breaker is not None:
                 breaker.record_failure()
             if reliability.fallback_on:
@@ -242,6 +285,9 @@ class PythiaServicer:
             )
             return response
         self._serving.stats.increment("fallbacks", len(suggestions))
+        tracing_lib.add_current_event(
+            "fallback.served", reason=reason, count=len(suggestions)
+        )
         _logger.warning(
             "Serving %d quasi-random fallback suggestion(s) for %s (%s).",
             len(suggestions),

@@ -13,6 +13,7 @@ import time
 from typing import Any, Dict, List, Optional, Union
 
 from vizier_tpu import pyvizier as vz
+from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.reliability import config as reliability_config_lib
 from vizier_tpu.reliability import deadline as deadline_lib
 from vizier_tpu.reliability import errors as errors_lib
@@ -188,19 +189,32 @@ class VizierClient:
         )
         attempts = max(1, cfg.retry_max_attempts) if cfg.retries_on else 1
         op = None
-        for attempt in range(attempts):
-            op = self._poll_suggest_op(suggestion_count, overall, deadline_secs)
-            if not op.error:
-                return [pc.trial_from_proto(t) for t in op.response.trials]
-            transient = errors_lib.has_transient_marker(op.error)
-            last_attempt = attempt == attempts - 1
-            if not transient or last_attempt:
-                break
-            delay = self._retry.delay_for_attempt(attempt)
-            if overall.remaining() <= delay:
-                break
-            self._count_retry(RuntimeError(op.error), attempt)
-            self._retry.sleep_fn(delay)
+        # The trace root: every downstream hop (service, Pythia dispatch,
+        # designer compute) parents onto this span via the request's
+        # trace_context field.
+        with tracing_lib.get_tracer().span(
+            "client.suggest",
+            study=self._study_name,
+            client_id=self._client_id,
+            count=int(suggestion_count),
+        ) as span:
+            for attempt in range(attempts):
+                op = self._poll_suggest_op(
+                    suggestion_count, overall, deadline_secs
+                )
+                if not op.error:
+                    return [pc.trial_from_proto(t) for t in op.response.trials]
+                transient = errors_lib.has_transient_marker(op.error)
+                last_attempt = attempt == attempts - 1
+                if not transient or last_attempt:
+                    break
+                delay = self._retry.delay_for_attempt(attempt)
+                if overall.remaining() <= delay:
+                    break
+                self._count_retry(RuntimeError(op.error), attempt)
+                span.add_event("transient_retry", attempt=attempt)
+                self._retry.sleep_fn(delay)
+            span.set_attribute("error", op.error.splitlines()[0][:200])
         raise RuntimeError(f"SuggestTrials failed: {op.error}")
 
     def _poll_suggest_op(
@@ -227,6 +241,11 @@ class VizierClient:
                 suggestion_count=suggestion_count,
                 client_id=self._client_id,
                 deadline_secs=budget,
+                # Carries the client.suggest span across the RPC ('' when
+                # tracing is off — the service then starts its own trace).
+                trace_context=tracing_lib.format_context(
+                    tracing_lib.get_tracer().current_context()
+                ),
             ),
             deadline=overall,
         )

@@ -31,6 +31,7 @@ import numpy as np
 _logger = logging.getLogger(__name__)
 
 from vizier_tpu import pyvizier as vz
+from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.reliability import config as reliability_config_lib
 from vizier_tpu.reliability import deadline as deadline_lib
 from vizier_tpu.reliability import errors as errors_lib
@@ -85,6 +86,11 @@ class VizierServicer:
         """Delegates to the in-process Pythia servicer's counters."""
         snapshot = getattr(self._pythia, "serving_stats", None)
         return snapshot() if snapshot is not None else {}
+
+    def prometheus_text(self) -> str:
+        """Delegates to the in-process Pythia's metric dump ('' if remote)."""
+        dump = getattr(self._pythia, "prometheus_text", None)
+        return dump() if dump is not None else ""
 
     def record_client_retry(self, amount: int = 1) -> None:
         """Client-side retry accounting (no-op without in-process Pythia).
@@ -156,6 +162,30 @@ class VizierServicer:
 
     def SuggestTrials(
         self, request: vizier_service_pb2.SuggestTrialsRequest, context=None
+    ) -> vizier_service_pb2.Operation:
+        # The service hop's span: parented on the client's span when the
+        # request carries a trace context, a fresh trace otherwise.
+        tracer = tracing_lib.get_tracer()
+        parent = tracing_lib.parse_context(request.trace_context)
+        t0 = time.perf_counter()
+        with tracer.span(
+            "service.suggest_trials",
+            parent=parent,
+            study=request.parent,
+            client_id=request.client_id or "default_client_id",
+            deadline_budget_secs=float(request.deadline_secs),
+        ) as span:
+            op = self._suggest_trials(request)
+            span.set_attribute("operation", op.name)
+            if op.error:
+                span.set_attribute("error", op.error.splitlines()[0][:200])
+        runtime = getattr(self._pythia, "serving_runtime", None)
+        if runtime is not None:
+            runtime.observe_suggest_latency("service", time.perf_counter() - t0)
+        return op
+
+    def _suggest_trials(
+        self, request: vizier_service_pb2.SuggestTrialsRequest
     ) -> vizier_service_pb2.Operation:
         study_name = request.parent
         client_id = request.client_id or "default_client_id"
@@ -294,7 +324,20 @@ class VizierServicer:
         preq.study_descriptor.config.CopyFrom(study.study_spec)
         preq.study_descriptor.guid = study_name
         preq.study_descriptor.max_trial_id = max_id
-        presp = self._dispatch_pythia(preq, deadline, operation_name)
+        tracer = tracing_lib.get_tracer()
+        with tracer.span(
+            "service.pythia_dispatch",
+            study=study_name,
+            deadline_remaining_secs=(
+                deadline.remaining() if deadline.is_set else 0.0
+            ),
+        ) as dispatch_span:
+            # The dispatch span rides the wire so Pythia's spans parent
+            # correctly even across the worker-thread / process hop.
+            preq.trace_context = tracing_lib.format_context(
+                dispatch_span.context()
+            )
+            presp = self._dispatch_pythia(preq, deadline, operation_name)
         if presp.error:
             if errors_lib.has_transient_marker(presp.error):
                 raise errors_lib.TransientError(f"Pythia error: {presp.error}")
@@ -373,10 +416,16 @@ class VizierServicer:
         waiter: pythia_util.ResponseWaiter = pythia_util.ResponseWaiter(
             operation_name=operation_name
         )
+        # The worker thread starts with an empty contextvars context; carry
+        # the dispatch span over so any spans opened on that thread (beyond
+        # what the proto's trace_context already covers) parent correctly.
+        tracer = tracing_lib.get_tracer()
+        dispatch_ctx = tracer.current_context()
 
         def run():
             try:
-                waiter.Report(self._pythia.Suggest(preq))
+                with tracer.use_context(dispatch_ctx):
+                    waiter.Report(self._pythia.Suggest(preq))
             except BaseException as e:  # pragma: no cover - defensive
                 try:
                     waiter.ReportError(e)
@@ -392,6 +441,9 @@ class VizierServicer:
             stats = self._serving_stats_sink()
             if stats is not None:
                 stats.increment("deadline_exceeded")
+            tracing_lib.add_current_event(
+                "deadline.exceeded", at="pythia_wait", operation=operation_name
+            )
             raise errors_lib.DeadlineExceededError(
                 errors_lib.mark_transient(f"DEADLINE_EXCEEDED: {e}")
             ) from None

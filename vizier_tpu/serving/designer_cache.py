@@ -25,8 +25,9 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Set
+from typing import Any, Callable, List, Optional, Set
 
+from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.serving import stats as stats_lib
 
 
@@ -58,6 +59,7 @@ class DesignerStateCache:
         ttl_seconds: float = 3600.0,
         stats: Optional[stats_lib.ServingStats] = None,
         time_fn: Callable[[], float] = time.monotonic,
+        observe_latency: bool = True,
     ):
         if max_entries < 1:
             raise ValueError(f"max_entries must be >= 1, got {max_entries}.")
@@ -65,6 +67,19 @@ class DesignerStateCache:
         self._ttl = ttl_seconds
         self._stats = stats or stats_lib.ServingStats()
         self._time = time_fn
+        # Lookup latency histogram: a miss pays designer construction (jit
+        # compile caches and all) — exactly the cost the cache exists to
+        # amortize, so it is worth a distribution, not just a counter.
+        registry = getattr(self._stats, "registry", None)
+        self._lookup_hist = (
+            registry.histogram(
+                "vizier_designer_cache_lookup_seconds",
+                help="Designer-cache lookup wall time; a miss includes "
+                "designer construction.",
+            )
+            if observe_latency and registry is not None
+            else None
+        )
         self._lock = threading.Lock()
         # Ordered oldest-used first; move_to_end on every hit.
         self._entries: "collections.OrderedDict[str, CachedDesignerEntry]" = (
@@ -95,6 +110,7 @@ class DesignerStateCache:
         resolved by a second lookup before insert: the loser's designer is
         discarded and the winner's entry returned.
         """
+        t0 = time.perf_counter()
         now = self._time()
         with self._lock:
             entry = self._entries.get(study_name)
@@ -106,6 +122,7 @@ class DesignerStateCache:
                 entry.last_used_at = now
                 self._entries.move_to_end(study_name)
                 self._stats.increment("cache_hits")
+                self._observe_lookup("hit", t0)
                 return entry
         designer = designer_factory()
         with self._lock:
@@ -115,6 +132,7 @@ class DesignerStateCache:
                 entry.last_used_at = self._time()
                 self._entries.move_to_end(study_name)
                 self._stats.increment("cache_hits")
+                self._observe_lookup("hit", t0)
                 return entry
             entry = CachedDesignerEntry(study_name, designer, self._time())
             self._entries[study_name] = entry
@@ -123,7 +141,16 @@ class DesignerStateCache:
             while len(self._entries) > self._max_entries:
                 self._entries.popitem(last=False)
                 self._stats.increment("cache_evictions_lru")
+            self._observe_lookup("miss", t0)
             return entry
+
+    def _observe_lookup(self, result: str, t0: float) -> None:
+        seconds = time.perf_counter() - t0
+        if self._lookup_hist is not None:
+            self._lookup_hist.observe(seconds, result=result)
+        tracing_lib.add_current_event(
+            "designer_cache", result=result, seconds=round(seconds, 6)
+        )
 
     def invalidate(self, study_name: str) -> bool:
         """Drops the study's entry (study deleted / state known stale)."""

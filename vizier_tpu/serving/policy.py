@@ -17,6 +17,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 from vizier_tpu.algorithms import core as core_lib
 from vizier_tpu.algorithms import designer_policy
+from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.pythia import policy as policy_lib
 from vizier_tpu.pythia import policy_supporter as supporter_lib
 from vizier_tpu.pyvizier import base_study_config
@@ -85,6 +86,7 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
         self, entry: cache_lib.CachedDesignerEntry, count: int
     ) -> List[trial_.TrialSuggestion]:
         designer = entry.designer
+        tracer = tracing_lib.get_tracer()
         completed = self._supporter.GetTrials(
             status_matches=trial_.TrialStatus.COMPLETED
         )
@@ -93,11 +95,23 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
         ]
         active = self._supporter.GetTrials(status_matches=trial_.TrialStatus.ACTIVE)
         before = self._train_counts(designer)
-        designer.update(
-            core_lib.CompletedTrials(new_completed), core_lib.ActiveTrials(active)
-        )
+        with tracer.span(
+            "designer.update",
+            designer=type(designer).__name__,
+            new_completed=len(new_completed),
+            incremental=True,
+        ):
+            designer.update(
+                core_lib.CompletedTrials(new_completed),
+                core_lib.ActiveTrials(active),
+            )
         entry.incorporated_trial_ids.update(t.id for t in new_completed)
-        suggestions = list(designer.suggest(count))
+        with tracer.span(
+            "designer.suggest",
+            designer=type(designer).__name__,
+            count=count,
+        ):
+            suggestions = list(designer.suggest(count))
         self._account_trains(before, self._train_counts(designer))
         # Mirror the trained unconstrained ARD params into the entry: the
         # stats/inspection surface for "what would seed the next train",

@@ -5,13 +5,18 @@ the serving policy share it so every counter lands in one place and
 ``DeleteStudy`` invalidation reaches the real cache. The reliability layer
 (per-study circuit breakers + its config) lives here too, so breaker
 transitions land in the same stats sink and study invalidation drops the
-breaker along with the designer state.
+breaker along with the designer state. The observability layer hangs off
+the same object: one metrics registry backs the serving counters AND the
+latency histograms (cache lookups, coalescer waits, per-hop suggest
+latency), all dumped together by :meth:`prometheus_text`.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
+from vizier_tpu.observability import config as obs_config_lib
+from vizier_tpu.observability import metrics as metrics_lib
 from vizier_tpu.reliability import breaker as breaker_lib
 from vizier_tpu.reliability import config as reliability_config_lib
 from vizier_tpu.serving import coalescer as coalescer_lib
@@ -28,9 +33,17 @@ class ServingRuntime:
         config: Optional[config_lib.ServingConfig] = None,
         stats: Optional[stats_lib.ServingStats] = None,
         reliability: Optional[reliability_config_lib.ReliabilityConfig] = None,
+        observability: Optional[obs_config_lib.ObservabilityConfig] = None,
     ):
         self.config = config or config_lib.ServingConfig.from_env()
+        self.observability = (
+            observability or obs_config_lib.ObservabilityConfig.from_env()
+        )
         self.stats = stats or stats_lib.ServingStats()
+        # One registry for this runtime's whole metric surface. A caller
+        # passing pre-existing stats brings its registry along so counters
+        # and histograms still land in one dump.
+        self.metrics: metrics_lib.MetricsRegistry = self.stats.registry
         self.reliability = (
             reliability or reliability_config_lib.ReliabilityConfig.from_env()
         )
@@ -38,8 +51,12 @@ class ServingRuntime:
             max_entries=self.config.cache_max_entries,
             ttl_seconds=self.config.cache_ttl_seconds,
             stats=self.stats,
+            observe_latency=self.observability.metrics_on,
         )
-        self.coalescer = coalescer_lib.RequestCoalescer(stats=self.stats)
+        self.coalescer = coalescer_lib.RequestCoalescer(
+            stats=self.stats,
+            observe_latency=self.observability.metrics_on,
+        )
         self.breakers = breaker_lib.CircuitBreakerRegistry(
             failure_threshold=self.reliability.breaker_failure_threshold,
             window_secs=self.reliability.breaker_window_secs,
@@ -47,6 +64,19 @@ class ServingRuntime:
             half_open_probes=self.reliability.breaker_half_open_probes,
             stats=self.stats,
         )
+        self._suggest_latency = self.metrics.histogram(
+            "vizier_suggest_latency_seconds",
+            help="SuggestTrials wall time per hop (service, pythia).",
+        )
+
+    def observe_suggest_latency(self, hop: str, seconds: float) -> None:
+        """Records one suggest's wall time at a hop (no-op when metrics are
+        off — the off switch must cost nothing)."""
+        if self.observability.metrics_on:
+            self._suggest_latency.observe(seconds, hop=hop)
+
+    def suggest_latency_histogram(self) -> metrics_lib.Histogram:
+        return self._suggest_latency
 
     def invalidate_study(self, study_name: str) -> bool:
         """Drops the study's designer state + breaker (study deleted)."""
@@ -59,3 +89,7 @@ class ServingRuntime:
         out["cached_studies"] = len(self.designer_cache)
         out["open_breakers"] = self.breakers.open_count()
         return out
+
+    def prometheus_text(self) -> str:
+        """Every serving counter + latency histogram, Prometheus format."""
+        return self.metrics.prometheus_text()

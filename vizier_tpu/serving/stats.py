@@ -1,14 +1,23 @@
 """Serving-level counters: cache, warm/cold ARD trains, coalescing.
 
-One process-wide mutex guards all counters; increments happen on the
-suggest control path (microseconds against a multi-ms designer run), so a
-finer-grained scheme buys nothing.
+Backed by the :mod:`vizier_tpu.observability` metrics registry (one
+``Counter`` per field, prefixed ``vizier_serving_``) so the serving
+vocabulary shows up in the same Prometheus dump as the latency histograms,
+while keeping the original ``FIELDS``/``increment``/``snapshot``/``reset``
+API — counters are core serving behavior and stay on even with
+``VIZIER_OBSERVABILITY=0``.
+
+Thread safety: the field→counter map is built once in ``__init__`` and
+never mutated, so the vocabulary membership check is race-free by
+construction (no lock needed to read an immutable dict); each counter
+serializes its own increments.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict
+from typing import Dict, Optional
+
+from vizier_tpu.observability import metrics as metrics_lib
 
 
 class ServingStats:
@@ -37,26 +46,36 @@ class ServingStats:
         "deadline_exceeded",  # ops completed with TRANSIENT: DEADLINE_EXCEEDED
     )
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {f: 0 for f in self.FIELDS}
+    def __init__(self, registry: Optional[metrics_lib.MetricsRegistry] = None):
+        # A private registry by default so each stats object starts from
+        # zero; the serving runtime passes its shared registry so the
+        # counters land in the same Prometheus dump as the histograms.
+        self._registry = registry or metrics_lib.MetricsRegistry()
+        self._counters = {
+            f: self._registry.counter(
+                f"vizier_serving_{f}", help=f"Serving counter: {f}."
+            )
+            for f in self.FIELDS
+        }
+
+    @property
+    def registry(self) -> metrics_lib.MetricsRegistry:
+        """The backing registry (histogram co-location, Prometheus dump)."""
+        return self._registry
 
     def increment(self, field: str, amount: int = 1) -> None:
-        if field not in self._counts:
+        counter = self._counters.get(field)
+        if counter is None:
             raise KeyError(f"Unknown serving counter: {field!r}")
-        with self._lock:
-            self._counts[field] += amount
+        counter.inc(amount)
 
     def get(self, field: str) -> int:
-        with self._lock:
-            return self._counts[field]
+        return int(self._counters[field].value())
 
     def snapshot(self) -> Dict[str, int]:
         """A point-in-time copy of every counter."""
-        with self._lock:
-            return dict(self._counts)
+        return {f: int(c.value()) for f, c in self._counters.items()}
 
     def reset(self) -> None:
-        with self._lock:
-            for f in self._counts:
-                self._counts[f] = 0
+        for counter in self._counters.values():
+            counter.reset()
