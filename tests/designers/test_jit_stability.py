@@ -1,0 +1,146 @@
+"""Retrace regression guard: the jit cache must be stable within a padding
+bucket and grow by exactly one entry at a bucket boundary.
+
+Padding (``converters.padding``) exists so the designers' jitted programs
+compile once per ``(pad_trials, features)`` bucket — every retrace costs
+~seconds of XLA compile on TPU and silently destroys serving latency. This
+test pins that contract for the hot entry points of both GP designers:
+growing a study within one bucket must not add cache entries; crossing a
+bucket boundary must add exactly one.
+"""
+
+import numpy as np
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.designers import gp_bandit as gp_bandit_lib
+from vizier_tpu.designers import gp_ucb_pe as gp_ucb_pe_lib
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+
+_FAST = dict(
+    ard_optimizer=lbfgs_lib.AdamOptimizer(maxiter=10),
+    ard_restarts=2,
+    max_acquisition_evaluations=200,
+)
+
+
+def _problem():
+    p = vz.ProblemStatement()
+    for d in range(2):
+        p.search_space.root.add_float_param(f"x{d}", 0.0, 1.0)
+    p.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return p
+
+
+def _trials(start_id, n, seed):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        t = vz.Trial(
+            parameters={"x0": float(rng.uniform()), "x1": float(rng.uniform())},
+            id=start_id + i,
+        )
+        t.complete(vz.Measurement(metrics={"obj": float(rng.uniform())}))
+        out.append(t)
+    return out
+
+
+def _cache_sizes(fns):
+    return tuple(fn._cache_size() for fn in fns)
+
+
+class TestGPBanditJitStability:
+    def test_stable_within_bucket_one_retrace_at_boundary(self):
+        fns = (gp_bandit_lib._train_gp, gp_bandit_lib._maximize_acquisition)
+        designer = gp_bandit_lib.VizierGPBandit(_problem(), rng_seed=0, **_FAST)
+
+        designer.update(core_lib.CompletedTrials(_trials(1, 4, seed=0)))
+        designer.suggest(1)
+        baseline = _cache_sizes(fns)
+
+        # Growing 4 -> 8 trials stays inside the pad_trials=8 bucket: the
+        # jit cache must not move while the study grows within it.
+        for step in range(4):
+            designer.update(
+                core_lib.CompletedTrials(_trials(5 + step, 1, seed=10 + step))
+            )
+            designer.suggest(1)
+            assert _cache_sizes(fns) == baseline, (
+                f"retrace inside padding bucket at {5 + step} trials"
+            )
+
+        # Trial 9 crosses into the pad_trials=16 bucket: exactly one new
+        # cache entry per program, never more.
+        designer.update(core_lib.CompletedTrials(_trials(9, 1, seed=99)))
+        designer.suggest(1)
+        grown = _cache_sizes(fns)
+        assert grown == tuple(b + 1 for b in baseline), (
+            f"bucket boundary must add exactly one entry: {baseline} -> {grown}"
+        )
+
+        # And the new bucket is itself stable.
+        designer.update(core_lib.CompletedTrials(_trials(10, 1, seed=100)))
+        designer.suggest(1)
+        assert _cache_sizes(fns) == grown
+
+
+class TestGPUCBPEJitStability:
+    def test_stable_within_bucket_one_retrace_at_boundary(self):
+        fns = (gp_bandit_lib._train_gp, gp_ucb_pe_lib._suggest_batch)
+        designer = gp_ucb_pe_lib.VizierGPUCBPEBandit(
+            _problem(), rng_seed=0, **_FAST
+        )
+
+        designer.update(core_lib.CompletedTrials(_trials(1, 3, seed=0)))
+        designer.suggest(1)
+        baseline = _cache_sizes(fns)
+
+        # 3 -> 7 completed trials: training data stays in the pad=8 bucket
+        # AND the all-points set (trials + 1 batch pick) stays <= 8, so
+        # neither program may retrace.
+        for step in range(4):
+            designer.update(
+                core_lib.CompletedTrials(_trials(4 + step, 1, seed=10 + step))
+            )
+            designer.suggest(1)
+            assert _cache_sizes(fns) == baseline, (
+                f"retrace inside padding bucket at {4 + step} trials"
+            )
+
+        # Trial 8: training data still pads to 8, but the all-points set
+        # (8 + 1 pick = 9 rows) crosses into the 16 bucket — the batch-loop
+        # program retraces once, the ARD program must not.
+        designer.update(core_lib.CompletedTrials(_trials(8, 1, seed=99)))
+        designer.suggest(1)
+        train_base, sweep_base = baseline
+        assert gp_bandit_lib._train_gp._cache_size() == train_base
+        assert gp_ucb_pe_lib._suggest_batch._cache_size() == sweep_base + 1
+
+
+class TestBatchedProgramJitStability:
+    def test_batched_programs_stable_across_flushes_within_bucket(self):
+        # Two batched flushes over different studies in the same bucket
+        # must share one compiled multi-study program.
+        def fresh(seed, n):
+            d = gp_bandit_lib.VizierGPBandit(_problem(), rng_seed=seed, **_FAST)
+            d.update(core_lib.CompletedTrials(_trials(1, n, seed=seed)))
+            return d
+
+        def flush(seeds, n):
+            designers = [fresh(s, n) for s in seeds]
+            items = [d.batch_prepare(1) for d in designers]
+            outs = designers[0].batch_execute(items, pad_to=len(items))
+            for d, i, o in zip(designers, items, outs):
+                d.batch_finalize(i, o)
+
+        program = gp_bandit_lib._gp_bandit_flush_program
+        flush((0, 1), n=4)
+        size = program._cache_size()
+        flush((2, 3), n=5)  # same pad bucket, different studies/data
+        assert program._cache_size() == size
+
+        flush((4, 5), n=9)  # bucket boundary: exactly one new entry
+        assert program._cache_size() == size + 1

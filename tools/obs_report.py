@@ -57,38 +57,55 @@ def _percentile(sorted_values: List[float], q: float) -> float:
 def phase_breakdown(spans: List[dict]) -> List[dict]:
     """Per-span-name latency stats, sorted by total time descending."""
     by_name: Dict[str, List[float]] = {}
+    occupancy: Dict[str, List[float]] = {}
     for span in spans:
         duration = span.get("duration_secs")
         if duration is None:
             continue
         by_name.setdefault(span["name"], []).append(float(duration))
+        # Cross-study batching occupancy: batch_executor.flush spans carry
+        # how many real studies shared the dispatch; member suggest spans
+        # carry batch_occupancy. Either way it rolls into a mean per phase.
+        attrs = span.get("attributes") or {}
+        occ = attrs.get("occupancy", attrs.get("batch_occupancy"))
+        if isinstance(occ, (int, float)):
+            occupancy.setdefault(span["name"], []).append(float(occ))
     out = []
     for name, durations in by_name.items():
         durations.sort()
-        out.append(
-            {
-                "phase": name,
-                "count": len(durations),
-                "p50_ms": _percentile(durations, 50) * 1e3,
-                "p95_ms": _percentile(durations, 95) * 1e3,
-                "p99_ms": _percentile(durations, 99) * 1e3,
-                "max_ms": durations[-1] * 1e3,
-                "total_ms": sum(durations) * 1e3,
-            }
-        )
+        row = {
+            "phase": name,
+            "count": len(durations),
+            "p50_ms": _percentile(durations, 50) * 1e3,
+            "p95_ms": _percentile(durations, 95) * 1e3,
+            "p99_ms": _percentile(durations, 99) * 1e3,
+            "max_ms": durations[-1] * 1e3,
+            "total_ms": sum(durations) * 1e3,
+        }
+        occ_samples = occupancy.get(name)
+        if occ_samples:
+            row["mean_occupancy"] = sum(occ_samples) / len(occ_samples)
+        out.append(row)
     out.sort(key=lambda row: row["total_ms"], reverse=True)
     return out
 
 
 def render_table(rows: List[dict]) -> str:
+    with_occ = any("mean_occupancy" in row for row in rows)
     header = f"{'phase':<34} {'count':>6} {'p50 ms':>9} {'p95 ms':>9} {'p99 ms':>9} {'max ms':>9} {'total ms':>10}"
+    if with_occ:
+        header += f" {'occ':>6}"
     lines = [header, "-" * len(header)]
     for row in rows:
-        lines.append(
+        line = (
             f"{row['phase']:<34} {row['count']:>6d} {row['p50_ms']:>9.2f} "
             f"{row['p95_ms']:>9.2f} {row['p99_ms']:>9.2f} {row['max_ms']:>9.2f} "
             f"{row['total_ms']:>10.2f}"
         )
+        if with_occ:
+            occ = row.get("mean_occupancy")
+            line += f" {occ:>6.2f}" if occ is not None else f" {'-':>6}"
+        lines.append(line)
     return "\n".join(lines)
 
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import math
+from typing import List
 
 
 class PaddingType(enum.Enum):
@@ -46,6 +47,25 @@ class PaddingSchedule:
 
     def pad_metrics(self, n: int) -> int:
         return self.num_metrics.pad(n)
+
+    def trial_bucket_grid(self, max_trials: int, start: int = 1) -> List[int]:
+        """The distinct ``pad_trials`` buckets covering ``start..max_trials``.
+
+        This is the grid the serving batch-executor prewarm walks: every
+        study whose trial count is in range lands in exactly one of these
+        padded sizes, so compiling one program per grid entry covers the
+        whole range (``vizier_tpu.parallel.batch_executor``).
+        """
+        if max_trials < start:
+            return []
+        out: List[int] = []
+        n = start
+        while n <= max_trials:
+            bucket = self.pad_trials(n)
+            out.append(bucket)
+            # NONE padding makes every size its own bucket; still terminate.
+            n = max(bucket, n) + 1
+        return out
 
 
 DEFAULT_PADDING = PaddingSchedule(

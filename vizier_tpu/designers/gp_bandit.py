@@ -98,6 +98,154 @@ def _maximize_acquisition(
     return vec_opt(scoring.score, rng, count=count, prior_features=prior_features)
 
 
+def _prior_features_from_data(data: gp_lib.GPData) -> kernels.MixedFeatures:
+    """Top observed points (by warped label) to seed the eagle pool.
+
+    Traceable (used both eagerly by the sequential path and under vmap by
+    the multi-study batched path): k is a function of the *padded* row
+    count so shapes stay stable within a padding bucket.
+    """
+    labels = jnp.where(data.row_mask, data.labels, -jnp.inf)
+    k = min(10, data.num_rows)
+    _, idx = jax.lax.top_k(labels, k)
+    num_valid = jnp.sum(data.row_mask)
+    idx = jnp.where(jnp.arange(k) < num_valid, idx, idx[0])
+    return kernels.MixedFeatures(data.continuous[idx], data.categorical[idx])
+
+
+# -- cross-study batched programs (vizier_tpu.parallel.batch_executor) ------
+#
+# The padding schedule makes concurrent studies shape-identical by
+# construction, so the per-study jitted programs above vmap cleanly over a
+# leading study axis: N same-bucket studies per device dispatch instead of
+# N dispatches. Inputs are stacked pytrees (``batch_executor.stack_pytrees``)
+# with per-study PRNG keys; the inner computation is the SAME program the
+# sequential path runs, so slot i of a batch matches study i run alone.
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "optimizer", "num_restarts", "ensemble_size")
+)
+def train_batched(
+    model: gp_lib.VizierGaussianProcess,
+    optimizer: lbfgs_lib.LbfgsOptimizer,
+    data: gp_lib.GPData,  # leading study axis [B, ...]
+    rng: Array,  # [B] per-study keys
+    num_restarts: int,
+    ensemble_size: int,
+    warm_start: Optional[gp_lib.Params] = None,  # leading axis [B]
+) -> gp_lib.GPState:
+    """Multi-study ARD: one device program vmapping :func:`_train_gp`."""
+    if warm_start is None:
+        return jax.vmap(
+            lambda d, k: _train_gp(
+                model, optimizer, d, k, num_restarts, ensemble_size
+            )
+        )(data, rng)
+    return jax.vmap(
+        lambda d, k, w: _train_gp(
+            model, optimizer, d, k, num_restarts, ensemble_size, w
+        )
+    )(data, rng, warm_start)
+
+
+def _sweep_one(vec_opt, acquisition, s, d, k, count, use_trust_region):
+    """Per-study scoring + eagle sweep (trace-shared by the batched entry
+    points below; identical math to the sequential suggest)."""
+    best_label = jnp.max(jnp.where(d.row_mask, d.labels, -jnp.inf))
+    trust = acquisitions.TrustRegion.from_data(d) if use_trust_region else None
+    scoring = acquisitions.ScoringFunction(
+        predictive=gp_lib.EnsemblePredictive(s),
+        acquisition=acquisition,
+        best_label=best_label,
+        trust_region=trust,
+    )
+    return _maximize_acquisition(
+        vec_opt, scoring, k, count, _prior_features_from_data(d)
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("vec_opt", "acquisition", "count", "use_trust_region")
+)
+def suggest_batched(
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    acquisition,  # hashable Acquisition instance (UCB/EI/...), jit-static
+    states: gp_lib.GPState,  # leading study axis [B, E, ...]
+    data: gp_lib.GPData,  # leading study axis [B, ...]
+    rng: Array,  # [B] per-study keys
+    count: int,
+    use_trust_region: bool = True,
+) -> vectorized_lib.VectorizedOptimizerResult:
+    """Multi-study acquisition sweep: one device program, one eagle pool
+    per study slot, vmapping the sequential scoring + sweep."""
+    return jax.vmap(
+        lambda s, d, k: _sweep_one(
+            vec_opt, acquisition, s, d, k, count, use_trust_region
+        )
+    )(states, data, rng)
+
+
+@jax.jit
+def _to_gp_data_batched(md: types.ModelData) -> gp_lib.GPData:
+    """Stacked host ModelData → batched device GPData, inside ONE program.
+
+    The eager per-study ``GPData.from_model_data`` costs ~6 dispatches per
+    study; done here the whole batch pays one transfer + one fused program.
+    """
+    return jax.vmap(lambda m: gp_lib.GPData.from_model_data(m))(md)
+
+
+def _warm_next_batched(model: gp_lib.VizierGaussianProcess, states) -> gp_lib.Params:
+    """Per-slot warm seed for the NEXT train: best member's params mapped
+    back through the bijectors — the sequential writeback, traced + vmapped."""
+    coll = model.param_collection()
+    return jax.vmap(
+        lambda p: coll.unconstrain(jax.tree_util.tree_map(lambda a: a[0], p))
+    )(states.params)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "model", "optimizer", "vec_opt", "acquisition",
+        "num_restarts", "ensemble_size", "count", "use_trust_region",
+    ),
+)
+def _gp_bandit_flush_program(
+    model: gp_lib.VizierGaussianProcess,
+    optimizer: lbfgs_lib.LbfgsOptimizer,
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    acquisition,
+    md: types.ModelData,  # stacked host ModelData, leading study axis
+    rng_train: Array,  # [B]
+    rng_acq: Array,  # [B]
+    warm: gp_lib.Params,  # [B]
+    num_restarts: int,
+    ensemble_size: int,
+    count: int,
+    use_trust_region: bool,
+):
+    """ONE device program per bucket flush: encode→train→sweep→warm seed.
+
+    Fusing the stages keeps the whole flush a single XLA dispatch — the
+    per-program launch + host-sync overhead that dominates N-small-program
+    serving happens once per BATCH instead of ~3·N times.
+    """
+    data = jax.vmap(lambda m: gp_lib.GPData.from_model_data(m))(md)
+    states = jax.vmap(
+        lambda d, k, w: _train_gp(
+            model, optimizer, d, k, num_restarts, ensemble_size, w
+        )
+    )(data, rng_train, warm)
+    result = jax.vmap(
+        lambda s, d, k: _sweep_one(
+            vec_opt, acquisition, s, d, k, count, use_trust_region
+        )
+    )(states, data, rng_acq)
+    return states, _warm_next_batched(model, states), result
+
+
 @functools.partial(
     jax.jit, static_argnames=("model", "optimizer", "num_restarts")
 )
@@ -302,6 +450,120 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     def ard_train_counts(self) -> dict:
         """Copies of the warm/cold ARD train counters (serving stats)."""
         return dict(self._ard_train_counts)
+
+    # -- cross-study batch protocol (vizier_tpu.parallel.batch_executor) ----
+
+    def _batch_restarts(self) -> int:
+        """The jit-static restart budget the next train would use (mirrors
+        ``_train``'s floor-at-ensemble rule)."""
+        return max(
+            self._warm_restart_budget() or self.ard_restarts, self.ensemble_size
+        )
+
+    def batch_bucket_key(self, count: Optional[int] = None):
+        """Shape-bucket identity for cross-study batching, or None.
+
+        None marks the paths the batched programs do not cover (seeding,
+        multi-objective, transfer priors, joint qEI, mesh-sharded): those
+        run the ordinary sequential suggest. The key carries the hashable
+        jit statics, so equal keys ⇒ one compiled program serves the batch.
+        """
+        count = count or 1
+        if (
+            self._mesh is not None
+            or len(self._trials) < self.num_seed_trials
+            or self._num_objectives() > 1
+            or getattr(self, "_priors", None)
+            or (self.acquisition == "qei" and count > 1)
+        ):
+            return None
+        from vizier_tpu.parallel import batch_executor
+
+        return batch_executor.BucketKey(
+            kind="gp_bandit",
+            pad_trials=self._converter.padding.pad_trials(len(self._trials)),
+            cont_width=self._cont_width,
+            cat_width=self._cat_width,
+            metric_count=1,
+            count=count,
+            statics=(
+                self._model,
+                self._ard,
+                self._vec_opt,
+                self._batch_restarts(),
+                self.ensemble_size,
+                self._make_acquisition(),
+                self.use_trust_region,
+            ),
+        )
+
+    def batch_prepare(self, count: Optional[int] = None) -> dict:
+        """Host-side half of a batched suggest: encode + warp + RNG draws.
+
+        Consumes this designer's RNG stream in exactly the order the
+        sequential ``suggest`` would (train key, then acquisition key), so
+        batched and sequential runs of the same study are key-for-key
+        identical.
+        """
+        count = count or 1
+        # Host-only: the ModelData leaves stay numpy; the GPData conversion
+        # happens inside the batched program (_to_gp_data_batched), so
+        # prepare issues zero device dispatches.
+        return dict(
+            designer=self,
+            count=count,
+            md=self._warped_model_data(),
+            rng_train=self._next_rng(),
+            rng_acq=self._next_rng(),
+            warm=self._warm_params,
+            restarts=self._batch_restarts(),
+        )
+
+    @classmethod
+    def batch_execute(cls, items: Sequence[dict], pad_to: Optional[int] = None):
+        """Device half: ONE vmapped train + ONE vmapped sweep for the whole
+        bucket (slot 0's jit statics stand in for everyone's — the bucket
+        key guarantees they are equal)."""
+        from vizier_tpu.parallel import batch_executor
+
+        d0: "VizierGPBandit" = items[0]["designer"]
+        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
+            [it[name] for it in items], pad_to
+        )
+        with jax_timing.device_phase("gp_bandit.suggest_batched") as phase:
+            states, warm_next, result = _gp_bandit_flush_program(
+                d0._model, d0._ard, d0._vec_opt, d0._make_acquisition(),
+                stack("md"), stack("rng_train"), stack("rng_acq"), stack("warm"),
+                items[0]["restarts"], d0.ensemble_size,
+                items[0]["count"], d0.use_trust_region,
+            )
+            phase.block(result)
+        # ONE device->host fetch for the whole batch; per-slot demux is then
+        # free numpy views (per-slot device slices would be ~20 dispatches
+        # per slot and dominated the executor's wall time).
+        states, warm_next, result = jax.device_get((states, warm_next, result))
+        return [
+            dict(
+                states=batch_executor.slice_pytree(states, i),
+                warm_next=batch_executor.slice_pytree(warm_next, i),
+                result=batch_executor.slice_pytree(result, i),
+            )
+            for i in range(len(items))
+        ]
+
+    def batch_finalize(self, item: dict, output: dict) -> List[trial_.TrialSuggestion]:
+        """Host-side demux: per-study warm-param writeback + decode — the
+        same state transitions the sequential suggest performs."""
+        states = output["states"]
+        self._record_train()
+        if self.use_warm_start_ard:
+            # The unconstrain already ran (vmapped) inside the flush program.
+            self._warm_params = output["warm_next"]
+            self._warm_is_trained = True
+        self._last_predictive = gp_lib.EnsemblePredictive(states)
+        return self._decode_result(
+            output["result"], item["count"], kind=self.acquisition
+        )
 
     def _maximize(
         self,
@@ -633,16 +895,12 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         return out[:count]
 
     def _prior_features(self, data: gp_lib.GPData) -> kernels.MixedFeatures:
-        """Top observed points (by warped label) to seed the eagle pool."""
-        labels = jnp.where(data.row_mask, data.labels, -jnp.inf)
-        # k stays a function of the *padded* row count so shapes are stable
-        # within a padding bucket (no retrace); slots past the valid rows
-        # would be all-zero padding rows, so redirect them to the best row.
-        k = min(10, data.num_rows)
-        _, idx = jax.lax.top_k(labels, k)
-        num_valid = jnp.sum(data.row_mask)
-        idx = jnp.where(jnp.arange(k) < num_valid, idx, idx[0])
-        return kernels.MixedFeatures(data.continuous[idx], data.categorical[idx])
+        """Top observed points (by warped label) to seed the eagle pool.
+
+        Slots past the valid rows would be all-zero padding rows, so
+        :func:`_prior_features_from_data` redirects them to the best row.
+        """
+        return _prior_features_from_data(data)
 
     # -- Predictor ---------------------------------------------------------
 

@@ -539,6 +539,133 @@ def _suggest_set_pe(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "vec_opt", "count", "config", "use_trust_region"),
+)
+def suggest_batched(
+    model: gp_lib.VizierGaussianProcess,
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    states_me,  # leading study axis [B, M, E]
+    all_data,  # GPData with leading study axis [B, ...]
+    data,  # completed-trials GPData with leading study axis [B, ...]
+    rng: Array,  # [B] per-study keys
+    first_has_new: Array,  # [B] bool
+    has_completed: Array,  # [B] bool
+    count: int,
+    config: UCBPEConfig,
+    use_trust_region: bool = True,
+) -> Tuple[vectorized_lib.VectorizedOptimizerResult, dict]:
+    """Multi-study UCB-PE batch: ONE device program vmapping the sequential
+    :func:`_suggest_batch` (greedy per-pick UCB/PE with pending-point
+    conditioning) over a leading study axis.
+
+    Used by the cross-study batch executor
+    (``vizier_tpu.parallel.batch_executor``): every slot runs the exact
+    per-study program, so slot i matches study i executed alone. The labels
+    / reference-point / prior-feature plumbing the sequential path computes
+    eagerly is folded into the traced program (same formulas, zero host
+    dispatches per study). The mesh-sharded and prior-acquisition variants
+    are not batchable (their bucket key is None).
+    """
+
+    return _sweep_batched(
+        model, vec_opt, states_me, all_data, data, rng,
+        first_has_new, has_completed, count, config, use_trust_region,
+    )
+
+
+def _sweep_batched(
+    model, vec_opt, states_me, all_data, data, rng,
+    first_has_new, has_completed, count, config, use_trust_region,
+):
+    """Trace-shared body of :func:`suggest_batched` (also used by the fused
+    flush program): vmap of the per-study greedy batch loop, with the label
+    stack / reference point / prior features folded into the trace."""
+
+    def one(s, ad, d, r, f, h):
+        labels_mn = d.labels[None]  # [M=1, N1]
+        labels_mask = d.row_mask
+        ref_point = acquisitions.get_reference_point(labels_mn, labels_mask)
+        prior = gp_bandit._prior_features_from_data(d)
+        return _suggest_batch(
+            model, vec_opt, s, ad, labels_mn, labels_mask, ref_point, prior,
+            r, f, h, count, config, use_trust_region, None, None,
+        )
+
+    return jax.vmap(one)(
+        states_me, all_data, data, rng, first_has_new, has_completed
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "model", "optimizer", "vec_opt", "vec_opt_rest", "num_restarts",
+        "ensemble_size", "count", "config", "use_trust_region", "two_phase",
+    ),
+)
+def _ucb_pe_flush_program(
+    model,
+    optimizer,
+    vec_opt,  # full-budget sweep (the two-phase first pick)
+    vec_opt_rest,  # the budget policy's sweep for the (remaining) picks
+    md,  # stacked host ModelData (completed trials), leading study axis
+    all_md,  # stacked host ModelData (completed+active, spare pick rows)
+    rng_train: Array,  # [B]
+    rng_acq: Array,  # [B]
+    rng_rest: Array,  # [B] (ignored unless two_phase)
+    warm,  # per-study warm ARD seeds, leading axis [B]
+    first_has_new: Array,  # [B] bool
+    has_completed: Array,  # [B] bool
+    num_restarts: int,
+    ensemble_size: int,
+    count: int,
+    config: UCBPEConfig,
+    use_trust_region: bool,
+    two_phase: bool,
+):
+    """ONE device program per bucket flush: encode→ARD→UCB-PE batch→warm.
+
+    The whole multi-study suggest — including the two-phase
+    ``first_pick_full`` flow with its mid-flight pending-row append — is a
+    single XLA dispatch, so a flush pays program-launch/host-sync overhead
+    once instead of ~4·B times.
+    """
+    data = jax.vmap(lambda m: gp_lib.GPData.from_model_data(m))(md)
+    all_data = jax.vmap(lambda m: gp_lib.GPData.from_model_data(m))(all_md)
+    states = jax.vmap(
+        lambda d, k, w: gp_bandit._train_gp(
+            model, optimizer, d, k, num_restarts, ensemble_size, w
+        )
+    )(data, rng_train, warm)
+    warm_next = gp_bandit._warm_next_batched(model, states)
+    # [B, E] -> [B, M=1, E]: the UCB-PE programs are per-metric batched.
+    states_me = jax.tree_util.tree_map(lambda a: a[:, None], states)
+    if two_phase:
+        first, aux1 = _sweep_batched(
+            model, vec_opt, states_me, all_data, data, rng_acq,
+            first_has_new, has_completed, 1, config, use_trust_region,
+        )
+        x = kernels.MixedFeatures(
+            first.features.continuous[:, :1], first.features.categorical[:, :1]
+        )
+        all_data = jax.vmap(_append_row)(all_data, x)
+        rest, aux2 = _sweep_batched(
+            model, vec_opt_rest, states_me, all_data, data, rng_rest,
+            jnp.zeros_like(first_has_new), has_completed, count - 1,
+            config, use_trust_region,
+        )
+        segments = ((first, aux1), (rest, aux2))
+    else:
+        batch, aux = _sweep_batched(
+            model, vec_opt_rest, states_me, all_data, data, rng_acq,
+            first_has_new, has_completed, count, config, use_trust_region,
+        )
+        segments = ((batch, aux),)
+    return states, warm_next, data, segments
+
+
 def _train_mt_gp(
     model: mtgp.MultiTaskGaussianProcess,
     optimizer,
@@ -796,6 +923,169 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         self._warm_params_me = list(params)
         self._warm_is_trained = True
 
+    # -- cross-study batch protocol (vizier_tpu.parallel.batch_executor) ----
+
+    def _batch_ensemble(self) -> int:
+        return max(self.ensemble_size, 1)
+
+    def _batch_restarts(self) -> int:
+        """Mirrors ``_train_states_me``'s budget: warm override or full,
+        floored at the ensemble size."""
+        return max(
+            self._warm_restart_budget() or self.ard_restarts,
+            self._batch_ensemble(),
+        )
+
+    def batch_bucket_key(self, count: Optional[int] = None):
+        """Shape-bucket identity for cross-study batching, or None.
+
+        Batchable: the single-objective independent-GP greedy path with no
+        cached fit (a cached fit means the sequential suggest would skip
+        training — re-training it in a batch would deviate). Multitask,
+        set-acquisition, priors, custom acquisition priors, mesh sharding,
+        and the seeding stage run sequentially.
+        """
+        count = count or 1
+        if (
+            self._mesh is not None
+            or len(self._trials) + len(self._active_trials) < self.num_seed_trials
+            or getattr(self, "_priors", None)
+            or len(self._objective_indices()) != 1
+            or self.config.optimize_set_acquisition_for_exploration
+            or self.prior_acquisition is not None
+            or self._cached_states is not None
+        ):
+            return None
+        from vizier_tpu.parallel import batch_executor
+
+        pad = self._converter.padding
+        n_all = len(self._trials) + len(self._active_trials)
+        return batch_executor.BucketKey(
+            kind="gp_ucb_pe",
+            pad_trials=pad.pad_trials(len(self._trials)),
+            cont_width=self._cont_width,
+            cat_width=self._cat_width,
+            metric_count=1,
+            count=count,
+            statics=(
+                # all-points rows get their own padded size (spare rows for
+                # the batch picks), so it is part of the shape identity.
+                pad.pad_trials(n_all + count),
+                self._model,
+                self._ard,
+                self._vec_opt,
+                self._pick_vec_opt(count),
+                self._batch_restarts(),
+                self._batch_ensemble(),
+                self.config,
+                self.use_trust_region,
+                self.acquisition_budget_policy,
+            ),
+        )
+
+    def batch_prepare(self, count: Optional[int] = None) -> dict:
+        """Host-side half of a batched suggest (single-objective path).
+
+        Encodes + warps this study's data and draws RNG keys in exactly the
+        sequential order: one train key, then one acquisition key per
+        ``_suggest_batch`` call the budget policy would make.
+        """
+        count = count or 1
+        conv = self._converter
+        raw = conv.metrics.encode(self._trials)
+        features, n_pad = self._padded_features(self._trials)
+        j = self._objective_indices()[0]
+        warper = output_warpers.create_default_warper()
+        warped = warper(raw[:, j]) if raw.shape[0] else raw[:, j]
+        self._metric_warpers = [warper]
+        self._warpers_fitted = raw.shape[0] > 0
+        # Host-only (numpy ModelData): GPData conversion, label stacking,
+        # reference point, and prior features all happen inside the batched
+        # device programs — prepare's only device work is the RNG splits.
+        md = types.ModelData(features, self._padded_labels(warped, n_pad))
+        rng_train = self._next_rng()
+        two_phase = self.acquisition_budget_policy == "first_pick_full" and count > 1
+        return dict(
+            designer=self,
+            count=count,
+            md=md,
+            all_md=self._all_points_model_data(count),
+            first_has_new=np.asarray(self._has_new_completed_trials()),
+            has_completed=np.asarray(bool(self._trials)),
+            warm=self._warm_params_me[0],
+            restarts=self._batch_restarts(),
+            rng_train=rng_train,
+            rng_acq=self._next_rng(),
+            rng_acq_rest=self._next_rng() if two_phase else None,
+        )
+
+    @classmethod
+    def batch_execute(cls, items: Sequence[dict], pad_to: Optional[int] = None):
+        """Device half: vmapped ARD train + vmapped UCB-PE batch loop(s) for
+        the whole bucket (two sweep programs under ``first_pick_full`` with
+        count > 1, exactly like the sequential flow)."""
+        from vizier_tpu.parallel import batch_executor
+
+        d0: "VizierGPUCBPEBandit" = items[0]["designer"]
+        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
+            [it[name] for it in items], pad_to
+        )
+        count = items[0]["count"]
+        two_phase = (
+            d0.acquisition_budget_policy == "first_pick_full" and count > 1
+        )
+        rng_a = stack("rng_acq")
+        with jax_timing.device_phase("gp_ucb_pe.suggest_batched") as phase:
+            states, warm_next, data, segments = _ucb_pe_flush_program(
+                d0._model, d0._ard, d0._vec_opt, d0._pick_vec_opt(count),
+                stack("md"), stack("all_md"),
+                stack("rng_train"), rng_a,
+                stack("rng_acq_rest") if two_phase else rng_a,
+                stack("warm"), stack("first_has_new"), stack("has_completed"),
+                items[0]["restarts"], d0._batch_ensemble(), count,
+                d0.config, d0.use_trust_region, two_phase,
+            )
+            phase.block(segments)
+        rows = [1, count - 1] if two_phase else [count]
+        # ONE device->host fetch for everything the demux needs; per-slot
+        # slices below are then free numpy views.
+        states, warm_next, data, segments = jax.device_get(
+            (states, warm_next, data, segments)
+        )
+        return [
+            dict(
+                states=batch_executor.slice_pytree(states, i),
+                warm_next=batch_executor.slice_pytree(warm_next, i),
+                data=batch_executor.slice_pytree(data, i),
+                segments=[
+                    (
+                        batch_executor.slice_pytree(result, i),
+                        batch_executor.slice_pytree(aux, i),
+                        n,
+                    )
+                    for (result, aux), n in zip(segments, rows)
+                ],
+            )
+            for i in range(len(items))
+        ]
+
+    def batch_finalize(self, item: dict, output: dict) -> List[trial_.TrialSuggestion]:
+        """Host-side demux: warm writeback, fit caching for predict/sample,
+        and per-segment decode — the sequential suggest's state transitions."""
+        states = output["states"]  # [E] leaves (this study's ensemble)
+        self._record_train()
+        if self.use_warm_start_ard:
+            # The unconstrain already ran (vmapped) inside the flush program.
+            self._warm_params_me = [output["warm_next"]]
+            self._warm_is_trained = True
+        states_me = jax.tree_util.tree_map(lambda a: a[None], states)  # [1, E]
+        self._cached_states = (states_me, [output["data"]])
+        self._last_predictive = gp_lib.EnsemblePredictive(states)
+        out: List[trial_.TrialSuggestion] = []
+        for result, aux, rows in output["segments"]:
+            out.extend(self._decode_ucb_pe(result, aux, rows))
+        return out
+
     def _use_multitask(self, num_metrics: int) -> bool:
         return (
             self.config.multitask_type is not mtgp.MultiTaskType.INDEPENDENT
@@ -810,8 +1100,9 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             multitask_type=self.config.multitask_type,
         )
 
-    def _all_points_data(self, count: int) -> gp_lib.GPData:
-        """GPData over completed+active rows with capacity for the picks."""
+    def _all_points_model_data(self, count: int) -> types.ModelData:
+        """Host (numpy) ModelData over completed+active rows with capacity
+        for the picks."""
         all_trials = list(self._trials) + list(self._active_trials)
         features, n_pad = self._padded_features(all_trials, extra_rows=count)
         spare = n_pad - len(all_trials)
@@ -823,9 +1114,11 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         zero_labels = types.PaddedArray.from_array(
             np.zeros((len(all_trials), 1), np.float32), (n_pad, 1), fill_value=np.nan
         )
-        return gp_lib.GPData.from_model_data(
-            types.ModelData(features, zero_labels)
-        )
+        return types.ModelData(features, zero_labels)
+
+    def _all_points_data(self, count: int) -> gp_lib.GPData:
+        """GPData over completed+active rows with capacity for the picks."""
+        return gp_lib.GPData.from_model_data(self._all_points_model_data(count))
 
     def suggest(self, count: Optional[int] = None) -> List[trial_.TrialSuggestion]:
         count = count or 1

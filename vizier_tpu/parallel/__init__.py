@@ -31,6 +31,12 @@ from vizier_tpu.models import kernels
 from vizier_tpu.optimizers import lbfgs as lbfgs_lib
 from vizier_tpu.optimizers import vectorized as vectorized_lib
 
+# Cross-study continuous batching (the intra-host sibling of the mesh data
+# plane below): N same-shape-bucket studies per device dispatch.
+from vizier_tpu.parallel.batch_executor import BatchExecutor
+from vizier_tpu.parallel.batch_executor import BatchSlotError
+from vizier_tpu.parallel.batch_executor import BucketKey
+
 Array = jax.Array
 
 DEVICE_AXIS = "devices"

@@ -1,0 +1,508 @@
+"""Cross-study continuous batching: N same-shape studies, ONE device program.
+
+The LLM-inference-server pattern applied to suggestion serving. Every
+study's GP-bandit computation is a small same-shape program — the padding
+schedule (``converters.padding``) quantizes trials/features into a small
+grid of ``(pad_trials, cont_width, cat_width)`` buckets by construction —
+so concurrent designer computations from *different* studies can be
+collected into shape-bucket queues and executed as one ``jax.vmap``-ed
+dispatch over a leading study axis (``gp_bandit.train_batched`` /
+``suggest_batched``). That replaces N dispatches that each leave the MXU
+idle between kernel launches with one dispatch of N-fold work.
+
+Scheduling is a bounded micro-batch window: a bucket flushes when it
+reaches ``max_batch_size`` slots ("full") or when its oldest slot has
+waited ``max_wait_ms`` ("timeout"), so single-study latency is bounded by
+the window. Partial batches are padded to ``max_batch_size`` with copies
+of slot 0 that are dropped at demux — one compiled program shape per
+bucket regardless of occupancy. A batch of one takes the ordinary
+sequential designer path (bit-identical to batching off when there is no
+concurrency).
+
+Fail isolation: a slot whose host-side ``batch_prepare`` raises is dropped
+from the batch before the device program runs; a device-program failure
+falls every slot back to its own sequential ``suggest`` (errors stay
+per-slot); a slot whose decoded suggestions contain non-finite parameters
+gets a typed ``TRANSIENT:`` error. In all three cases the error surfaces
+only to that study's waiter, which hands it to the existing reliability
+path (retry / circuit breaker / quasi-random fallback) — batchmates are
+never poisoned.
+
+Batchable designers expose four duck-typed hooks (``gp_bandit`` and
+``gp_ucb_pe`` implement them; anything else runs sequentially):
+
+- ``batch_bucket_key(count)`` → :class:`BucketKey` or None (unbatchable);
+- ``batch_prepare(count)`` → host-side encode + RNG draws, one item dict;
+- ``batch_execute(items, pad_to)`` → the vmapped device programs, one
+  output dict per item;
+- ``batch_finalize(item, output)`` → host-side decode + state writeback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
+
+from vizier_tpu.observability import metrics as metrics_lib
+from vizier_tpu.observability import tracing as tracing_lib
+from vizier_tpu.reliability import errors as errors_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketKey:
+    """Identity of one shape bucket: equal keys ⇒ batchable together.
+
+    ``statics`` carries the hashable jit-static objects (model, optimizers,
+    acquisition config, restart budget, …) so two studies share a bucket
+    exactly when they would share every compiled program — shape AND
+    configuration.
+    """
+
+    kind: str  # designer family, e.g. "gp_bandit" | "gp_ucb_pe"
+    pad_trials: int
+    cont_width: int
+    cat_width: int
+    metric_count: int
+    count: int  # suggestions per study (a jit-static of the sweep)
+    statics: Tuple[Hashable, ...] = ()
+
+    def label(self) -> str:
+        """Low-cardinality metrics/tracing label (one per shape bucket)."""
+        return (
+            f"{self.kind}/t{self.pad_trials}/f{self.cont_width}"
+            f"x{self.cat_width}/m{self.metric_count}/q{self.count}"
+        )
+
+
+class BatchSlotError(errors_lib.TransientError):
+    """A batched slot produced an invalid result (isolated to its study)."""
+
+
+class _Slot:
+    """One study's pending computation inside a bucket queue.
+
+    ``action`` is the scheduler's verdict, executed by the WAITING thread
+    once ``event`` fires: "batched" (finalize ``output``), "sequential"
+    (run the plain per-study suggest — the B=1 path, bit-identical to
+    batching off), or "fallback" (the shared device program failed; run the
+    plain suggest and account it). Host-side prepare/finalize running on
+    the waiter threads keeps the scheduler thread free to dispatch the next
+    bucket while this one decodes — the continuous-batching pipeline.
+    """
+
+    __slots__ = (
+        "designer", "count", "enqueued_at", "event", "error",
+        "item", "output", "action", "span",
+    )
+
+    def __init__(self, designer: Any, count: int, now: float, span) -> None:
+        self.designer = designer
+        self.count = count
+        self.enqueued_at = now
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.item: Optional[dict] = None
+        self.output: Any = None
+        self.action: str = "sequential"
+        self.span = span  # the submitter's active span (may be None)
+
+
+def stack_pytrees(trees: Sequence[Any], pad_to: Optional[int] = None) -> Any:
+    """Stacks per-study pytrees along a new leading axis, padding with
+    copies of tree 0 up to ``pad_to`` (masked out again at demux).
+
+    Host (numpy) leaves stack in numpy — zero device dispatches; the whole
+    batch then crosses to the device once, at the jitted program's entry.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    trees = list(trees)
+    if pad_to is not None and pad_to > len(trees):
+        trees = trees + [trees[0]] * (pad_to - len(trees))
+
+    def stack(*xs):
+        if all(not isinstance(x, jax.Array) for x in xs):
+            return np.stack([np.asarray(x) for x in xs])
+        return jnp.stack(xs)
+
+    return jax.tree_util.tree_map(stack, *trees)
+
+
+def slice_pytree(tree: Any, index: int) -> Any:
+    """Slot ``index`` of a leading-study-axis pytree.
+
+    Demux is meant to run on a host (``jax.device_get``-fetched) tree, where
+    each slice is a free numpy view; on device arrays every leaf slice would
+    be its own dispatch — fetch once, then slice.
+    """
+    import jax
+
+    return jax.tree_util.tree_map(lambda a: a[index], tree)
+
+
+def check_finite_suggestions(suggestions: Sequence[Any], study: str = "") -> None:
+    """Raises :class:`BatchSlotError` if any numeric parameter is non-finite.
+
+    A NaN escaping one slot of a batched program must degrade only its own
+    study; the TRANSIENT marker routes it into the reliability fallback.
+    """
+    for s in suggestions:
+        for name, value in s.parameters.as_dict().items():
+            if isinstance(value, float) and not math.isfinite(value):
+                raise BatchSlotError(
+                    errors_lib.mark_transient(
+                        f"BATCH_SLOT_INVALID: non-finite parameter "
+                        f"{name!r}={value!r} in batched suggestion"
+                        + (f" for study {study!r}" if study else "")
+                    )
+                )
+
+
+class BatchExecutor:
+    """Continuous-batching engine over shape-bucket queues.
+
+    Thread model: callers (one servicer thread per study, each already
+    holding its study's cache-entry lock) block in :meth:`suggest`; a single
+    daemon scheduler thread owns flush decisions and runs the batched
+    programs, so device dispatch is naturally serialized. The scheduler
+    never takes per-study locks — the submitting thread holds them while it
+    waits, which is exactly what makes mutating the designer from the
+    scheduler safe.
+    """
+
+    def __init__(
+        self,
+        max_batch_size: int = 8,
+        max_wait_ms: float = 4.0,
+        pad_partial: bool = True,
+        stats: Optional[Any] = None,  # serving.stats.ServingStats
+        metrics: Optional[metrics_lib.MetricsRegistry] = None,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        if max_batch_size < 1:
+            raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
+        self.max_batch_size = max_batch_size
+        self.max_wait_secs = max(max_wait_ms, 0.0) / 1000.0
+        self.pad_partial = pad_partial
+        self._stats = stats
+        self._time = time_fn
+        self._cond = threading.Condition()
+        self._queues: Dict[BucketKey, List[_Slot]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._occupancy = self._flushes = self._queue_wait = None
+        if metrics is not None:
+            self._occupancy = metrics.histogram(
+                "vizier_batch_occupancy",
+                help="Real (unpadded) slots per batch flush.",
+                buckets=[1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64],
+            )
+            self._flushes = metrics.counter(
+                "vizier_batch_flushes",
+                help="Batch flushes by reason (full | timeout | drain).",
+            )
+            self._queue_wait = metrics.histogram(
+                "vizier_batch_queue_wait_seconds",
+                help="Time a slot spent queued before its batch flushed.",
+            )
+
+    # -- submission ---------------------------------------------------------
+
+    def suggest(self, designer: Any, count: Optional[int] = None) -> List[Any]:
+        """Routes one study's suggest through the batching engine.
+
+        Unbatchable paths (designer without the protocol, seeding stage,
+        multi-objective, priors, …) run inline on the caller's thread —
+        identical to batching off.
+        """
+        count = count or 1
+        key_fn = getattr(designer, "batch_bucket_key", None)
+        key = key_fn(count) if key_fn is not None else None
+        if key is None or self._closed:
+            return designer.suggest(count)
+        tracer = tracing_lib.get_tracer()
+        slot = _Slot(designer, count, self._time(), tracer.current_span())
+        # Joining a non-empty bucket ⇒ this slot will (very likely) ride a
+        # batched flush: run its host-side prepare HERE, on the caller's
+        # thread, so it overlaps the in-flight flush's device window instead
+        # of serializing on the scheduler. A prepare failure stays inline —
+        # naturally isolated to this study. An empty bucket stays
+        # unprepared: if nobody joins before the window closes, the
+        # scheduler hands it back as a plain sequential suggest
+        # (bit-identical to batching off).
+        with self._cond:
+            will_batch = bool(self._queues.get(key))
+        if will_batch:
+            try:
+                slot.item = designer.batch_prepare(count)
+            except BaseException:
+                self._increment("batch_slot_errors")
+                raise
+        with self._cond:
+            closed = self._closed
+            if not closed:
+                self._ensure_scheduler()
+                self._queues.setdefault(key, []).append(slot)
+                self._cond.notify_all()
+        if closed:
+            return designer.suggest(count)
+        slot.event.wait()
+        return self._complete(slot)
+
+    def _complete(self, slot: _Slot) -> List[Any]:
+        """Runs the scheduler's verdict on the waiting thread."""
+        if slot.error is not None:
+            raise slot.error
+        if slot.action == "batched":
+            try:
+                suggestions = list(
+                    slot.designer.batch_finalize(slot.item, slot.output)
+                )
+                check_finite_suggestions(suggestions)
+            except BaseException:
+                self._increment("batch_slot_errors")
+                raise
+            self._increment("batched_suggests")
+            return suggestions
+        if slot.action == "fallback":
+            # The shared device program died (OOM, compile failure, chaos):
+            # nobody got the batched result; everybody retries alone on its
+            # own thread. This slot's error — if its sequential run also
+            # fails — stays its own.
+            self._increment("batch_fallbacks")
+            tracing_lib.add_current_event("batch_executor.fallback_sequential")
+            return list(slot.designer.suggest(slot.count))
+        return list(slot.designer.suggest(slot.count))  # "sequential"
+
+    def close(self) -> None:
+        """Drains every queue (reason "drain") and stops the scheduler."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=30.0)
+
+    def pending_counts(self) -> Dict[str, int]:
+        with self._cond:
+            return {k.label(): len(v) for k, v in self._queues.items() if v}
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _ensure_scheduler(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._scheduler_loop,
+                name="vizier-batch-executor",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def _take_due(self) -> List[Tuple[BucketKey, List[_Slot], str]]:
+        """Pops every due (key, slots, reason) batch. Caller holds the lock."""
+        now = self._time()
+        due: List[Tuple[BucketKey, List[_Slot], str]] = []
+        for key, slots in self._queues.items():
+            while len(slots) >= self.max_batch_size:
+                due.append((key, slots[: self.max_batch_size], "full"))
+                del slots[: self.max_batch_size]
+            if slots and (
+                self._closed
+                or now - slots[0].enqueued_at >= self.max_wait_secs
+            ):
+                due.append((key, slots[:], "drain" if self._closed else "timeout"))
+                slots.clear()
+        return due
+
+    def _next_deadline(self) -> Optional[float]:
+        """Seconds until the oldest queued slot times out (lock held)."""
+        oldest = None
+        for slots in self._queues.values():
+            if slots and (oldest is None or slots[0].enqueued_at < oldest):
+                oldest = slots[0].enqueued_at
+        if oldest is None:
+            return None
+        return max(oldest + self.max_wait_secs - self._time(), 0.0)
+
+    def _scheduler_loop(self) -> None:
+        while True:
+            with self._cond:
+                due = self._take_due()
+                if not due:
+                    if self._closed:
+                        return
+                    self._cond.wait(timeout=self._next_deadline())
+                    continue
+            for key, slots, reason in due:
+                self._execute(key, slots, reason)
+
+    # -- execution ----------------------------------------------------------
+
+    def _observe_flush(self, key: BucketKey, slots: List[_Slot], reason: str) -> None:
+        now = self._time()
+        label = key.label()
+        if self._flushes is not None:
+            self._flushes.inc(reason=reason)
+            self._occupancy.observe(len(slots), bucket=label)
+            for slot in slots:
+                self._queue_wait.observe(now - slot.enqueued_at, bucket=label)
+        if self._stats is not None:
+            self._stats.increment("batch_flushes")
+
+    def _execute(self, key: BucketKey, slots: List[_Slot], reason: str) -> None:
+        self._observe_flush(key, slots, reason)
+        tracer = tracing_lib.get_tracer()
+        with tracer.span(
+            "batch_executor.flush",
+            bucket=key.label(),
+            occupancy=len(slots),
+            reason=reason,
+        ) as span:
+            # Link the flush span and every member's request span both ways:
+            # a member trace shows WHICH batch served it, the flush span
+            # shows WHO shared the dispatch.
+            for slot in slots:
+                if slot.span is not None and span is not None:
+                    span.add_link(slot.span.context(), name="batch_member")
+                    slot.span.add_link(span.context(), name="batch_flush")
+                    slot.span.set_attribute("batch_occupancy", len(slots))
+            if len(slots) == 1 and slots[0].item is None:
+                # No batchmates and never prepared: hand back the plain
+                # sequential path, bit-identical to batching off (and no
+                # vmap overhead). The waiter runs it on its own thread.
+                slots[0].action = "sequential"
+                slots[0].event.set()
+                return
+            self._execute_batched(slots)
+
+    def _increment(self, field: str, amount: int = 1) -> None:
+        if self._stats is not None and amount:
+            self._stats.increment(field, amount)
+
+    def _execute_batched(self, slots: List[_Slot]) -> None:
+        # Prepare any slot that arrived into an empty bucket (typically the
+        # flush's first member; the rest prepared on their own threads at
+        # submit time). Slot-isolated: a study whose encode/RNG work raises
+        # is dropped from the batch before the device program runs.
+        live: List[_Slot] = []
+        for slot in slots:
+            if slot.item is None:
+                try:
+                    slot.item = slot.designer.batch_prepare(slot.count)
+                except BaseException as e:
+                    slot.error = e
+                    self._increment("batch_slot_errors")
+                    slot.event.set()
+                    continue
+            live.append(slot)
+        if not live:
+            return
+        # A lone prepare survivor still goes through the batched program:
+        # its RNG draws already happened in batch order, and pad_partial
+        # keeps the compiled shape identical either way.
+        pad_to = self.max_batch_size if self.pad_partial else None
+        try:
+            outputs = live[0].designer.batch_execute(
+                [slot.item for slot in live], pad_to=pad_to
+            )
+        except BaseException:
+            # The shared device program died: every slot retries alone on
+            # its own waiting thread (see _complete), errors slot-isolated.
+            tracing_lib.add_current_event(
+                "batch_executor.fallback_sequential", slots=len(live)
+            )
+            for slot in live:
+                slot.action = "fallback"
+                slot.event.set()
+            return
+        for slot, output in zip(live, outputs):
+            slot.output = output
+            slot.action = "batched"
+            slot.event.set()
+
+    # -- compile prewarm ----------------------------------------------------
+
+    def prewarm(
+        self,
+        problem: Any,  # pyvizier ProblemStatement
+        designer_factory: Callable[..., Any],
+        *,
+        max_trials: int = 32,
+        counts: Sequence[int] = (1,),
+        batch_sizes: Optional[Sequence[int]] = None,
+        rng_seed: int = 0,
+    ) -> List[dict]:
+        """Walks the padding-bucket grid and compiles the batched programs.
+
+        For every ``pad_trials`` bucket covering studies up to ``max_trials``
+        and every requested suggestion ``count``, synthetic studies are
+        trained + swept once at batch sizes {1, max} (1 warms the sequential
+        per-study programs, max the vmapped multi-study programs, which —
+        with ``pad_partial`` — is the only batched shape that ever runs).
+        First-request latency then pays no XLA compile. Returns one report
+        row per (bucket, count, batch_size) with wall seconds.
+        """
+        from vizier_tpu.designers import quasi_random
+        from vizier_tpu.pyvizier import trial as trial_
+
+        sizes = tuple(batch_sizes or (1, self.max_batch_size))
+        probe = designer_factory(problem)
+        schedule = probe._converter.padding
+        report: List[dict] = []
+        for bucket in schedule.trial_bucket_grid(max_trials):
+            for count in counts:
+                for size in sizes:
+                    t0 = time.perf_counter()
+                    designers = []
+                    for j in range(size):
+                        d = designer_factory(problem)
+                        seeder = quasi_random.QuasiRandomDesigner(
+                            problem.search_space, seed=rng_seed + j
+                        )
+                        trials = []
+                        for i, s in enumerate(seeder.suggest(bucket)):
+                            t = s.to_trial(i + 1)
+                            t.complete(
+                                trial_.Measurement(
+                                    metrics={
+                                        m.name: 0.1 * ((i + j) % 7)
+                                        for m in problem.metric_information
+                                    }
+                                )
+                            )
+                            trials.append(t)
+                        from vizier_tpu.algorithms import core as core_lib
+
+                        d.update(core_lib.CompletedTrials(trials))
+                        designers.append(d)
+                    status = "ok"
+                    try:
+                        if size == 1:
+                            designers[0].suggest(count)
+                        else:
+                            items = [d.batch_prepare(count) for d in designers]
+                            pad_to = (
+                                self.max_batch_size if self.pad_partial else None
+                            )
+                            outputs = designers[0].batch_execute(
+                                items, pad_to=pad_to
+                            )
+                            for d, item, out in zip(designers, items, outputs):
+                                d.batch_finalize(item, out)
+                    except Exception as e:  # prewarm must never block serving
+                        status = f"error:{type(e).__name__}"
+                    report.append(
+                        dict(
+                            pad_trials=bucket,
+                            count=count,
+                            batch_size=size,
+                            seconds=round(time.perf_counter() - t0, 4),
+                            status=status,
+                        )
+                    )
+        return report

@@ -40,7 +40,7 @@ class DefaultPolicyFactory:
         return kwargs
 
     def _gp_policy(
-        self, policy_supporter, factory, study_name: str
+        self, policy_supporter, factory, study_name: str, problem=None
     ) -> policy_lib.Policy:
         """Cache-backed policy when serving is on; stateless otherwise."""
         from vizier_tpu.algorithms import designer_policy
@@ -48,6 +48,10 @@ class DefaultPolicyFactory:
         if self._serving is not None and self._serving.config.designer_cache:
             from vizier_tpu.serving import policy as serving_policy
 
+            if problem is not None:
+                # Background AOT compile of the batched programs for this
+                # search-space shape (no-op unless batching_prewarm is on).
+                self._serving.maybe_prewarm_batching_async(problem, factory)
             return serving_policy.CachedDesignerStatePolicy(
                 policy_supporter,
                 factory,
@@ -107,7 +111,9 @@ class DefaultPolicyFactory:
                 from vizier_tpu.designers import gp_bandit
 
                 factory = lambda p, **kw: gp_bandit.VizierGPBandit(p)
-            return self._gp_policy(policy_supporter, factory, study_name)
+            return self._gp_policy(
+                policy_supporter, factory, study_name, problem=problem_statement
+            )
         if algorithm in ("GAUSSIAN_PROCESS_BANDIT",):
             from vizier_tpu.designers import gp_bandit
 
@@ -116,6 +122,7 @@ class DefaultPolicyFactory:
                 policy_supporter,
                 lambda p, **kw: gp_bandit.VizierGPBandit(p, **serving_kwargs),
                 study_name,
+                problem=problem_statement,
             )
         if algorithm == "RANDOM_SEARCH":
             return random_policy.RandomPolicy(policy_supporter)

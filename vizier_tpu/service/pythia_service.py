@@ -71,6 +71,40 @@ class PythiaServicer:
         """Serving counters + latency histograms, Prometheus text format."""
         return self._serving.prometheus_text()
 
+    def prewarm(
+        self,
+        study_config: vz.StudyConfig,
+        algorithm: str = "DEFAULT",
+        counts=(1,),
+        max_trials=None,
+    ) -> list:
+        """AOT-compiles the (batched) suggest programs for this study shape.
+
+        Walks the padding-bucket grid at batch sizes {1, max}: a server
+        prewarmed for its expected study shapes pays no XLA compile on the
+        first real request. Returns the per-bucket compile report (empty
+        when batching is off or the algorithm has no batched path).
+        """
+        from vizier_tpu.designers import gp_bandit, gp_ucb_pe
+
+        problem = study_config.to_problem()
+        kwargs_fn = getattr(self._policy_factory, "_gp_designer_kwargs", None)
+        kwargs = kwargs_fn() if kwargs_fn is not None else {}
+        algorithm = (algorithm or "DEFAULT").upper()
+        if algorithm in ("DEFAULT", "GP_UCB_PE", "ALGORITHM_UNSPECIFIED"):
+            factory = lambda p: gp_ucb_pe.VizierGPUCBPEBandit(p, **kwargs)
+        elif algorithm == "GAUSSIAN_PROCESS_BANDIT":
+            factory = lambda p: gp_bandit.VizierGPBandit(p, **kwargs)
+        else:
+            return []
+        return self._serving.prewarm_batching(
+            problem, factory, counts=counts, max_trials=max_trials
+        )
+
+    def shutdown(self) -> None:
+        """Drains the serving runtime's batch executor (idempotent)."""
+        self._serving.shutdown()
+
     def invalidate_study(self, study_name: str) -> None:
         """Drops every piece of per-study serving state (study deleted)."""
         self._serving.invalidate_study(study_name)

@@ -15,6 +15,11 @@ between requests instead:
   counters behind a small snapshot API;
 - :class:`ServingConfig` — the knobs (all on by default; env-overridable).
 
+The runtime also owns the cross-study batch executor
+(``vizier_tpu.parallel.batch_executor``): concurrent designer computations
+from different studies that share a padding bucket execute as one vmapped
+device program (``docs/guides/performance.md``).
+
 See ``docs/guides/serving.md`` for semantics and the intentional deviation
 from the reference's per-request cold train (PARITY.md).
 """

@@ -9,17 +9,40 @@ cold-train-per-request behavior without code changes):
 - ``VIZIER_SERVING_WARM_START=0`` — cache designers but cold-train ARD on
   every suggest (full restart budget from random inits);
 - ``VIZIER_SERVING_COALESCING=0`` — every Pythia suggest computes its own
-  designer run.
+  designer run;
+- ``VIZIER_BATCHING=0``           — no cross-study batch executor: every
+  study's computation dispatches alone (today's per-study path,
+  bit-identical suggestions);
+- ``VIZIER_BATCHING_PREWARM=1``   — AOT-compile the batched programs over
+  the padding-bucket grid when the first study of a shape arrives
+  (default off: prewarm is explicit via ``ServingRuntime.prewarm_batching``).
+- ``VIZIER_COMPILE_CACHE_DIR=/path`` — persist XLA compilations across
+  process restarts (``jax_compilation_cache_dir``).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+from typing import Optional
 
 
-def _env_on(name: str) -> bool:
-    return os.environ.get(name, "1") not in ("0", "false", "False", "")
+def _env_on(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default) not in ("0", "false", "False", "")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +64,30 @@ class ServingConfig:
     # WARM_START_AB.json (latency + regret parity).
     warm_ard_restarts: int = 1
 
+    # -- cross-study batching (vizier_tpu.parallel.batch_executor) ----------
+    # Collect concurrent designer computations from different studies into
+    # shape-bucket queues and run each bucket as ONE vmapped device program.
+    # The A/B evidence is BATCHING_AB.json (tools/batching_ab.py).
+    batching: bool = True
+    # Flush a bucket at this many studies ("full") ...
+    batch_max_size: int = 8
+    # ... or when its oldest request has waited this long ("timeout"), so
+    # single-study latency is bounded by the micro-batch window.
+    batch_max_wait_ms: float = 4.0
+    # Pad partial batches to batch_max_size with masked copies of slot 0:
+    # one compiled program shape per bucket regardless of occupancy.
+    batch_pad_partial: bool = True
+    # AOT-compile the batched programs over the padding-bucket grid when
+    # the first study of a shape arrives (background thread). Explicit
+    # prewarm via ServingRuntime.prewarm_batching works either way.
+    batching_prewarm: bool = False
+    # The padding-grid ceiling the prewarm walks (study sizes 1..N).
+    batching_prewarm_max_trials: int = 32
+
+    # JAX persistent compilation cache directory (applied at runtime init
+    # via ``jax_compilation_cache_dir``); None leaves jax's default alone.
+    compilation_cache_dir: Optional[str] = None
+
     @classmethod
     def from_env(cls) -> "ServingConfig":
         """The default config with per-knob environment overrides applied."""
@@ -48,9 +95,21 @@ class ServingConfig:
             designer_cache=_env_on("VIZIER_SERVING_CACHE"),
             warm_start=_env_on("VIZIER_SERVING_WARM_START"),
             coalescing=_env_on("VIZIER_SERVING_COALESCING"),
+            batching=_env_on("VIZIER_BATCHING"),
+            batch_max_size=_env_int("VIZIER_BATCH_MAX_SIZE", 8),
+            batch_max_wait_ms=_env_float("VIZIER_BATCH_MAX_WAIT_MS", 4.0),
+            batching_prewarm=_env_on("VIZIER_BATCHING_PREWARM", default="0"),
+            compilation_cache_dir=(
+                os.environ.get("VIZIER_COMPILE_CACHE_DIR") or None
+            ),
         )
 
     @classmethod
     def disabled(cls) -> "ServingConfig":
-        """Reference behavior: stateless, cold, uncoalesced."""
-        return cls(designer_cache=False, warm_start=False, coalescing=False)
+        """Reference behavior: stateless, cold, uncoalesced, unbatched."""
+        return cls(
+            designer_cache=False,
+            warm_start=False,
+            coalescing=False,
+            batching=False,
+        )

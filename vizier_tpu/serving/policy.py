@@ -111,7 +111,15 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
             designer=type(designer).__name__,
             count=count,
         ):
-            suggestions = list(designer.suggest(count))
+            # Cross-study batching: concurrent same-bucket computations from
+            # different studies share one vmapped device program. The
+            # executor runs unbatchable paths (and batching off) inline —
+            # the exact per-study call below.
+            executor = getattr(self._runtime, "batch_executor", None)
+            if executor is not None:
+                suggestions = list(executor.suggest(designer, count))
+            else:
+                suggestions = list(designer.suggest(count))
         self._account_trains(before, self._train_counts(designer))
         # Mirror the trained unconstrained ARD params into the entry: the
         # stats/inspection surface for "what would seed the next train",

@@ -13,7 +13,9 @@ latency), all dumped together by :meth:`prometheus_text`.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from vizier_tpu.observability import config as obs_config_lib
 from vizier_tpu.observability import metrics as metrics_lib
@@ -23,6 +25,31 @@ from vizier_tpu.serving import coalescer as coalescer_lib
 from vizier_tpu.serving import config as config_lib
 from vizier_tpu.serving import designer_cache as cache_lib
 from vizier_tpu.serving import stats as stats_lib
+
+_logger = logging.getLogger(__name__)
+
+
+def _apply_compilation_cache(cache_dir: str) -> bool:
+    """Points jax's persistent compilation cache at ``cache_dir``.
+
+    Best-effort: an older jax without the option must not take serving
+    down. The min-compile-time floor is dropped to 0 so the small per-bucket
+    GP programs (often < 1s compiles on CPU) are cached too.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:  # option renamed/missing: dir alone still helps
+            pass
+        return True
+    except Exception:
+        _logger.warning(
+            "Could not enable the JAX compilation cache at %r.", cache_dir
+        )
+        return False
 
 
 class ServingRuntime:
@@ -68,6 +95,90 @@ class ServingRuntime:
             "vizier_suggest_latency_seconds",
             help="SuggestTrials wall time per hop (service, pythia).",
         )
+        # JAX persistent compilation cache: survive process restarts so a
+        # restarted server pays zero XLA compiles for known buckets.
+        self.compilation_cache_active = False
+        if self.config.compilation_cache_dir:
+            self.compilation_cache_active = _apply_compilation_cache(
+                self.config.compilation_cache_dir
+            )
+        # Cross-study batch executor: concurrent same-bucket designer
+        # computations share ONE vmapped device program. None = batching
+        # off (VIZIER_BATCHING=0): the exact per-study path.
+        self.batch_executor = None
+        if self.config.batching:
+            from vizier_tpu.parallel import batch_executor as batch_executor_lib
+
+            self.batch_executor = batch_executor_lib.BatchExecutor(
+                max_batch_size=self.config.batch_max_size,
+                max_wait_ms=self.config.batch_max_wait_ms,
+                pad_partial=self.config.batch_pad_partial,
+                stats=self.stats,
+                metrics=(
+                    self.metrics if self.observability.metrics_on else None
+                ),
+            )
+        self._prewarmed_shapes: set = set()
+        self._prewarm_lock = threading.Lock()
+        self._prewarm_threads: List[threading.Thread] = []
+
+    # -- compile prewarm ----------------------------------------------------
+
+    def prewarm_batching(
+        self,
+        problem: Any,
+        designer_factory: Callable[..., Any],
+        *,
+        max_trials: Optional[int] = None,
+        counts: Sequence[int] = (1,),
+    ) -> List[dict]:
+        """Walks the padding-bucket grid for ``problem`` and AOT-compiles the
+        batched suggest programs at batch sizes {1, max} so first-request
+        latency pays no XLA compile. Returns the per-bucket compile report."""
+        if self.batch_executor is None:
+            return []
+        return self.batch_executor.prewarm(
+            problem,
+            designer_factory,
+            max_trials=max_trials or self.config.batching_prewarm_max_trials,
+            counts=counts,
+        )
+
+    def maybe_prewarm_batching_async(
+        self, problem: Any, designer_factory: Callable[..., Any]
+    ) -> bool:
+        """Background prewarm, once per distinct search-space shape; used by
+        the policy factory when ``config.batching_prewarm`` is on. Returns
+        True when a prewarm thread was started."""
+        if self.batch_executor is None or not self.config.batching_prewarm:
+            return False
+        shape_key = tuple(
+            sorted((p.name, str(p.type)) for p in problem.search_space.parameters)
+        )
+        with self._prewarm_lock:
+            if shape_key in self._prewarmed_shapes:
+                return False
+            self._prewarmed_shapes.add(shape_key)
+        thread = threading.Thread(
+            target=lambda: self.prewarm_batching(problem, designer_factory),
+            name="vizier-batch-prewarm",
+            daemon=True,
+        )
+        with self._prewarm_lock:
+            self._prewarm_threads.append(thread)
+        thread.start()
+        return True
+
+    def shutdown(self) -> None:
+        """Joins in-flight prewarm compiles (an XLA compile aborted by
+        interpreter teardown SIGABRTs the process) and drains the batch
+        executor. Idempotent."""
+        with self._prewarm_lock:
+            threads, self._prewarm_threads = self._prewarm_threads, []
+        for thread in threads:
+            thread.join(timeout=120.0)
+        if self.batch_executor is not None:
+            self.batch_executor.close()
 
     def observe_suggest_latency(self, hop: str, seconds: float) -> None:
         """Records one suggest's wall time at a hop (no-op when metrics are

@@ -44,6 +44,11 @@ class ServingStats:
         "breaker_close_transitions",
         "breaker_short_circuits",  # suggests skipped because a circuit was open
         "deadline_exceeded",  # ops completed with TRANSIENT: DEADLINE_EXCEEDED
+        # Cross-study batching (vizier_tpu.parallel.batch_executor).
+        "batch_flushes",  # bucket flushes (full / timeout / drain)
+        "batched_suggests",  # slots served from a shared vmapped program
+        "batch_fallbacks",  # slots rerun sequentially after a batch failure
+        "batch_slot_errors",  # slot-isolated prepare/finalize/NaN failures
     )
 
     def __init__(self, registry: Optional[metrics_lib.MetricsRegistry] = None):

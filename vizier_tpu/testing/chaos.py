@@ -130,6 +130,35 @@ class ChaosDesigner(core_lib.Designer):
             raise failing.FailedSuggestError(str(e)) from None
         return list(self._inner.suggest(count))
 
+    # -- cross-study batch protocol (vizier_tpu.parallel.batch_executor) ----
+    # Chaos-wrapped designers stay batchable: the executor's fail-isolation
+    # contract (one faulting slot degrades only its own study) is exercised
+    # by striking in the per-slot host-side hooks. A strike in
+    # ``batch_execute`` poisons the shared device program, driving the
+    # whole-batch sequential-fallback path instead.
+
+    def batch_bucket_key(self, count: Optional[int] = None):
+        key_fn = getattr(self._inner, "batch_bucket_key", None)
+        return key_fn(count) if key_fn is not None else None
+
+    def batch_prepare(self, count: Optional[int] = None) -> dict:
+        try:
+            self._chaos.strike("designer.batch_prepare")
+        except InjectedFaultError as e:
+            raise failing.FailedSuggestError(str(e)) from None
+        return self._inner.batch_prepare(count)
+
+    def batch_execute(self, items, pad_to: Optional[int] = None):
+        self._chaos.strike("designer.batch_execute")
+        return self._inner.batch_execute(items, pad_to=pad_to)
+
+    def batch_finalize(self, item: dict, output) -> List[trial_.TrialSuggestion]:
+        try:
+            self._chaos.strike("designer.batch_finalize")
+        except InjectedFaultError as e:
+            raise failing.FailedSuggestError(str(e)) from None
+        return self._inner.batch_finalize(item, output)
+
 
 def chaos_designer_factory(
     inner_factory: Callable[..., core_lib.Designer],
