@@ -1,0 +1,36 @@
+"""Shared fixtures: one whole-tree analysis run per session, one fixtures
+run per session — the passes are pure functions of the source, so every
+test can share them."""
+
+import os
+
+import pytest
+
+from vizier_tpu.analysis import common, suite
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+FIXTURES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+@pytest.fixture(scope="session")
+def repo_root():
+    return REPO_ROOT
+
+
+@pytest.fixture(scope="session")
+def fixtures_dir():
+    return FIXTURES_DIR
+
+
+@pytest.fixture(scope="session")
+def real_suite_result(repo_root):
+    """The full configured suite over the real tree (baseline applied)."""
+    return suite.run_suite(repo_root)
+
+
+@pytest.fixture(scope="session")
+def fixtures_project(fixtures_dir, repo_root):
+    """AST project over the seeded-violation fixtures only."""
+    return common.Project([fixtures_dir], rel_to=repo_root)

@@ -1,0 +1,32 @@
+"""Seeded env-registry violations for tests/analysis/test_env_registry.py.
+Never imported — analyzed as AST only."""
+
+import os
+
+
+def undeclared_get():
+    return os.environ.get("VIZIER_NOT_A_REAL_SWITCH", "1")
+
+
+def undeclared_subscript():
+    return os.environ["VIZIER_ALSO_NOT_DECLARED"]
+
+
+def undeclared_getenv():
+    return os.getenv("VIZIER_NOT_A_REAL_SWITCH")
+
+
+def read_of_reserved_constant():
+    # VIZIER_METHODS is a declared *constant* (the gRPC method table), not
+    # an environment switch; reading it from the environment is a bug.
+    return os.environ.get("VIZIER_METHODS")
+
+
+def dynamic_read(name: str):
+    # Hides the switch name from static scanning; must go through
+    # vizier_tpu.analysis.registry helpers instead.
+    return os.environ.get(name, "0")
+
+
+def declared_read_is_fine():
+    return os.environ.get("VIZIER_BATCHING", "1")

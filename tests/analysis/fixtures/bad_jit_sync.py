@@ -1,0 +1,59 @@
+"""Seeded JAX-discipline violations (host syncs, tracer branch, retrace
+hazards) for tests/analysis/test_jax_discipline.py. Never imported —
+analyzed as AST only, so the bodies need not be runnable jax code."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def bad_host_syncs(x, scale):
+    # Each of these forces a device flush inside the traced program.
+    x.block_until_ready()
+    host = np.asarray(x)
+    scalar = x.mean().item()
+    coerced = float(host)
+    return x * scale + scalar + coerced
+
+
+@jax.jit
+def bad_tracer_branch(x):
+    total = jnp.sum(x)
+    if total > 0:  # Python branch on a traced value
+        return x
+    return -x
+
+
+def _helper_reached_from_jit(y):
+    # Reachable from jitted caller below: sync flagged here too.
+    return np.asarray(y)
+
+
+@jax.jit
+def bad_sync_via_helper(x):
+    return _helper_reached_from_jit(x)
+
+
+@functools.partial(jax.jit, static_argnames=("sizes",))
+def takes_static_sizes(x, sizes):
+    return x
+
+
+def bad_call_sites(x, items):
+    # Unhashable literal as a jit-static: TypeError at trace time.
+    takes_static_sizes(x, [1, 2, 3])
+    # Per-request len() as a jit-static: a recompile per distinct size.
+    takes_static_sizes(x, len(items))
+    for _ in range(3):
+        # A fresh jitted callable per iteration: retraces every pass.
+        fresh = jax.jit(lambda v: v + 1)
+        x = fresh(x)
+    return x
+
+
+def clean_static_usage(x):
+    # Tuple statics and hoisted jit: no findings here.
+    return takes_static_sizes(x, (1, 2, 3))

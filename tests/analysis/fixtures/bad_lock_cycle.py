@@ -1,0 +1,50 @@
+"""Seeded lock-order violations: a classic ABBA deadlock cycle plus a
+blocking wait under a held lock. NEVER imported — the analysis passes read
+this file as AST only; it exists so tests/analysis/test_lock_order.py can
+assert each seeded finding is reported (and nothing else)."""
+
+import threading
+import time
+
+
+class AccountA:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+
+    def transfer_to_b(self, b: "AccountB"):
+        # A -> B ...
+        with self.lock_a:
+            with b.lock_b:
+                pass
+
+    def sleep_while_locked(self):
+        # Blocking op under a held (critical) lock.
+        with self.lock_a:
+            time.sleep(0.1)
+
+
+class AccountB:
+    def __init__(self):
+        self.lock_b = threading.Lock()
+
+    def transfer_to_a(self, a: AccountA):
+        # ... and B -> A: the ABBA cycle.
+        with self.lock_b:
+            with a.lock_a:
+                pass
+
+
+class Waiter:
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.done = threading.Event()
+
+    def ok_same_condition_wait(self):
+        # Waiting on the condition you hold RELEASES it: not a violation.
+        with self.cond:
+            self.cond.wait(timeout=0.1)
+
+    def bad_event_wait_under_cond(self):
+        # Waiting on a DIFFERENT primitive while holding the condition.
+        with self.cond:
+            self.done.wait(timeout=0.1)

@@ -1,0 +1,41 @@
+"""A well-behaved module: consistent lock order, leaf critical sections,
+declared env reads, disciplined jit code. The analysis passes must report
+ZERO findings here. Never imported — analyzed as AST only."""
+
+import functools
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class OrderedPair:
+    """Always outer -> inner: a consistent global order, no cycle."""
+
+    def __init__(self):
+        self.outer = threading.Lock()
+        self.inner = threading.Lock()
+        self.items = []
+
+    def push(self, item):
+        with self.outer:
+            with self.inner:
+                self.items.append(item)
+
+    def pop(self):
+        with self.outer:
+            with self.inner:
+                return self.items.pop() if self.items else None
+
+
+@functools.partial(jax.jit, static_argnames=("axis",))
+def disciplined_reduce(x, axis):
+    # Shape-derived branching is static under tracing: allowed.
+    if x.ndim > 1:
+        return jnp.sum(x, axis=axis)
+    return jnp.sum(x)
+
+
+def read_declared_switch():
+    return os.environ.get("VIZIER_OBSERVABILITY", "1")

@@ -1,0 +1,103 @@
+"""Baseline machinery: the TOML-subset reader, matching, and staleness."""
+
+import pytest
+
+from vizier_tpu.analysis import baseline as baseline_lib
+from vizier_tpu.analysis import common
+
+
+class TestTomlSubset:
+    def test_tables_arrays_and_scalars(self):
+        data = baseline_lib.parse_toml_subset(
+            """
+            version = 1  # trailing comment
+            title = "with # hash inside"
+
+            [tool.vizier_analysis]
+            paths = ["vizier_tpu", "tools"]
+            fast = true
+            ratio = 0.5
+
+            [[finding]]
+            pass = "lock_order"
+            key = "a->b"
+            reason = "why"
+
+            [[finding]]
+            pass = "env_registry"
+            key = "c"
+            reason = "also why"
+            """
+        )
+        assert data["version"] == 1
+        assert data["title"] == "with # hash inside"
+        assert data["tool"]["vizier_analysis"]["paths"] == [
+            "vizier_tpu",
+            "tools",
+        ]
+        assert data["tool"]["vizier_analysis"]["fast"] is True
+        assert data["tool"]["vizier_analysis"]["ratio"] == 0.5
+        assert [f["key"] for f in data["finding"]] == ["a->b", "c"]
+
+    def test_multiline_array(self):
+        data = baseline_lib.parse_toml_subset(
+            'paths = [\n  "a",\n  "b",\n]\n'
+        )
+        assert data["paths"] == ["a", "b"]
+
+    def test_unsupported_value_is_loud(self):
+        with pytest.raises(baseline_lib.TomlSubsetError):
+            baseline_lib.parse_toml_subset("when = 2024-01-01\n")
+
+
+def _finding(key, pass_name="lock_order"):
+    return common.Finding(
+        pass_name=pass_name,
+        rule="r",
+        key=key,
+        message="m",
+        path="p.py",
+        line=1,
+    )
+
+
+class TestBaselineMatching:
+    def test_apply_partitions_and_reports_stale(self, tmp_path):
+        path = tmp_path / "baseline.toml"
+        path.write_text(
+            """
+            [[finding]]
+            pass = "lock_order"
+            key = "known"
+            reason = "intentional"
+
+            [[finding]]
+            pass = "lock_order"
+            key = "gone"
+            reason = "used to match"
+            """
+        )
+        bl = baseline_lib.load_baseline(str(path))
+        new, accepted, stale = bl.apply([_finding("known"), _finding("fresh")])
+        assert [f.key for f in new] == ["fresh"]
+        assert [f.key for f in accepted] == ["known"]
+        assert [e.key for e in stale] == ["gone"]
+
+    def test_key_matches_within_pass_only(self, tmp_path):
+        path = tmp_path / "baseline.toml"
+        path.write_text(
+            '[[finding]]\npass = "env_registry"\nkey = "k"\nreason = "x"\n'
+        )
+        bl = baseline_lib.load_baseline(str(path))
+        new, accepted, _ = bl.apply([_finding("k", pass_name="lock_order")])
+        assert len(new) == 1 and not accepted
+
+    def test_empty_reason_rejected(self, tmp_path):
+        path = tmp_path / "baseline.toml"
+        path.write_text('[[finding]]\npass = "p"\nkey = "k"\nreason = "  "\n')
+        with pytest.raises(baseline_lib.TomlSubsetError, match="reason"):
+            baseline_lib.load_baseline(str(path))
+
+    def test_missing_file_is_empty_baseline(self, tmp_path):
+        bl = baseline_lib.load_baseline(str(tmp_path / "nope.toml"))
+        assert bl.entries == []

@@ -1,0 +1,122 @@
+"""tools/check_analysis.py: exit codes, config plumbing, JSON output.
+
+The CLI is exercised in-process through its main() (cheap); one
+subprocess test proves the real entry point works without pytest's import
+state.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from vizier_tpu.analysis import suite
+
+
+def _load_cli(repo_root):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_analysis", os.path.join(repo_root, "tools", "check_analysis.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def cli(repo_root):
+    return _load_cli(repo_root)
+
+
+class TestExitCodes:
+    def test_clean_tree_exits_zero_under_budget(self, cli, capsys):
+        t0 = time.perf_counter()
+        rc = cli.main([])
+        elapsed = time.perf_counter() - t0
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "ANALYSIS OK" in out
+        # Acceptance bound is <30s for all four passes; enforce it with
+        # headroom so drift is visible early.
+        assert elapsed < 30, f"analysis took {elapsed:.1f}s"
+
+    def test_seeded_fixtures_exit_nonzero(self, cli, tmp_path, capsys):
+        empty = tmp_path / "empty_baseline.toml"
+        empty.write_text("version = 1\n")
+        rc = cli.main(
+            [
+                "--paths",
+                "tests/analysis/fixtures",
+                "--baseline",
+                str(empty),
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "bad_lock_cycle" in out
+        assert "ANALYSIS FAILED" in out
+
+    def test_stale_baseline_fails_only_in_strict_mode(
+        self, cli, tmp_path, capsys
+    ):
+        stale = tmp_path / "stale.toml"
+        stale.write_text(
+            '[[finding]]\npass = "lock_order"\nkey = "cycle:nope"\n'
+            'reason = "never matches"\n'
+        )
+        rc = cli.main(
+            ["--paths", "tests/analysis/fixtures/clean_module.py",
+             "--baseline", str(stale)]
+        )
+        capsys.readouterr()
+        assert rc == 0
+        rc = cli.main(
+            ["--paths", "tests/analysis/fixtures/clean_module.py",
+             "--baseline", str(stale), "--strict-baseline"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "STALE" in out
+
+
+class TestConfigPlumbing:
+    def test_pyproject_section_is_read(self, repo_root):
+        config = suite.load_config(repo_root)
+        assert "vizier_tpu" in config.paths
+        assert config.baseline == "vizier_tpu/analysis/baseline.toml"
+        assert set(config.passes) == set(suite.ALL_PASSES)
+        assert "VizierServicer._study_locks" in config.critical_locks
+
+    def test_single_pass_selection(self, cli, capsys):
+        rc = cli.main(["--pass", "env_registry"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[env_registry]" in out
+        assert "[lock_order]" not in out
+
+    def test_json_output_with_lock_graph(self, cli, capsys):
+        rc = cli.main(["--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert payload["ok"] is True
+        assert payload["lock_graph"]["sites"], "lock graph missing"
+        site_ids = {s["lock_id"] for s in payload["lock_graph"]["sites"]}
+        assert "VizierServicer._study_locks" in site_ids
+
+
+@pytest.mark.slow
+class TestRealSubprocess:
+    def test_entry_point_runs_standalone(self, repo_root):
+        proc = subprocess.run(
+            [sys.executable, os.path.join("tools", "check_analysis.py")],
+            cwd=repo_root,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ANALYSIS OK" in proc.stdout

@@ -1,0 +1,199 @@
+"""Instrumented-lock factory: runtime acquisition order is recorded and
+cross-checked against the static graph — observed edges the static pass
+missed are surfaced, intentional/static edges are confirmed."""
+
+import threading
+
+from vizier_tpu.analysis import debug_locks
+
+
+class TestObservatoryMechanics:
+    def test_nested_acquisition_records_edge(self):
+        with debug_locks.instrument() as obs:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+        pairs = obs.edge_pairs()
+        assert len(pairs) == 1
+        ((src, dst),) = pairs
+        assert src.line < dst.line  # a was created before b
+        assert obs.acquisitions == 2
+
+    def test_reentrant_rlock_no_self_edge(self):
+        with debug_locks.instrument() as obs:
+            r = threading.RLock()
+            with r:
+                with r:
+                    pass
+        assert obs.edge_pairs() == set()
+
+    def test_condition_wait_releases_held_lock(self):
+        # A waiter holding ONLY the condition must not manufacture edges
+        # against locks acquired by the notifier while it sleeps.
+        with debug_locks.instrument() as obs:
+            cond = threading.Condition()
+            other = threading.Lock()
+            state = {"ready": False}
+
+            def waiter():
+                with cond:
+                    while not state["ready"]:
+                        cond.wait(timeout=5)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            import time
+
+            time.sleep(0.05)
+            with other:  # acquired while the waiter sleeps in wait()
+                with cond:
+                    state["ready"] = True
+                    cond.notify_all()
+            t.join(timeout=5)
+        sites = {s.line for s, _ in obs.edge_pairs()} | {
+            d.line for _, d in obs.edge_pairs()
+        }
+        # The only edge is other->cond (the notifier's nesting); the
+        # sleeping waiter contributes none.
+        assert len(obs.edge_pairs()) == 1
+
+    def test_unpatched_after_exit(self):
+        with debug_locks.instrument():
+            pass
+        assert not isinstance(
+            threading.Lock(), debug_locks._InstrumentedBase
+        )
+
+
+class TestCrossCheckAgainstStaticGraph:
+    def test_real_serving_locks_confirmed_by_static_graph(
+        self, real_suite_result, repo_root
+    ):
+        """Drive the REAL designer-cache/coalescer path under instrumented
+        locks; every observed nesting must be predicted statically."""
+        with debug_locks.instrument() as obs:
+            from vizier_tpu.serving.coalescer import RequestCoalescer
+            from vizier_tpu.serving.designer_cache import DesignerStateCache
+
+            cache = DesignerStateCache(
+                max_entries=4, observe_latency=False
+            )
+            coalescer = RequestCoalescer(observe_latency=False)
+
+            def one_study(name):
+                entry = cache.get_or_create(name, lambda: object())
+                with entry.lock:
+                    # The policy's error path: invalidate under the entry
+                    # lock (the entry.lock -> map lock static edge).
+                    cache.invalidate(name)
+
+            threads = [
+                threading.Thread(target=one_study, args=(f"s{i}",))
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            coalescer.coalesce("k", lambda: 42)
+        check = debug_locks.check_against_static(
+            obs, real_suite_result.lock_result, repo_root
+        )
+        assert check.missing_static == [], (
+            "runtime lock order the static graph missed: "
+            f"{[(s, d) for s, d, _ in check.missing_static]}"
+        )
+        assert (
+            "CachedDesignerEntry.lock",
+            "DesignerStateCache._lock",
+        ) in check.confirmed
+
+    def test_chaos_workload_order_matches_static_graph(
+        self, real_suite_result, repo_root
+    ):
+        """Seeded chaos faults drive the serving cache down BOTH its happy
+        and error paths (invalidate-under-entry-lock) across threads; every
+        acquisition order the chaos run observes must be statically
+        predicted."""
+        from vizier_tpu.testing import chaos as chaos_lib
+
+        monkey = chaos_lib.ChaosMonkey(seed=7, failure_prob=0.4)
+        with debug_locks.instrument() as obs:
+            from vizier_tpu.serving.coalescer import RequestCoalescer
+            from vizier_tpu.serving.designer_cache import DesignerStateCache
+
+            cache = DesignerStateCache(max_entries=3, observe_latency=False)
+            coalescer = RequestCoalescer(observe_latency=False)
+
+            def worker(tid):
+                for step in range(6):
+                    name = f"s{(tid + step) % 4}"
+                    entry = cache.get_or_create(name, lambda: object())
+                    try:
+                        with entry.lock:
+                            # The policy's critical section: chaos decides
+                            # between a clean suggest and the error path,
+                            # which (like CachedDesignerStatePolicy)
+                            # invalidates UNDER the entry lock.
+                            try:
+                                monkey.strike(f"suggest/{name}")
+                            except chaos_lib.InjectedFaultError:
+                                cache.invalidate(name)
+                                raise
+                    except chaos_lib.InjectedFaultError:
+                        pass
+                    coalescer.coalesce((name, step), lambda: step)
+
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        assert monkey.total_faults() > 0, "chaos never fired; weak test"
+        check = debug_locks.check_against_static(
+            obs, real_suite_result.lock_result, repo_root
+        )
+        assert check.missing_static == [], (
+            "chaos run observed lock order the static graph missed: "
+            f"{[(s, d) for s, d, _ in check.missing_static]}"
+        )
+
+    def test_seeded_inversion_is_caught(self, real_suite_result, repo_root):
+        """An acquisition order the static graph does NOT contain must be
+        reported as a gap — the harness's whole point."""
+        with debug_locks.instrument() as obs:
+            from vizier_tpu.serving.coalescer import RequestCoalescer
+            from vizier_tpu.serving.designer_cache import DesignerStateCache
+
+            cache = DesignerStateCache(max_entries=4, observe_latency=False)
+            coalescer = RequestCoalescer(observe_latency=False)
+            entry = cache.get_or_create("s", lambda: object())
+            with entry.lock:
+                with coalescer._lock:  # no static code path does this
+                    pass
+        check = debug_locks.check_against_static(
+            obs, real_suite_result.lock_result, repo_root
+        )
+        assert (
+            "CachedDesignerEntry.lock",
+            "RequestCoalescer._lock",
+        ) in [(s, d) for s, d, _ in check.missing_static]
+
+    def test_creation_site_maps_to_static_site(
+        self, real_suite_result, repo_root
+    ):
+        with debug_locks.instrument() as obs:
+            from vizier_tpu.serving.designer_cache import DesignerStateCache
+
+            DesignerStateCache(max_entries=2, observe_latency=False)
+        mapped = {
+            debug_locks.map_site(
+                s, real_suite_result.lock_result.sites, repo_root
+            )
+            for s in obs.sites
+        }
+        assert "DesignerStateCache._lock" in mapped

@@ -1,0 +1,142 @@
+"""Lock-order pass: seeded fixtures report exactly the planted findings;
+the real tree is clean modulo the checked-in baseline; the static graph
+covers every threading.Lock/RLock/Condition site in vizier_tpu/."""
+
+import os
+import re
+
+from vizier_tpu.analysis import lock_order
+
+
+def _fixture_result(fixtures_project):
+    return lock_order.run(
+        fixtures_project,
+        critical_locks=("AccountA.lock_a", "Waiter.cond"),
+    )
+
+
+class TestSeededFixtures:
+    def test_abba_cycle_detected(self, fixtures_project):
+        result = _fixture_result(fixtures_project)
+        cycles = [f for f in result.findings if f.rule == "lock-cycle"]
+        assert len(cycles) == 1
+        assert cycles[0].key == "cycle:AccountA.lock_a->AccountB.lock_b"
+
+    def test_sleep_under_critical_lock_flagged(self, fixtures_project):
+        result = _fixture_result(fixtures_project)
+        keys = {f.key for f in result.findings}
+        assert (
+            "AccountA.lock_a->wait@tests/analysis/fixtures/"
+            "bad_lock_cycle.py::AccountA.sleep_while_locked" in keys
+        )
+
+    def test_foreign_wait_under_condition_flagged(self, fixtures_project):
+        result = _fixture_result(fixtures_project)
+        keys = {f.key for f in result.findings}
+        assert (
+            "Waiter.cond->wait@tests/analysis/fixtures/"
+            "bad_lock_cycle.py::Waiter.bad_event_wait_under_cond" in keys
+        )
+
+    def test_same_condition_wait_is_exempt(self, fixtures_project):
+        result = _fixture_result(fixtures_project)
+        assert not any(
+            "ok_same_condition_wait" in f.key for f in result.findings
+        )
+
+    def test_clean_module_has_no_findings_and_ordered_edges(
+        self, fixtures_project
+    ):
+        result = _fixture_result(fixtures_project)
+        assert not any("clean_module" in f.path for f in result.findings)
+        assert ("OrderedPair.outer", "OrderedPair.inner") in result.edge_pairs()
+
+    def test_exactly_the_seeded_findings(self, fixtures_project):
+        # Nothing beyond the three planted violations: precision matters as
+        # much as recall, or the baseline rots.
+        result = _fixture_result(fixtures_project)
+        assert len(result.findings) == 3
+
+
+class TestRealTree:
+    def test_no_unbaselined_findings(self, real_suite_result):
+        assert real_suite_result.passes["lock_order"].new == []
+
+    def test_intentional_exceptions_are_baselined_not_silent(
+        self, real_suite_result
+    ):
+        # The per-study entry-lock-over-compute design must stay VISIBLE as
+        # a baselined finding — if it vanishes, either the code or the
+        # analyzer regressed.
+        accepted = {
+            f.key for f in real_suite_result.passes["lock_order"].accepted
+        }
+        assert (
+            "CachedDesignerEntry.lock->device_compute@vizier_tpu/serving/"
+            "policy.py::CachedDesignerStatePolicy._run_designer" in accepted
+        )
+
+    def test_graph_covers_every_threading_lock_site(
+        self, real_suite_result, repo_root
+    ):
+        """Every textual threading.Lock/RLock/Condition construction in
+        vizier_tpu/ must appear as a node of the static graph."""
+        sites = {
+            (s.path, s.line) for s in real_suite_result.lock_result.sites
+        }
+        site_files = {s.path for s in real_suite_result.lock_result.sites}
+        pattern = re.compile(r"threading\.(Lock|RLock|Condition)\(\)")
+        missing = []
+        for dirpath, dirnames, filenames in os.walk(
+            os.path.join(repo_root, "vizier_tpu")
+        ):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                abspath = os.path.join(dirpath, fn)
+                rel = os.path.relpath(abspath, repo_root)
+                with open(abspath, "r", encoding="utf-8") as f:
+                    for lineno, line in enumerate(f, 1):
+                        if pattern.search(line) and (rel, lineno) not in sites:
+                            missing.append(f"{rel}:{lineno}")
+        assert not missing, f"lock sites not in the static graph: {missing}"
+        # Factory-constructed locks are covered too.
+        ids = real_suite_result.lock_result.site_ids()
+        assert "VizierServicer._study_locks" in ids
+        assert "vizier_tpu/service/vizier_service.py" in site_files
+
+    def test_cross_module_edges_resolved(self, real_suite_result):
+        edges = real_suite_result.lock_result.edge_pairs()
+        # Serving: one study's entry lock reaches the batch executor's
+        # condition (slot wait) and the cache map lock (invalidate-on-error).
+        assert ("CachedDesignerEntry.lock", "BatchExecutor._cond") in edges
+        assert (
+            "CachedDesignerEntry.lock",
+            "DesignerStateCache._lock",
+        ) in edges
+        # Service: study locks nest over datastore locks (both impls).
+        assert (
+            "VizierServicer._study_locks",
+            "NestedDictRAMDataStore._lock",
+        ) in edges
+        assert ("VizierServicer._study_locks", "SQLDataStore._lock") in edges
+
+    def test_study_lock_never_reaches_compute_or_batching(
+        self, real_suite_result
+    ):
+        # The deliberate design invariant the suggest path documents:
+        # Pythia dispatch (and therefore designer compute / batch waits)
+        # happens OUTSIDE the study lock.
+        edges = real_suite_result.lock_result.edge_pairs()
+        assert ("VizierServicer._study_locks", "BatchExecutor._cond") not in edges
+        assert (
+            "VizierServicer._study_locks",
+            "CachedDesignerEntry.lock",
+        ) not in edges
+
+    def test_no_cycles_in_real_tree(self, real_suite_result):
+        assert not any(
+            f.rule == "lock-cycle"
+            for f in real_suite_result.passes["lock_order"].findings
+        )

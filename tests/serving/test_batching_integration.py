@@ -23,6 +23,9 @@ def _fast_gp_kwargs():
             max_acquisition_evaluations=200,
             ard_restarts=2,
             ard_optimizer=lbfgs_lib.AdamOptimizer(maxiter=10),
+            # Few-trial integration runs: keep warm seeding engaged below
+            # the production floor so warm-path wiring is exercised.
+            warm_start_min_trials=0,
         )
     return _FAST_GP_KWARGS
 

@@ -338,6 +338,10 @@ def fast_gp_kwargs():
         max_acquisition_evaluations=300,
         ard_restarts=2,
         ard_optimizer=lbfgs_lib.LbfgsOptimizer(maxiter=5),
+        # These tests assert warm/cold counter plumbing at single-digit
+        # trial counts; disable the convergence-protecting engage floor so
+        # warm seeding starts on the second train as the assertions expect.
+        warm_start_min_trials=0,
     )
 
 

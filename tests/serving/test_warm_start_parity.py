@@ -44,6 +44,10 @@ def _run_arm(seed: int, warm: bool) -> float:
         ard_optimizer=lbfgs_lib.LbfgsOptimizer(maxiter=8),
         use_warm_start_ard=warm,
         warm_ard_restarts=1 if warm else None,
+        # The parity claim is about the warm MECHANISM; at this CI scale
+        # (12 trials) the engage floor would leave the warm arm cold and
+        # make the comparison vacuous.
+        warm_start_min_trials=0,
     )
     best, tid = np.inf, 0
     while tid < TRIALS:

@@ -6,6 +6,10 @@
 set -e
 cd "$(dirname "$0")/.."
 
+echo "== 0. static analysis: lock order / JAX discipline / env registry (~2 s) =="
+#    zero unbaselined violations (docs/guides/static_analysis.md)
+python tools/check_analysis.py
+
 echo "== 1. full test suite (~16 min, 989 tests) =="
 python -m pytest tests/ -q
 

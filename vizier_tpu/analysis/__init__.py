@@ -1,0 +1,30 @@
+"""Static analysis suite: lock order, JAX discipline, env-switch registry.
+
+Stdlib-only (``ast``-based — importable and runnable without jax). Run it
+as a CLI (``python tools/check_analysis.py``) or through the tier-1 tests
+(``tests/analysis/``); both share :func:`vizier_tpu.analysis.suite.run_suite`
+and the checked-in ``baseline.toml``. See docs/guides/static_analysis.md.
+"""
+
+from vizier_tpu.analysis import registry
+from vizier_tpu.analysis.common import Finding, Project
+from vizier_tpu.analysis.suite import (
+    ALL_PASSES,
+    SuiteConfig,
+    SuiteResult,
+    format_report,
+    load_config,
+    run_suite,
+)
+
+__all__ = [
+    "ALL_PASSES",
+    "Finding",
+    "Project",
+    "SuiteConfig",
+    "SuiteResult",
+    "format_report",
+    "load_config",
+    "registry",
+    "run_suite",
+]

@@ -1,0 +1,498 @@
+"""Pass 2: JAX tracing discipline inside jit/vmap-reachable code.
+
+The GP-bandit hot path keeps one compiled program per padding bucket;
+that contract only holds while jitted code stays free of host syncs and
+per-call retrace hazards. This pass finds the functions that jit/vmap
+will trace — decorator roots (``@jax.jit``, ``@functools.partial(jax.jit,
+...)``), call-site roots (``jax.jit(f)``), ``jax.vmap`` targets and
+``lax.scan/cond/while_loop`` body functions, plus everything reachable
+from them through the project call graph — and flags, inside that traced
+set:
+
+- **host syncs**: ``.block_until_ready()``, ``jax.device_get``,
+  ``np.asarray``/``np.array`` on traced values, ``.item()``, and
+  ``float()``/``int()`` coercions of non-literal expressions — each forces
+  the device to flush mid-program;
+- **tracer branching**: Python ``if``/``while`` whose condition involves a
+  non-static parameter of a jit root or a value produced by ``jnp``/``jax``
+  ops (shape/ndim/dtype/len and ``is None`` tests are static and exempt);
+- **retrace hazards**: ``jax.jit(...)`` created inside a loop, static
+  arguments that are unhashable literals (list/dict/set), and ``len(...)``
+  passed directly as a jit-static (a per-size recompile outside the
+  padding-bucket grid).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from vizier_tpu.analysis import common
+
+PASS_NAME = "jax_discipline"
+
+_TRACE_ENTRY_TAILS = {"jit", "vmap", "pmap"}
+_LAX_BODY_FUNCS = {"scan", "cond", "while_loop", "fori_loop", "map", "switch"}
+_NUMPY_ROOTS = {"np", "numpy", "onp"}
+_JAX_VALUE_ROOTS = {"jnp", "jax", "lax"}
+
+
+@dataclasses.dataclass
+class JitRoot:
+    fn: common.FunctionInfo
+    static_names: Set[str]
+    line: int
+
+
+@dataclasses.dataclass
+class JaxDisciplineResult:
+    roots: List[JitRoot]
+    traced: Set[str]  # qualnames
+    findings: List[common.Finding]
+
+
+def _param_names(fn_node: ast.AST) -> List[str]:
+    args = fn_node.args
+    return [a.arg for a in list(args.posonlyargs) + list(args.args)]
+
+
+def _static_names_from_call(call: ast.Call, fn_node: Optional[ast.AST]) -> Set[str]:
+    """static_argnames/static_argnums keywords -> parameter-name set."""
+    names: Set[str] = set()
+    params = _param_names(fn_node) if fn_node is not None else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for elt in _iter_const(kw.value):
+                if isinstance(elt, str):
+                    names.add(elt)
+        elif kw.arg == "static_argnums":
+            for elt in _iter_const(kw.value):
+                if isinstance(elt, int) and 0 <= elt < len(params):
+                    names.add(params[elt])
+    return names
+
+
+def _iter_const(node: ast.AST):
+    if isinstance(node, ast.Constant):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant):
+                yield elt.value
+
+
+def _jit_call_of(node: ast.AST) -> Optional[ast.Call]:
+    """The jit/partial(jit, ...) Call if ``node`` is a jit decorator/expr."""
+    if isinstance(node, ast.Call):
+        tail = common._tail_name(node.func)
+        if tail == "jit":
+            return node
+        if tail == "partial" and node.args:
+            if common._tail_name(node.args[0]) == "jit":
+                return node
+    elif common._tail_name(node) == "jit":
+        # Bare `@jax.jit` / `@jit` decorator with no arguments.
+        return ast.Call(func=node, args=[], keywords=[])
+    return None
+
+
+class JaxDisciplineAnalyzer:
+    def __init__(self, project: common.Project):
+        self.project = project
+        self.roots: Dict[str, JitRoot] = {}
+        self.findings: List[common.Finding] = []
+
+    # -- root discovery -----------------------------------------------------
+
+    def _discover_roots(self) -> None:
+        for qualname, fn in self.project.functions.items():
+            node = fn.node
+            for dec in getattr(node, "decorator_list", []):
+                jit_call = _jit_call_of(dec)
+                if jit_call is not None:
+                    self.roots[qualname] = JitRoot(
+                        fn=fn,
+                        static_names=_static_names_from_call(jit_call, node),
+                        line=node.lineno,
+                    )
+        # Call-site roots and lax body functions.
+        for qualname, fn in self.project.functions.items():
+            local_types = self.project.local_types(fn)
+            for call in ast.walk(fn.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                tail = common._tail_name(call.func)
+                if tail in _TRACE_ENTRY_TAILS and call.args:
+                    self._add_callable_root(call.args[0], call, fn, local_types)
+                elif tail in _LAX_BODY_FUNCS:
+                    for arg in call.args:
+                        self._add_callable_root(arg, None, fn, local_types)
+
+    def _add_callable_root(
+        self,
+        target: ast.AST,
+        jit_call: Optional[ast.Call],
+        fn: common.FunctionInfo,
+        local_types: Dict[str, str],
+    ) -> None:
+        if isinstance(target, ast.Lambda):
+            # Trace the lambda body's resolvable callees directly.
+            for sub in ast.walk(target.body):
+                if isinstance(sub, ast.Call):
+                    for callee in self.project.resolve_call(sub, fn, local_types):
+                        self.roots.setdefault(
+                            callee.qualname,
+                            JitRoot(fn=callee, static_names=set(), line=target.lineno),
+                        )
+            return
+        if isinstance(target, ast.Name):
+            info = self.project.module_functions.get(fn.path, {}).get(target.id)
+            if info is not None:
+                statics = (
+                    _static_names_from_call(jit_call, info.node)
+                    if jit_call is not None
+                    else set()
+                )
+                root = self.roots.setdefault(
+                    info.qualname, JitRoot(fn=info, static_names=set(), line=target.lineno)
+                )
+                root.static_names |= statics
+
+    # -- reachability --------------------------------------------------------
+
+    def _traced_closure(self) -> Set[str]:
+        traced: Set[str] = set(self.roots)
+        queue = list(self.roots)
+        while queue:
+            qualname = queue.pop()
+            fn = self.project.functions.get(qualname)
+            if fn is None:
+                continue
+            local_types = self.project.local_types(fn)
+            for call in ast.walk(fn.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                for callee in self.project.resolve_call(call, fn, local_types):
+                    if callee.qualname not in traced:
+                        traced.add(callee.qualname)
+                        queue.append(callee.qualname)
+        return traced
+
+    # -- checks inside traced functions --------------------------------------
+
+    def _tainted_locals(self, fn: common.FunctionInfo) -> Set[str]:
+        """Names assigned from jnp/jax computations in ``fn``'s body."""
+        tainted: Set[str] = set()
+        for _ in range(2):
+            for node in ast.walk(fn.node):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                    continue
+                tgt = node.targets[0]
+                names: List[str] = []
+                if isinstance(tgt, ast.Name):
+                    names = [tgt.id]
+                elif isinstance(tgt, ast.Tuple):
+                    names = [e.id for e in tgt.elts if isinstance(e, ast.Name)]
+                if not names:
+                    continue
+                if self._is_jax_valued(node.value, tainted):
+                    tainted.update(names)
+        return tainted
+
+    def _is_jax_valued(self, node: ast.AST, tainted: Set[str]) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                dotted_name = common.dotted(sub.func)
+                if dotted_name and dotted_name.split(".", 1)[0] in _JAX_VALUE_ROOTS:
+                    return True
+            elif isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+        return False
+
+    def _check_function(self, qualname: str) -> None:
+        fn = self.project.functions.get(qualname)
+        if fn is None:
+            return
+        root = self.roots.get(qualname)
+        nonstatic_params: Set[str] = set()
+        if root is not None:
+            nonstatic_params = set(_param_names(fn.node)) - root.static_names
+            nonstatic_params.discard("self")
+        tainted = self._tainted_locals(fn)
+        fn_label = qualname.split("::", 1)[1]
+
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                self._check_host_sync(node, fn, fn_label)
+            elif isinstance(node, (ast.If, ast.While)):
+                bad = self._tracer_names_in_test(
+                    node.test, nonstatic_params, tainted
+                )
+                if bad:
+                    self.findings.append(
+                        common.Finding(
+                            pass_name=PASS_NAME,
+                            rule="tracer-branch",
+                            key=f"tracer-branch@{fn.path}::{fn_label}:{sorted(bad)[0]}",
+                            message=(
+                                f"Python branch on traced value(s) "
+                                f"{sorted(bad)} inside jitted {fn_label}; "
+                                "use lax.cond/jnp.where"
+                            ),
+                            path=fn.path,
+                            line=node.lineno,
+                        )
+                    )
+
+    def _check_host_sync(
+        self, call: ast.Call, fn: common.FunctionInfo, fn_label: str
+    ) -> None:
+        func = call.func
+        attr = func.attr if isinstance(func, ast.Attribute) else None
+        dotted_name = common.dotted(func)
+        sync: Optional[str] = None
+        if attr == "block_until_ready":
+            sync = "block_until_ready"
+        elif attr == "item" and not call.args:
+            sync = ".item()"
+        elif dotted_name in ("jax.device_get",):
+            sync = "jax.device_get"
+        elif (
+            dotted_name
+            and dotted_name.split(".", 1)[0] in _NUMPY_ROOTS
+            and dotted_name.split(".")[-1] in ("asarray", "array")
+        ):
+            sync = dotted_name
+        elif (
+            isinstance(func, ast.Name)
+            and func.id in ("float", "int")
+            and call.args
+            and not isinstance(call.args[0], ast.Constant)
+            and not self._static_value(call.args[0])
+            and self._has_bare_name_load(call.args[0])
+        ):
+            sync = f"{func.id}()"
+        if sync is not None:
+            self.findings.append(
+                common.Finding(
+                    pass_name=PASS_NAME,
+                    rule="host-sync-in-jit",
+                    key=f"host-sync@{fn.path}::{fn_label}:{sync}",
+                    message=(
+                        f"host sync {sync} inside jit-traced {fn_label} "
+                        "(forces a device flush / retrace hazard)"
+                    ),
+                    path=fn.path,
+                    line=call.lineno,
+                )
+            )
+
+    @staticmethod
+    def _has_bare_name_load(node: ast.AST) -> bool:
+        """True when the expression reads any plain variable.
+
+        ``float(np.log(1e-2))`` is a host *constant* — every Name in it is
+        the root of a module-attribute chain (``np``), not a value — while
+        ``float(x)`` coerces a runtime value and would sync a tracer.
+        """
+        # Names that are roots of attribute chains (np.log, math.pi) are
+        # module references, not runtime values.
+        roots = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and isinstance(sub.value, ast.Name):
+                roots.add(id(sub.value))
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and id(sub) not in roots
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _static_value(node: ast.AST) -> bool:
+        """Expressions whose value is static under tracing (shape-derived)."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype",
+            ):
+                return True
+            if isinstance(sub, ast.Call) and common._tail_name(sub.func) == "len":
+                return True
+        return False
+
+    def _tracer_names_in_test(
+        self, test: ast.AST, nonstatic_params: Set[str], tainted: Set[str]
+    ) -> Set[str]:
+        # Static/exempt shapes: `x is None`, isinstance, shape/ndim/dtype
+        # comparisons, len() — all concrete at trace time.
+        if isinstance(test, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            return set()
+        if self._static_value(test):
+            return set()
+        bad: Set[str] = set()
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                tail = common._tail_name(sub.func)
+                if tail in ("isinstance", "len", "hasattr", "getattr"):
+                    return set()
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                if sub.id in nonstatic_params or sub.id in tainted:
+                    bad.add(sub.id)
+        return bad
+
+    # -- call-site checks -----------------------------------------------------
+
+    def _check_call_sites(self) -> None:
+        root_by_name: Dict[Tuple[str, str], JitRoot] = {}
+        for root in self.roots.values():
+            root_by_name[(root.fn.path, root.fn.name)] = root
+        for qualname, fn in self.project.functions.items():
+            loop_depth_nodes = self._loop_nodes(fn.node)
+            for call in ast.walk(fn.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                tail = common._tail_name(call.func)
+                # jax.jit(...) constructed inside a loop: a fresh callable
+                # (and compile cache) per iteration.
+                if tail == "jit" and call in loop_depth_nodes:
+                    fn_label = qualname.split("::", 1)[1]
+                    self.findings.append(
+                        common.Finding(
+                            pass_name=PASS_NAME,
+                            rule="jit-in-loop",
+                            key=f"jit-in-loop@{fn.path}::{fn_label}",
+                            message=(
+                                f"jax.jit(...) constructed inside a loop in "
+                                f"{fn_label}: hoist it, or every iteration "
+                                "retraces"
+                            ),
+                            path=fn.path,
+                            line=call.lineno,
+                        )
+                    )
+                # Static args at direct calls of known roots. Values derived
+                # from the CALLER's own jit-statics are stable and exempt
+                # (e.g. len(mesh.devices.flat) where mesh is the caller's
+                # static param).
+                root = None
+                if isinstance(call.func, ast.Name):
+                    root = root_by_name.get((fn.path, call.func.id))
+                if root is None or not root.static_names:
+                    continue
+                caller_root = self.roots.get(qualname)
+                caller_statics = (
+                    caller_root.static_names if caller_root else set()
+                )
+                params = _param_names(root.fn.node)
+                fn_label = qualname.split("::", 1)[1]
+                for i, arg in enumerate(call.args):
+                    if i >= len(params) or params[i] not in root.static_names:
+                        continue
+                    self._check_static_arg(
+                        arg, params[i], root, fn, fn_label, caller_statics
+                    )
+                for kw in call.keywords:
+                    if kw.arg in root.static_names:
+                        self._check_static_arg(
+                            kw.value, kw.arg, root, fn, fn_label, caller_statics
+                        )
+
+    def _check_static_arg(
+        self,
+        arg: ast.AST,
+        param: str,
+        root: JitRoot,
+        fn: common.FunctionInfo,
+        fn_label: str,
+        caller_statics: Set[str] = frozenset(),
+    ) -> None:
+        if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+            self.findings.append(
+                common.Finding(
+                    pass_name=PASS_NAME,
+                    rule="unhashable-static",
+                    key=(
+                        f"unhashable-static@{fn.path}::{fn_label}:"
+                        f"{root.fn.name}.{param}"
+                    ),
+                    message=(
+                        f"unhashable literal passed as jit-static "
+                        f"{param!r} of {root.fn.name} (TypeError at trace "
+                        "time; use a tuple)"
+                    ),
+                    path=fn.path,
+                    line=arg.lineno,
+                )
+            )
+        elif (
+            isinstance(arg, ast.Call)
+            and common._tail_name(arg.func) == "len"
+            and not self._rooted_in(arg, caller_statics)
+        ):
+            self.findings.append(
+                common.Finding(
+                    pass_name=PASS_NAME,
+                    rule="shape-unstable-static",
+                    key=(
+                        f"shape-unstable-static@{fn.path}::{fn_label}:"
+                        f"{root.fn.name}.{param}"
+                    ),
+                    message=(
+                        f"len(...) passed directly as jit-static {param!r} "
+                        f"of {root.fn.name}: recompiles per size — route "
+                        "through the padding-bucket grid"
+                    ),
+                    path=fn.path,
+                    line=arg.lineno,
+                )
+            )
+
+    @staticmethod
+    def _rooted_in(node: ast.AST, names: Set[str]) -> bool:
+        """Whether every Name the expression reads is one of ``names``."""
+        if not names:
+            return False
+        loads = [
+            sub.id
+            for sub in ast.walk(node)
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+        ]
+        return bool(loads) and all(name in names or name == "len" for name in loads)
+
+    @staticmethod
+    def _loop_nodes(fn_node: ast.AST) -> Set[ast.AST]:
+        """All Call nodes lexically inside a for/while body."""
+        out: Set[ast.AST] = set()
+        for node in ast.walk(fn_node):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        out.add(sub)
+        return out
+
+    def run(self) -> JaxDisciplineResult:
+        self._discover_roots()
+        traced = self._traced_closure()
+        for qualname in sorted(traced):
+            self._check_function(qualname)
+        self._check_call_sites()
+        seen: Set[str] = set()
+        unique: List[common.Finding] = []
+        for f in sorted(self.findings, key=lambda f: (f.path, f.line, f.key)):
+            if f.key not in seen:
+                seen.add(f.key)
+                unique.append(f)
+        return JaxDisciplineResult(
+            roots=sorted(self.roots.values(), key=lambda r: r.fn.qualname),
+            traced=traced,
+            findings=unique,
+        )
+
+
+def run(project: common.Project) -> JaxDisciplineResult:
+    return JaxDisciplineAnalyzer(project).run()

@@ -1,0 +1,190 @@
+"""The single source of truth for every ``VIZIER_*`` switch.
+
+Every environment variable the tree reads (and every reserved ``VIZIER_*``
+constant that is *not* an environment variable) is declared here with its
+owner and documentation link. The ``env_registry`` analysis pass fails any
+``os.environ`` read — direct or through the helpers below — of a name that
+is missing from this table, and any declared switch whose doc file does
+not mention it.
+
+Runtime code reads switches through :func:`env_on` / :func:`env_int` /
+:func:`env_float` / :func:`env_str`, which raise on undeclared names — so
+a typo'd switch fails loudly at import time instead of silently reading an
+always-unset variable.
+
+Stdlib-only on purpose: config modules all over the tree import this, and
+the analysis pass must be runnable without jax installed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional, Tuple
+
+# Declaration kinds:
+#   "flag"     — boolean-ish on/off switch ("0"/"false"/"" = off);
+#   "int"      — integer-valued;
+#   "float"    — float-valued;
+#   "str"      — free-form string (paths, names);
+#   "constant" — a reserved VIZIER_* Python constant that is NOT an
+#                environment variable (reading it from os.environ is a
+#                violation; declaring it here keeps the literal scan and
+#                naive greps honest about what is and is not a switch).
+_KINDS = ("flag", "int", "float", "str", "constant")
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvSwitch:
+    """One declared ``VIZIER_*`` name."""
+
+    name: str
+    kind: str
+    owner: str  # owning config class or module
+    doc: str  # repo-relative doc path that describes the switch
+    description: str
+    # Default *as read* ("1" = on unless explicitly disabled). Only
+    # meaningful for env kinds; constants have no runtime default.
+    default: str = ""
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"Unknown switch kind {self.kind!r} for {self.name}.")
+        if not self.name.startswith("VIZIER_"):
+            raise ValueError(f"Switch {self.name!r} must start with VIZIER_.")
+
+
+def _switch(name, kind, owner, doc, description, default=""):
+    return EnvSwitch(name, kind, owner, doc, description, default)
+
+
+_OBS_DOC = "docs/guides/observability.md"
+_REL_DOC = "docs/guides/reliability.md"
+_SRV_DOC = "docs/guides/serving.md"
+_PERF_DOC = "docs/guides/performance.md"
+_SWITCH_DOC = "docs/guides/switching_from_oss_vizier.md"
+
+SWITCHES: Tuple[EnvSwitch, ...] = (
+    # -- observability (ObservabilityConfig) -------------------------------
+    _switch("VIZIER_OBSERVABILITY", "flag", "ObservabilityConfig", _OBS_DOC,
+            "Master switch for tracing/metrics/JAX profiling.", "1"),
+    _switch("VIZIER_OBSERVABILITY_TRACING", "flag", "ObservabilityConfig",
+            _OBS_DOC, "Span tracing on/off (counters stay).", "1"),
+    _switch("VIZIER_OBSERVABILITY_METRICS", "flag", "ObservabilityConfig",
+            _OBS_DOC, "Latency histograms on/off.", "1"),
+    _switch("VIZIER_OBSERVABILITY_JAX", "flag", "ObservabilityConfig",
+            _OBS_DOC, "Designer device-phase timers (forces syncs).", "1"),
+    _switch("VIZIER_OBSERVABILITY_SPAN_BUFFER", "int", "ObservabilityConfig",
+            _OBS_DOC, "Finished-span ring-buffer size.", "4096"),
+    _switch("VIZIER_OBSERVABILITY_SPAN_LOG", "str", "ObservabilityConfig",
+            _OBS_DOC, "JSON-lines span sink path ('' = ring only)."),
+    # -- reliability (ReliabilityConfig) -----------------------------------
+    _switch("VIZIER_RELIABILITY", "flag", "ReliabilityConfig", _REL_DOC,
+            "Master switch for retries/deadlines/breaker/fallback.", "1"),
+    _switch("VIZIER_RELIABILITY_RETRIES", "flag", "ReliabilityConfig",
+            _REL_DOC, "Retry transient RPC/op failures.", "1"),
+    _switch("VIZIER_RELIABILITY_DEADLINE", "flag", "ReliabilityConfig",
+            _REL_DOC, "Deadline attachment and propagation.", "1"),
+    _switch("VIZIER_RELIABILITY_BREAKER", "flag", "ReliabilityConfig",
+            _REL_DOC, "Per-study circuit breaker.", "1"),
+    _switch("VIZIER_RELIABILITY_FALLBACK", "flag", "ReliabilityConfig",
+            _REL_DOC, "Quasi-random fallback on designer failure.", "1"),
+    # -- serving (ServingConfig) -------------------------------------------
+    _switch("VIZIER_SERVING_CACHE", "flag", "ServingConfig", _SRV_DOC,
+            "Per-study designer-state cache.", "1"),
+    _switch("VIZIER_SERVING_WARM_START", "flag", "ServingConfig", _SRV_DOC,
+            "Warm-started ARD training.", "1"),
+    _switch("VIZIER_SERVING_COALESCING", "flag", "ServingConfig", _SRV_DOC,
+            "Compute-level request coalescing.", "1"),
+    _switch("VIZIER_BATCHING", "flag", "ServingConfig", _PERF_DOC,
+            "Cross-study batch executor.", "1"),
+    _switch("VIZIER_BATCH_MAX_SIZE", "int", "ServingConfig", _PERF_DOC,
+            "Micro-batch flush size.", "8"),
+    _switch("VIZIER_BATCH_MAX_WAIT_MS", "float", "ServingConfig", _PERF_DOC,
+            "Micro-batch flush window (ms).", "4.0"),
+    _switch("VIZIER_BATCHING_PREWARM", "flag", "ServingConfig", _PERF_DOC,
+            "Background AOT compile of batched programs.", "0"),
+    _switch("VIZIER_COMPILE_CACHE_DIR", "str", "ServingConfig", _PERF_DOC,
+            "JAX persistent compilation cache directory."),
+    # -- designers ---------------------------------------------------------
+    _switch("VIZIER_DISABLE_MESH", "flag", "GPBanditDesigner", _SWITCH_DOC,
+            "Opt out of the multi-device auto-mesh (set = disabled).", "0"),
+    # -- bench.py (repo-root benchmark harness) ----------------------------
+    _switch("VIZIER_BENCH_SCALE", "float", "bench.py", _PERF_DOC,
+            "Global workload scale factor for bench.py.", "1.0"),
+    _switch("VIZIER_BENCH_WATCHDOG_S", "float", "bench.py", _PERF_DOC,
+            "bench.py watchdog timeout in seconds."),
+    _switch("VIZIER_PEAK_FLOPS", "float", "bench.py", _PERF_DOC,
+            "Hardware peak FLOP/s override for MFU accounting."),
+    # -- reserved constants (NOT environment variables) --------------------
+    _switch("VIZIER_METHODS", "constant", "service.grpc_stubs",
+            "docs/guides/running_the_service.md",
+            "gRPC method table constant in grpc_stubs; never an env var."),
+    _switch("VIZIER_SERVICE_NAME", "constant", "service.grpc_stubs",
+            "docs/guides/running_the_service.md",
+            "gRPC service name constant in grpc_stubs; never an env var."),
+)
+
+BY_NAME: Dict[str, EnvSwitch] = {s.name: s for s in SWITCHES}
+if len(BY_NAME) != len(SWITCHES):  # pragma: no cover - declaration bug
+    raise RuntimeError("Duplicate VIZIER_* switch declaration.")
+
+
+def declared(name: str) -> bool:
+    return name in BY_NAME
+
+
+def env_switch_names() -> Tuple[str, ...]:
+    """Declared names that are real environment switches (not constants)."""
+    return tuple(s.name for s in SWITCHES if s.kind != "constant")
+
+
+def _require(name: str) -> EnvSwitch:
+    switch = BY_NAME.get(name)
+    if switch is None:
+        raise KeyError(
+            f"Undeclared environment switch {name!r}: declare it in "
+            "vizier_tpu/analysis/registry.py (and document it) first."
+        )
+    if switch.kind == "constant":
+        raise KeyError(
+            f"{name!r} is a reserved constant, not an environment switch."
+        )
+    return switch
+
+
+def env_on(name: str, default: Optional[str] = None) -> bool:
+    """Boolean switch read: unset -> declared default; "0"/"false"/"" = off."""
+    switch = _require(name)
+    base = switch.default if default is None else default
+    return os.environ.get(name, base) not in ("0", "false", "False", "")
+
+
+def env_set(name: str) -> bool:
+    """True when the switch is set to a truthy value (unset -> False).
+
+    The read shape for opt-*out* flags like ``VIZIER_DISABLE_MESH`` whose
+    absence means "feature on".
+    """
+    return env_on(name, default="0")
+
+
+def env_int(name: str, default: int) -> int:
+    _require(name)
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    _require(name)
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_str(name: str, default: str = "") -> str:
+    _require(name)
+    return os.environ.get(name, default)

@@ -71,16 +71,21 @@ def _train_gp(
 ) -> gp_lib.GPState:
     """ARD: restarts → L-BFGS (vmapped) → top-k precomputed posteriors.
 
-    ``warm_start`` (previous suggest's best unconstrained params) replaces
-    the first random restart — steady-state hyperparameters move little
-    between suggests, so one restart usually lands at the optimum
-    immediately and the rest guard against mode switches.
+    ``warm_start`` (previous suggest's best unconstrained params) is
+    prepended as an EXTRA restart row — steady-state hyperparameters move
+    little between suggests, so that row usually lands at the optimum
+    immediately, while the random restarts keep their full exploration
+    budget. (It used to *replace* restart 0; losing one random init
+    measurably regressed small-budget mixed-space convergence — see
+    PARITY.md "Warm-start ARD seeding".)
     """
     coll = model.param_collection()
     inits = coll.batch_random_init_unconstrained(rng, num_restarts)
     if warm_start is not None:
         inits = jax.tree_util.tree_map(
-            lambda batch, warm: batch.at[0].set(warm), inits, warm_start
+            lambda batch, warm: jnp.concatenate([warm[None], batch], axis=0),
+            inits,
+            warm_start,
         )
     loss_fn = lambda p: model.neg_log_likelihood(p, data)
     result = optimizer(loss_fn, inits, best_n=ensemble_size)
@@ -291,9 +296,17 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     # Injectable ARD optimizer (tests swap in a cheaper one; must be hashable).
     ard_optimizer: Optional[lbfgs_lib.Optimizer] = None
     # Carry the previous suggest's trained params into the next train as
-    # restart seed 0. False restores the reference's per-request cold train
-    # (restart 0 stays a fixed random init, trained params are discarded).
+    # an extra restart seed. False restores the reference's per-request
+    # cold train (trained params are discarded between suggests).
     use_warm_start_ard: bool = True
+    # Completed trials required before warm seeding ENGAGES. Early in a
+    # study the NLL landscape is nearly flat, and a previously trained seed
+    # keeps winning the restart selection — a self-reinforcing mode lock-in
+    # that measurably regressed 40-trial mixed-space convergence (see
+    # PARITY.md "Warm-start ARD seeding"). Below the floor every train is
+    # cold (full random restarts); steady-state serving, where the warm
+    # latency win lives, sits far above it.
+    warm_start_min_trials: int = 20
     # Restart budget for a WARM train (one with trained seed params). None
     # keeps the full ``ard_restarts`` budget; the serving runtime sets 1 so
     # steady-state suggests pay one early-exiting L-BFGS run instead of
@@ -353,10 +366,12 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         else:
             # VIZIER_DISABLE_MESH opts out of the auto-mesh (the CPU test
             # suite sets it: 8 *virtual* host devices share the same cores,
-            # so pool-sharding only multiplies work there).
-            import os
+            # so pool-sharding only multiplies work there). Read through
+            # the central switch registry; env_set also fixes the old raw
+            # read treating "0" as set-and-therefore-disabled.
+            from vizier_tpu.analysis import registry as _registry
 
-            want_mesh = len(jax.devices()) > 1 and not os.environ.get(
+            want_mesh = len(jax.devices()) > 1 and not _registry.env_set(
                 "VIZIER_DISABLE_MESH"
             )
         if want_mesh:
@@ -417,6 +432,13 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         return parallel.train_gp_sharded(
             self._model, self._ard, data, rng,
             restarts, ensemble_size, self._mesh, warm_start,
+        )
+
+    def _warm_update_allowed(self) -> bool:
+        """Whether this train's optimum may seed the next one (floor met)."""
+        return (
+            self.use_warm_start_ard
+            and len(self._trials) >= self.warm_start_min_trials
         )
 
     def _warm_restart_budget(self) -> Optional[int]:
@@ -556,7 +578,7 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         same state transitions the sequential suggest performs."""
         states = output["states"]
         self._record_train()
-        if self.use_warm_start_ard:
+        if self._warm_update_allowed():
             # The unconstrain already ran (vmapped) inside the flush program.
             self._warm_params = output["warm_next"]
             self._warm_is_trained = True
@@ -670,7 +692,7 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
                 )
                 phase.block(states)
         self._record_train()
-        if self.use_warm_start_ard:
+        if self._warm_update_allowed():
             # Warm-start the next suggest from this one's best member
             # (states.params are constrained; map back through the bijectors).
             coll = self._model.param_collection()

@@ -893,7 +893,7 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             for j, data in enumerate(datas)
         ]
         self._record_train()
-        if self.use_warm_start_ard:
+        if self._warm_update_allowed():
             coll = self._model.param_collection()
             self._warm_params_me = [
                 coll.unconstrain(
@@ -1074,7 +1074,7 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         and per-segment decode — the sequential suggest's state transitions."""
         states = output["states"]  # [E] leaves (this study's ensemble)
         self._record_train()
-        if self.use_warm_start_ard:
+        if self._warm_update_allowed():
             # The unconstrain already ran (vmapped) inside the flush program.
             self._warm_params_me = [output["warm_next"]]
             self._warm_is_trained = True

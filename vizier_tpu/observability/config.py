@@ -20,12 +20,12 @@ mechanism has its own off-switch for A/B isolation:
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, Dict
 
-
-def _env_on(name: str) -> bool:
-    return os.environ.get(name, "1") not in ("0", "false", "False", "")
+# All VIZIER_* switches are declared in (and read through) the central
+# registry (vizier_tpu.analysis.registry); enforced by the env_registry
+# analysis pass.
+from vizier_tpu.analysis import registry as _registry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,14 +62,14 @@ class ObservabilityConfig:
     def from_env(cls) -> "ObservabilityConfig":
         """The default config with per-knob environment overrides applied."""
         return cls(
-            enabled=_env_on("VIZIER_OBSERVABILITY"),
-            tracing=_env_on("VIZIER_OBSERVABILITY_TRACING"),
-            metrics=_env_on("VIZIER_OBSERVABILITY_METRICS"),
-            jax_profiling=_env_on("VIZIER_OBSERVABILITY_JAX"),
-            span_buffer_size=int(
-                os.environ.get("VIZIER_OBSERVABILITY_SPAN_BUFFER", "4096")
+            enabled=_registry.env_on("VIZIER_OBSERVABILITY"),
+            tracing=_registry.env_on("VIZIER_OBSERVABILITY_TRACING"),
+            metrics=_registry.env_on("VIZIER_OBSERVABILITY_METRICS"),
+            jax_profiling=_registry.env_on("VIZIER_OBSERVABILITY_JAX"),
+            span_buffer_size=_registry.env_int(
+                "VIZIER_OBSERVABILITY_SPAN_BUFFER", 4096
             ),
-            span_log_path=os.environ.get("VIZIER_OBSERVABILITY_SPAN_LOG", ""),
+            span_log_path=_registry.env_str("VIZIER_OBSERVABILITY_SPAN_LOG"),
         )
 
     @classmethod

@@ -56,6 +56,25 @@ def create_mesh(
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def _distributed_initialized() -> bool:
+    """Whether the jax distributed runtime is already up.
+
+    ``jax.distributed.is_initialized`` only exists on newer jax; on
+    releases without it (0.4.37 ships only initialize/shutdown) the
+    coordination client on the private global state carries the same bit.
+    Neither path touches devices, so the backend stays uninitialized.
+    """
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src import distributed as _distributed_src
+
+        return _distributed_src.global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -78,9 +97,9 @@ def initialize_multihost(
     MUST run before any JAX call that initializes the XLA backend
     (including ``jax.devices()``): ``jax.distributed.initialize`` refuses
     to run afterwards. Initialization state is checked via
-    ``jax.distributed.is_initialized`` — never by touching devices.
+    ``_distributed_initialized`` — never by touching devices.
     """
-    if not jax.distributed.is_initialized():
+    if not _distributed_initialized():
         if coordinator_address is not None:
             # Explicit cluster spec: failures must propagate — a silently
             # absent cluster would shard per-host and corrupt results.
@@ -130,7 +149,10 @@ def train_gp_sharded(
     ``num_restarts`` should be a multiple of the mesh size. Data is
     replicated (it is small); each device runs its restarts locally; the
     final top-k selection is the only cross-device reduction. ``warm_start``
-    replaces the first restart (same contract as ``gp_bandit._train_gp``).
+    replaces the first restart here — unlike ``gp_bandit._train_gp``, which
+    prepends it as an extra row — because appending would break the
+    restarts-divisible-by-mesh sharding; at mesh-scale restart budgets the
+    one lost random init is immaterial.
     """
     coll = model.param_collection()
     inits = coll.batch_random_init_unconstrained(rng, num_restarts)

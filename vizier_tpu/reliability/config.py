@@ -17,11 +17,11 @@ A/B isolation:
 from __future__ import annotations
 
 import dataclasses
-import os
 
-
-def _env_on(name: str) -> bool:
-    return os.environ.get(name, "1") not in ("0", "false", "False", "")
+# All VIZIER_* switches are declared in (and read through) the central
+# registry (vizier_tpu.analysis.registry); enforced by the env_registry
+# analysis pass.
+from vizier_tpu.analysis import registry as _registry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,11 +76,11 @@ class ReliabilityConfig:
     def from_env(cls) -> "ReliabilityConfig":
         """The default config with per-knob environment overrides applied."""
         return cls(
-            enabled=_env_on("VIZIER_RELIABILITY"),
-            retries=_env_on("VIZIER_RELIABILITY_RETRIES"),
-            deadlines=_env_on("VIZIER_RELIABILITY_DEADLINE"),
-            breaker=_env_on("VIZIER_RELIABILITY_BREAKER"),
-            fallback=_env_on("VIZIER_RELIABILITY_FALLBACK"),
+            enabled=_registry.env_on("VIZIER_RELIABILITY"),
+            retries=_registry.env_on("VIZIER_RELIABILITY_RETRIES"),
+            deadlines=_registry.env_on("VIZIER_RELIABILITY_DEADLINE"),
+            breaker=_registry.env_on("VIZIER_RELIABILITY_BREAKER"),
+            fallback=_registry.env_on("VIZIER_RELIABILITY_FALLBACK"),
         )
 
     @classmethod

@@ -185,18 +185,25 @@ _CHANNELS: Dict[str, _ChannelEntry] = {}
 
 
 def _shared_channel(endpoint: str, timeout: float) -> grpc.Channel:
+    # Lock order: _CHANNEL_LOCK is a LEAF lock — only dict bookkeeping runs
+    # under it. channel.close() re-enters grpc-core (connectivity watchers,
+    # completion queues) and is deferred to after release; enforced by the
+    # lock_order static-analysis pass.
+    stale = None
     with _CHANNEL_LOCK:
         entry = _CHANNELS.get(endpoint)
         if entry is not None and entry.broken and entry.ready.is_set():
-            # Stale cache hit: evict, close, fall through to a fresh
-            # connect (which re-runs the full ready-wait).
+            # Stale cache hit: evict, close (outside the lock), fall
+            # through to a fresh connect (which re-runs the ready-wait).
             del _CHANNELS[endpoint]
-            entry.channel.close()
+            stale = entry
             entry = None
         fresh = entry is None
         if fresh:
             entry = _ChannelEntry(grpc.insecure_channel(endpoint))
             _CHANNELS[endpoint] = entry
+    if stale is not None:
+        stale.channel.close()
     if fresh:
         try:
             grpc.channel_ready_future(entry.channel).result(timeout=timeout)

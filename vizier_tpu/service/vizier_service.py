@@ -596,24 +596,36 @@ class VizierServicer:
                 )
             if self._pythia is None:
                 raise RuntimeError("No Pythia endpoint connected.")
-            from vizier_tpu.service.protos import pythia_service_pb2
+            max_trial_id = self.datastore.max_trial_id(study_name)
 
-            algorithm = study.study_spec.algorithm
-            preq = pythia_service_pb2.PythiaEarlyStopRequest(
-                trial_ids=[tr.trial_id],
-                algorithm=algorithm,
-                study_name=study_name,
-            )
-            preq.study_descriptor.config.CopyFrom(study.study_spec)
-            preq.study_descriptor.guid = study_name
-            preq.study_descriptor.max_trial_id = self.datastore.max_trial_id(study_name)
-            presp = self._pythia.EarlyStop(preq)
-            if presp.error:
-                raise RuntimeError(f"Pythia error: {presp.error}")
+        # The Pythia dispatch runs OUTSIDE the study lock, like the suggest
+        # path: the lock protects datastore read-modify-write windows, not
+        # the stopping-policy computation — holding it across a potentially
+        # slow policy (or remote RPC) would stall every suggest/complete for
+        # the study. A concurrent check racing this window sees the ACTIVE
+        # op above and returns its (not-yet-stopping) answer instead of
+        # blocking; it re-asks after the recycle period, the same contract
+        # as a crashed-mid-computation op. Enforced by the lock_order
+        # static-analysis pass ("no RPC under a study lock").
+        from vizier_tpu.service.protos import pythia_service_pb2
 
-            # Fan decisions out into per-trial ops (batch-aware policies may
-            # return decisions for other trials too).
-            should_stop = False
+        preq = pythia_service_pb2.PythiaEarlyStopRequest(
+            trial_ids=[tr.trial_id],
+            algorithm=study.study_spec.algorithm,
+            study_name=study_name,
+        )
+        preq.study_descriptor.config.CopyFrom(study.study_spec)
+        preq.study_descriptor.guid = study_name
+        preq.study_descriptor.max_trial_id = max_trial_id
+        presp = self._pythia.EarlyStop(preq)
+        if presp.error:
+            raise RuntimeError(f"Pythia error: {presp.error}")
+
+        # Fan decisions out into per-trial ops (batch-aware policies may
+        # return decisions for other trials too) — back under the lock for
+        # the datastore writes.
+        should_stop = False
+        with self._study_locks[study_name]:
             for decision in presp.decisions:
                 d_resource = resources.EarlyStoppingOperationResource(
                     tr.owner_id, tr.study_id, int(decision.id)
@@ -628,9 +640,9 @@ class VizierServicer:
                 self.datastore.create_early_stopping_operation(d_op)
                 if int(decision.id) == tr.trial_id:
                     should_stop = decision.should_stop
-            return vizier_service_pb2.CheckTrialEarlyStoppingStateResponse(
-                should_stop=should_stop
-            )
+        return vizier_service_pb2.CheckTrialEarlyStoppingStateResponse(
+            should_stop=should_stop
+        )
 
     # -- optimal trials ----------------------------------------------------
 

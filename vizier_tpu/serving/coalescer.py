@@ -120,11 +120,14 @@ class RequestCoalescer:
             self._observe("leader", t0)
             # Unregister BEFORE waking waiters: a new request arriving after
             # the computation finished must start fresh, not adopt a result
-            # computed against stale study state.
+            # computed against stale study state. The counter update runs
+            # outside the map lock (it takes the metrics lock; this mutex
+            # stays a leaf of the serving lock graph).
             with self._lock:
                 del self._inflight[key]
-                if entry.followers:
-                    self._stats.increment("coalesced_computations")
+                had_followers = bool(entry.followers)
+            if had_followers:
+                self._stats.increment("coalesced_computations")
             entry.done.set()
         return entry.result
 

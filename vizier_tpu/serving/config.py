@@ -23,26 +23,12 @@ cold-train-per-request behavior without code changes):
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Optional
 
-
-def _env_on(name: str, default: str = "1") -> bool:
-    return os.environ.get(name, default) not in ("0", "false", "False", "")
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+# All VIZIER_* switches are declared in (and read through) the central
+# registry; an undeclared name raises instead of silently reading an
+# always-unset variable. Enforced by the env_registry analysis pass.
+from vizier_tpu.analysis import registry as _registry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,15 +78,15 @@ class ServingConfig:
     def from_env(cls) -> "ServingConfig":
         """The default config with per-knob environment overrides applied."""
         return cls(
-            designer_cache=_env_on("VIZIER_SERVING_CACHE"),
-            warm_start=_env_on("VIZIER_SERVING_WARM_START"),
-            coalescing=_env_on("VIZIER_SERVING_COALESCING"),
-            batching=_env_on("VIZIER_BATCHING"),
-            batch_max_size=_env_int("VIZIER_BATCH_MAX_SIZE", 8),
-            batch_max_wait_ms=_env_float("VIZIER_BATCH_MAX_WAIT_MS", 4.0),
-            batching_prewarm=_env_on("VIZIER_BATCHING_PREWARM", default="0"),
+            designer_cache=_registry.env_on("VIZIER_SERVING_CACHE"),
+            warm_start=_registry.env_on("VIZIER_SERVING_WARM_START"),
+            coalescing=_registry.env_on("VIZIER_SERVING_COALESCING"),
+            batching=_registry.env_on("VIZIER_BATCHING"),
+            batch_max_size=_registry.env_int("VIZIER_BATCH_MAX_SIZE", 8),
+            batch_max_wait_ms=_registry.env_float("VIZIER_BATCH_MAX_WAIT_MS", 4.0),
+            batching_prewarm=_registry.env_on("VIZIER_BATCHING_PREWARM"),
             compilation_cache_dir=(
-                os.environ.get("VIZIER_COMPILE_CACHE_DIR") or None
+                _registry.env_str("VIZIER_COMPILE_CACHE_DIR") or None
             ),
         )
 

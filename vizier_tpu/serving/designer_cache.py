@@ -112,37 +112,53 @@ class DesignerStateCache:
         """
         t0 = time.perf_counter()
         now = self._time()
+        # Counter/histogram updates run OUTSIDE the map mutex throughout:
+        # they take the metrics registry's own locks, and nesting those
+        # under the cache mutex would serialize unrelated studies' lookups
+        # on metric bookkeeping (the lock_order pass keeps this mutex a
+        # leaf of the serving lock graph).
+        ttl_evicted = False
         with self._lock:
             entry = self._entries.get(study_name)
             if entry is not None and self._expired(entry, now):
                 del self._entries[study_name]
-                self._stats.increment("cache_evictions_ttl")
+                ttl_evicted = True
                 entry = None
             if entry is not None:
                 entry.last_used_at = now
                 self._entries.move_to_end(study_name)
-                self._stats.increment("cache_hits")
-                self._observe_lookup("hit", t0)
-                return entry
+        if ttl_evicted:
+            self._stats.increment("cache_evictions_ttl")
+        if entry is not None:
+            self._stats.increment("cache_hits")
+            self._observe_lookup("hit", t0)
+            return entry
         designer = designer_factory()
+        lru_evictions = 0
+        race_hit = False
         with self._lock:
             entry = self._entries.get(study_name)
             if entry is not None and not self._expired(entry, self._time()):
                 # Lost the miss race; serve the winner's entry as a hit.
                 entry.last_used_at = self._time()
                 self._entries.move_to_end(study_name)
-                self._stats.increment("cache_hits")
-                self._observe_lookup("hit", t0)
-                return entry
-            entry = CachedDesignerEntry(study_name, designer, self._time())
-            self._entries[study_name] = entry
-            self._entries.move_to_end(study_name)
-            self._stats.increment("cache_misses")
-            while len(self._entries) > self._max_entries:
-                self._entries.popitem(last=False)
-                self._stats.increment("cache_evictions_lru")
-            self._observe_lookup("miss", t0)
+                race_hit = True
+            else:
+                entry = CachedDesignerEntry(study_name, designer, self._time())
+                self._entries[study_name] = entry
+                self._entries.move_to_end(study_name)
+                while len(self._entries) > self._max_entries:
+                    self._entries.popitem(last=False)
+                    lru_evictions += 1
+        if race_hit:
+            self._stats.increment("cache_hits")
+            self._observe_lookup("hit", t0)
             return entry
+        self._stats.increment("cache_misses")
+        if lru_evictions:
+            self._stats.increment("cache_evictions_lru", lru_evictions)
+        self._observe_lookup("miss", t0)
+        return entry
 
     def _observe_lookup(self, result: str, t0: float) -> None:
         seconds = time.perf_counter() - t0
