@@ -1,0 +1,203 @@
+"""RoutedVizierStub: drop-in substitutability, affinity, failure notes."""
+
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.distributed import router_stub, routing
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import pythia_service, vizier_client, vizier_service
+from vizier_tpu.service.protos import vizier_service_pb2
+
+
+def study_config() -> vz.StudyConfig:
+    config = vz.StudyConfig(algorithm="RANDOM_SEARCH")
+    config.search_space.root.add_float_param("x", 0.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+def make_servicer():
+    servicer = vizier_service.VizierServicer()
+    servicer.set_pythia(pythia_service.PythiaServicer(servicer))
+    return servicer
+
+
+@pytest.fixture
+def tier():
+    servicers = {f"replica-{i}": make_servicer() for i in range(3)}
+    stub = router_stub.RoutedVizierStub(servicers)
+    return servicers, stub
+
+
+def create_study(stub, study_id: str) -> str:
+    name = f"owners/o/studies/{study_id}"
+    stub.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(
+            parent="owners/o", study=pc.study_to_proto(study_config(), name)
+        )
+    )
+    return name
+
+
+class TestDropIn:
+    def test_vizier_client_runs_unchanged_over_the_router(self, tier):
+        _, stub = tier
+        name = create_study(stub, "dropin")
+        client = vizier_client.VizierClient(stub, name, "w0")
+        for i in range(5):
+            (trial,) = client.get_suggestions(1)
+            client.complete_trial(
+                trial.id, vz.Measurement(metrics={"obj": float(i)})
+            )
+        trials = client.list_trials()
+        assert len(trials) == 5
+        assert all(t.status == vz.TrialStatus.COMPLETED for t in trials)
+        assert len(client.list_optimal_trials()) == 1
+        assert client.get_study_config().search_space.parameters[0].name == "x"
+
+    def test_study_affinity_all_state_on_one_replica(self, tier):
+        servicers, stub = tier
+        names = [create_study(stub, f"aff{i}") for i in range(6)]
+        client_trials = {}
+        for name in names:
+            client = vizier_client.VizierClient(stub, name, "w")
+            (trial,) = client.get_suggestions(1)
+            client_trials[name] = trial.id
+        for name in names:
+            owner_id = stub.router.replica_for(name)
+            owner = servicers[owner_id]
+            # The owning replica has the study AND its trials; nobody else
+            # has either.
+            assert owner.datastore.load_study(name).name == name
+            assert owner.datastore.max_trial_id(name) == 1
+            for rid, servicer in servicers.items():
+                if rid != owner_id:
+                    with pytest.raises(KeyError):
+                        servicer.datastore.load_study(name)
+
+    def test_list_studies_merges_across_replicas(self, tier):
+        servicers, stub = tier
+        names = {create_study(stub, f"merge{i}") for i in range(8)}
+        response = stub.ListStudies(
+            vizier_service_pb2.ListStudiesRequest(parent="owners/o")
+        )
+        assert {s.name for s in response.studies} == names
+        # The workload really is spread (not all on one replica).
+        owners = {stub.router.replica_for(n) for n in names}
+        assert len(owners) > 1
+
+    def test_operation_polling_routes_to_the_owner(self, tier):
+        _, stub = tier
+        name = create_study(stub, "ops")
+        op = stub.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent=name, suggestion_count=1, client_id="w"
+            )
+        )
+        polled = stub.GetOperation(
+            vizier_service_pb2.GetOperationRequest(name=op.name)
+        )
+        assert polled.name == op.name and polled.done
+
+    def test_routing_disabled_uses_first_replica_only(self):
+        servicers = {f"replica-{i}": make_servicer() for i in range(3)}
+        stub = router_stub.RoutedVizierStub(servicers, routing_enabled=False)
+        for i in range(5):
+            create_study(stub, f"pin{i}")
+        assert len(servicers["replica-0"].datastore.list_studies("owners/o")) == 5
+        assert not servicers["replica-1"].datastore.list_studies("owners/o")
+
+
+class _DeadEndpoint:
+    """Transport-dead replica: every RPC raises ConnectionError."""
+
+    def __getattr__(self, name):
+        def call(request):
+            raise ConnectionError("connection refused")
+
+        return call
+
+
+class TestFailureHandling:
+    def test_self_managed_mark_down_after_threshold(self):
+        live = make_servicer()
+        router = routing.StudyRouter(["replica-0", "replica-1"])
+        # Find a study owned by replica-1, then kill replica-1.
+        name = None
+        for i in range(50):
+            candidate = f"owners/o/studies/f{i}"
+            if router.replica_for(candidate) == "replica-1":
+                name = candidate
+                break
+        assert name is not None
+        stub = router_stub.RoutedVizierStub(
+            {"replica-0": live, "replica-1": _DeadEndpoint()},
+            router=router,
+            failure_threshold=2,
+        )
+        request = vizier_service_pb2.CreateStudyRequest(
+            parent="owners/o", study=pc.study_to_proto(study_config(), name)
+        )
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                stub.CreateStudy(request)
+        # Threshold reached: replica-1 is down, the retry lands on 0.
+        assert not stub.router.is_up("replica-1")
+        stub.CreateStudy(request)
+        assert live.datastore.load_study(name).name == name
+
+    def test_failure_hook_receives_the_error(self):
+        seen = []
+        stub = router_stub.RoutedVizierStub(
+            {"replica-0": _DeadEndpoint()},
+            on_failure=lambda rid, e: seen.append((rid, type(e).__name__)),
+        )
+        with pytest.raises(ConnectionError):
+            create_study(stub, "hooked")
+        assert seen == [("replica-0", "ConnectionError")]
+        # With a hook installed the stub does NOT mark down on its own.
+        assert stub.router.is_up("replica-0")
+
+    def test_success_resets_consecutive_failures(self):
+        flaky_state = {"fail": True}
+        inner = make_servicer()
+
+        class Flaky:
+            def __getattr__(self, name):
+                method = getattr(inner, name)
+
+                def call(request):
+                    if flaky_state["fail"]:
+                        flaky_state["fail"] = False
+                        raise ConnectionError("blip")
+                    return method(request)
+
+                return call
+
+        stub = router_stub.RoutedVizierStub(
+            {"replica-0": Flaky()}, failure_threshold=2
+        )
+        with pytest.raises(ConnectionError):
+            create_study(stub, "flaky")
+        create_study(stub, "flaky")  # succeeds, resets the counter
+        flaky_state["fail"] = True
+        with pytest.raises(ConnectionError):
+            create_study(stub, "flaky2")
+        # One failure after a success: still below threshold 2.
+        assert stub.router.is_up("replica-0")
+
+    def test_stats_and_metrics(self, tier):
+        _, stub = tier
+        name = create_study(stub, "metrics")
+        owner = stub.router.replica_for(name)
+        stats = stub.stats()
+        assert stats["replicas"][owner]["requests"] >= 1
+        assert stats["replicas"][owner]["state"] == "up"
+
+    def test_value_errors_do_not_implicate_the_replica(self, tier):
+        _, stub = tier
+        with pytest.raises(ValueError):
+            stub.GetStudy(vizier_service_pb2.GetStudyRequest(name="garbage"))
+        assert all(state == "up" for state in stub.router.snapshot().values())

@@ -1,0 +1,109 @@
+"""StudyRouter: rendezvous placement, liveness, minimal-disruption."""
+
+import pytest
+
+from vizier_tpu.distributed import routing
+
+KEYS = [f"owners/o/studies/s{i}" for i in range(200)]
+
+
+def make_router(n=4, **kwargs):
+    return routing.StudyRouter([f"replica-{i}" for i in range(n)], **kwargs)
+
+
+class TestPlacement:
+    def test_deterministic_across_instances(self):
+        a, b = make_router(), make_router()
+        assert [a.replica_for(k) for k in KEYS] == [
+            b.replica_for(k) for k in KEYS
+        ]
+
+    def test_every_replica_gets_a_share(self):
+        router = make_router()
+        assignments = router.assignments(KEYS)
+        for rid, studies in assignments.items():
+            # 200 keys over 4 replicas: a replica with none (or nearly
+            # all) means the hash is degenerate, not just unlucky.
+            assert 10 <= len(studies) <= 120, (rid, len(studies))
+
+    def test_ranking_is_a_permutation(self):
+        router = make_router()
+        ranking = router.ranking(KEYS[0])
+        assert sorted(ranking) == sorted(router.replica_ids)
+
+    def test_routing_disabled_pins_first_replica(self):
+        router = make_router(routing=False)
+        assert {router.replica_for(k) for k in KEYS} == {"replica-0"}
+
+    def test_duplicate_or_empty_ids_rejected(self):
+        with pytest.raises(ValueError):
+            routing.StudyRouter([])
+        with pytest.raises(ValueError):
+            routing.StudyRouter(["a", "a"])
+
+
+class TestLiveness:
+    def test_only_downed_replicas_studies_move(self):
+        router = make_router()
+        before = {k: router.replica_for(k) for k in KEYS}
+        router.mark_down("replica-2")
+        after = {k: router.replica_for(k) for k in KEYS}
+        moved = {k for k in KEYS if before[k] != after[k]}
+        assert moved == {k for k in KEYS if before[k] == "replica-2"}
+        assert all(after[k] != "replica-2" for k in KEYS)
+
+    def test_moved_studies_go_to_second_choice(self):
+        router = make_router()
+        key = next(k for k in KEYS if router.replica_for(k) == "replica-1")
+        ranking = router.ranking(key)
+        router.mark_down("replica-1")
+        assert router.replica_for(key) == ranking[1]
+
+    def test_mark_up_restores_original_placement(self):
+        router = make_router()
+        before = {k: router.replica_for(k) for k in KEYS}
+        router.mark_down("replica-0")
+        assert router.mark_up("replica-0")
+        assert {k: router.replica_for(k) for k in KEYS} == before
+
+    def test_mark_transitions_report_change(self):
+        router = make_router()
+        assert router.mark_down("replica-3")
+        assert not router.mark_down("replica-3")  # already down
+        assert router.mark_up("replica-3")
+        assert not router.mark_up("replica-3")  # already up
+
+    def test_all_down_raises_transient(self):
+        router = make_router(2)
+        router.mark_down("replica-0")
+        router.mark_down("replica-1")
+        with pytest.raises(routing.NoLiveReplicaError):
+            router.replica_for(KEYS[0])
+        # NoLiveReplicaError must classify as transient (retries can heal).
+        from vizier_tpu.reliability import errors as errors_lib
+
+        assert errors_lib.is_transient_exception(
+            routing.NoLiveReplicaError("x")
+        )
+
+    def test_unknown_replica_rejected(self):
+        router = make_router()
+        with pytest.raises(KeyError):
+            router.mark_down("replica-99")
+
+    def test_route_cache_tracks_liveness_epoch(self):
+        router = make_router()
+        key = KEYS[0]
+        first = router.replica_for(key)
+        assert router.last_route(key) == first
+        router.mark_down(first)
+        second = router.replica_for(key)
+        assert second != first
+        assert router.last_route(key) == second
+        router.mark_up(first)
+        assert router.replica_for(key) == first
+
+    def test_snapshot(self):
+        router = make_router(2)
+        router.mark_down("replica-1")
+        assert router.snapshot() == {"replica-0": "up", "replica-1": "down"}

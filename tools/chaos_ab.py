@@ -17,6 +17,24 @@ rate (≈ the injected designer-fault rate); the OFF arm dies at the first
 injected fault that reaches the client.
 
 Usage:  python tools/chaos_ab.py [--trials 50] [--seed 11] [--fault-prob 0.1]
+        [--distributed N] [--kill-at K] [--instrument-locks]
+
+``--distributed N`` adds a third arm: the same seeded fault schedule
+against an N-replica sharded tier (``vizier_tpu.distributed``) with
+snapshot+WAL persistence, and at trial ``--kill-at`` (default: halfway)
+the replica that owns the study is KILLED. The run must still complete
+every trial: the routed stub surfaces the dead replica, the manager fails
+its studies over to the rendezvous successors by WAL replay, and the
+client's retry machinery lands on the successor — with the breaker /
+fallback counters still visible in the shared-Pythia serving stats.
+
+``--instrument-locks`` runs every arm under
+``analysis.debug_locks.instrument()`` and cross-checks the runtime
+acquisition order against the static lock-order graph (now including the
+router/WAL locks) when the soak finishes; an observed edge the static
+pass missed fails the run. This is the chaos-soak ↔ static-analysis
+cross-check the long `slow`-marked soak in
+``tests/distributed/test_chaos_soak.py`` runs in CI.
 """
 
 from __future__ import annotations
@@ -137,11 +155,147 @@ def run_arm(
     }
 
 
+def run_distributed_arm(
+    *,
+    trials: int,
+    seed: int,
+    fault_prob: float,
+    reliability: ReliabilityConfig,
+    num_replicas: int,
+    kill_at: int,
+) -> dict:
+    """Kill-one-replica failover under the same seeded fault schedule."""
+    import tempfile
+
+    from vizier_tpu.distributed import ReplicaManager
+
+    monkey = chaos.ChaosMonkey(seed=seed, failure_prob=fault_prob)
+    wal_root = tempfile.mkdtemp(prefix="vizier-chaos-wal-")
+    manager = ReplicaManager(
+        num_replicas,
+        wal_root=wal_root,
+        policy_factory=_ChaosPolicyFactory(monkey),
+        reliability_config=reliability,
+    )
+    study_name = "owners/chaos/studies/dist-ab"
+    manager.stub.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(
+            parent="owners/chaos",
+            study=pc.study_to_proto(_study_config(), study_name),
+        )
+    )
+    # Transport faults injected BETWEEN the client and the router: they
+    # exercise client retries without implicating any replica (the manager
+    # verifies liveness before failing over).
+    client = vizier_client.VizierClient(
+        chaos.ChaosServiceStub(manager.stub, monkey),
+        study_name,
+        "chaos-worker",
+        reliability=reliability,
+    )
+    owner_before = manager.router.replica_for(study_name)
+
+    suggest_hist = MetricsRegistry().histogram(
+        "chaos_suggest_latency_seconds", help="chaos_ab per-suggest wall time"
+    )
+    completed = fallback_trials = 0
+    error = None
+    killed = False
+    start = time.perf_counter()
+    try:
+        for i in range(trials):
+            if i == kill_at:
+                manager.kill_replica(owner_before)
+                killed = True
+            t0 = time.perf_counter()
+            (trial,) = client.get_suggestions(1)
+            suggest_hist.observe(time.perf_counter() - t0)
+            if is_fallback_suggestion(trial.metadata):
+                fallback_trials += 1
+            client.complete_trial(
+                trial.id, vz.Measurement(metrics={"obj": 0.01 * i})
+            )
+            completed += 1
+    except Exception as e:  # a failed failover lands here
+        error = f"{type(e).__name__}: {e}"
+    elapsed = time.perf_counter() - start
+
+    def _ms(q: float):
+        value = suggest_hist.percentile(q)
+        return round(value * 1000.0, 2) if value is not None else None
+
+    stats = manager.serving_stats()
+    owner_after = manager.router.replica_for(study_name)
+    manager.shutdown()
+    return {
+        "completed_trials": completed,
+        "target_trials": trials,
+        "failed": error is not None,
+        "error": error,
+        "replicas": num_replicas,
+        "wal_root": wal_root,
+        "killed_replica": owner_before if killed else None,
+        "killed_at_trial": kill_at if killed else None,
+        "owner_after_failover": owner_after,
+        "failovers": stats["failovers"],
+        "restored_studies": stats["restored_studies"],
+        "router": stats["router"],
+        "fallback_trials": fallback_trials,
+        "fallback_rate": fallback_trials / max(1, completed),
+        "elapsed_secs": round(elapsed, 3),
+        "suggest_latency_ms": {"p50": _ms(50), "p95": _ms(95), "p99": _ms(99)},
+        "serving_stats": {
+            k: v
+            for k, v in sorted(stats.items())
+            if isinstance(v, int) and v
+        },
+        "injected": monkey.counts(),
+    }
+
+
+def _cross_check_locks(observatory, out: dict) -> bool:
+    """Diffs the soak's observed lock order against the static graph."""
+    from vizier_tpu.analysis import debug_locks, suite
+
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+    static = suite.run_suite(repo_root, passes=["lock_order"]).lock_result
+    check = debug_locks.check_against_static(observatory, static, repo_root)
+    out["lock_check"] = {
+        "acquisitions": observatory.acquisitions,
+        "confirmed_edges": sorted(set(check.confirmed)),
+        "missing_from_static_graph": [
+            {"src": src, "dst": dst, "thread": edge.thread}
+            for src, dst, edge in check.missing_static
+        ],
+        "unmapped_sites": [s.short() for s in check.unmapped_sites],
+    }
+    return not check.missing_static
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--trials", type=int, default=50)
     parser.add_argument("--seed", type=int, default=11)
     parser.add_argument("--fault-prob", type=float, default=0.1)
+    parser.add_argument(
+        "--distributed",
+        type=int,
+        default=0,
+        metavar="N",
+        help="add the N-replica kill-one-replica failover arm (0 = skip)",
+    )
+    parser.add_argument(
+        "--kill-at",
+        type=int,
+        default=-1,
+        help="trial index at which the owning replica dies (-1 = halfway)",
+    )
+    parser.add_argument(
+        "--instrument-locks",
+        action="store_true",
+        help="record runtime lock order during the soak and fail on edges "
+        "the static lock_order graph does not predict",
+    )
     parser.add_argument(
         "--out",
         default=str(pathlib.Path(__file__).resolve().parent.parent / "CHAOS_AB.json"),
@@ -173,17 +327,42 @@ def main() -> None:
             "transport_fault_prob": args.fault_prob,
             "algorithm": "RANDOM_SEARCH (chaos-wrapped designer)",
             "observability": ObservabilityConfig.from_env().as_dict(),
+            "instrument_locks": bool(args.instrument_locks),
         },
         "arms": {},
     }
-    for name, reliability in arms.items():
-        print(f"[chaos_ab] running arm: {name}")
-        report["arms"][name] = run_arm(
-            trials=args.trials,
-            seed=args.seed,
-            fault_prob=args.fault_prob,
-            reliability=reliability,
-        )
+    if args.instrument_locks:
+        from vizier_tpu.analysis import debug_locks
+
+        instrumentation = debug_locks.instrument()
+    else:
+        import contextlib
+
+        instrumentation = contextlib.nullcontext(None)
+
+    kill_at = args.kill_at if args.kill_at >= 0 else args.trials // 2
+    with instrumentation as observatory:
+        for name, reliability in arms.items():
+            print(f"[chaos_ab] running arm: {name}")
+            report["arms"][name] = run_arm(
+                trials=args.trials,
+                seed=args.seed,
+                fault_prob=args.fault_prob,
+                reliability=reliability,
+            )
+        if args.distributed:
+            print(
+                f"[chaos_ab] running arm: distributed_failover "
+                f"({args.distributed} replicas, kill at trial {kill_at})"
+            )
+            report["arms"]["distributed_failover"] = run_distributed_arm(
+                trials=args.trials,
+                seed=args.seed,
+                fault_prob=args.fault_prob,
+                reliability=arms["reliability_on"],
+                num_replicas=args.distributed,
+                kill_at=kill_at,
+            )
 
     on, off = report["arms"]["reliability_on"], report["arms"]["reliability_off"]
     report["verdict"] = {
@@ -192,9 +371,27 @@ def main() -> None:
         "off_failed": off["failed"],
         "off_completed": off["completed_trials"],
     }
+    ok = True
+    if args.distributed:
+        dist = report["arms"]["distributed_failover"]
+        report["verdict"].update(
+            {
+                "distributed_completed_all": dist["completed_trials"]
+                == args.trials,
+                "distributed_failovers": dist["failovers"],
+                "distributed_killed_replica": dist["killed_replica"],
+            }
+        )
+        ok = ok and dist["completed_trials"] == args.trials and dist["failovers"] >= 1
+    if args.instrument_locks:
+        locks_ok = _cross_check_locks(observatory, report)
+        report["verdict"]["lock_order_confirmed"] = locks_ok
+        ok = ok and locks_ok
     pathlib.Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
     print(json.dumps(report["verdict"], indent=2))
     print(f"[chaos_ab] wrote {args.out}")
+    if not ok:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

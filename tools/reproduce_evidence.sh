@@ -18,9 +18,17 @@ echo "== 2. full-scale CPU bench for the shipped default (~30 min) =="
 JAX_PLATFORMS=cpu VIZIER_BENCH_SCALE=1.0 VIZIER_BENCH_WATCHDOG_S=14400 \
   python bench.py
 
-echo "== 3. service throughput head-to-head (~6 min) =="
-#    -> SERVICE_THROUGHPUT.json (builds /tmp/refvizier on first run)
-JAX_PLATFORMS=cpu python tools/service_throughput.py --out /tmp/st.json
+echo "== 3. service throughput head-to-head + sharded-tier A/B (~8 min) =="
+#    -> SERVICE_THROUGHPUT.json (builds /tmp/refvizier on first run);
+#    --replicas adds the "distributed" section: 4 routed replicas vs one
+#    gRPC server on the same 8-study workload (target >= 5x)
+JAX_PLATFORMS=cpu python tools/service_throughput.py --replicas 4 --out /tmp/st.json
+
+echo "== 3b. failover chaos: kill one replica mid-study (~1 min) =="
+#    -> CHAOS_AB.json gains the distributed_failover arm (50/50 trials
+#    complete via router failover + WAL handoff) and the runtime
+#    lock-order cross-check (router/WAL locks vs the static graph)
+JAX_PLATFORMS=cpu python tools/chaos_ab.py --distributed 4 --instrument-locks
 
 echo "== 4. budget-policy A/B, 5 seeds x 3 families (~45 min) =="
 #    -> budget_ab_r5.json

@@ -2,6 +2,7 @@
 
 Usage: python tools/service_throughput.py [--out SERVICE_THROUGHPUT.json]
        [--side repo|reference|both]
+       [--replicas N [--replica-mode inprocess|subprocess]]
 
 Reference ``performance_test.py:44-89`` runs clients×trials configs
 {1×10, 2×10, 10×10, 50×5, 100×5} on RANDOM_SEARCH over a 2-D space and
@@ -16,6 +17,18 @@ The reference side runs in a subprocess so its ``vizier`` package import
 and proto registrations stay isolated; per-worker clients are created
 BEFORE the timed section on both sides, so the clock covers only the
 suggest→complete loops.
+
+``--replicas N`` additionally runs the sharded-tier A/B (a "distributed"
+section in the JSON; the single-replica report above is byte-compatible
+with the original schema): the SAME multi-study workload measured against
+(a) one ``DefaultVizierServer`` over localhost gRPC — today's deployment —
+and (b) N replicas behind the study-affinity router
+(``vizier_tpu.distributed``). ``--replica-mode inprocess`` (default) uses
+``ReplicaManager`` — clients route straight to the owning replica's
+servicer with no central frontend hop, replicas share one Pythia fleet;
+``subprocess`` starts N ``replica_main`` gRPC server processes and routes
+over real channels (the multi-host shape; on a single-core container it
+cannot beat one server — the processes timeshare the core).
 """
 
 from __future__ import annotations
@@ -39,6 +52,10 @@ REFCOPY = "/tmp/refvizier"
 
 
 REPEATS = 3  # best-of-N per config: throughput = least-interference run
+# The distributed A/B arms run short (~0.1 s for the tier), so scheduler
+# noise on a small host dominates single runs; more best-of repeats per
+# arm, same least-interference methodology.
+DIST_REPEATS = 5
 
 
 def run_repo() -> list:
@@ -197,13 +214,286 @@ def run_reference() -> list:
     return rows
 
 
+# -- sharded-tier A/B --------------------------------------------------------
+
+# The distributed workload: study-affinity routing only pays off with many
+# studies, so the A/B drives STUDIES concurrent studies with
+# CLIENTS_PER_STUDY worker threads each, identical on both arms.
+DIST_STUDIES = 8
+DIST_CLIENTS_PER_STUDY = 2
+DIST_TRIALS_EACH = 25
+
+
+def _dist_workload(stub, tag: str) -> dict:
+    """Runs the multi-study workload against ``stub``; returns the row."""
+    import concurrent.futures as cf
+
+    from vizier_tpu import pyvizier as vz
+    from vizier_tpu.service import proto_converters as pc
+    from vizier_tpu.service import vizier_client
+    from vizier_tpu.service.protos import vizier_service_pb2
+    from vizier_tpu.testing import stress
+
+    study_names, clients = [], []
+    for s in range(DIST_STUDIES):
+        name = f"owners/perf/studies/{tag}-s{s}"
+        stub.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(
+                parent="owners/perf",
+                study=pc.study_to_proto(stress.stress_study_config(), name),
+            )
+        )
+        study_names.append(name)
+        for w in range(DIST_CLIENTS_PER_STUDY):
+            clients.append(vizier_client.VizierClient(stub, name, f"worker_{w}"))
+
+    def worker(client):
+        for _ in range(DIST_TRIALS_EACH):
+            (trial,) = client.get_suggestions(1)
+            x = trial.parameters["x"].value
+            y = trial.parameters["y"].value
+            client.complete_trial(
+                trial.id,
+                vz.Measurement(metrics={"obj": (x - 0.3) ** 2 + (y - 0.7) ** 2}),
+            )
+
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(max_workers=len(clients)) as pool:
+        list(pool.map(worker, clients))
+    wall = time.perf_counter() - t0
+
+    from vizier_tpu.service.protos import study_pb2
+
+    total = DIST_STUDIES * DIST_CLIENTS_PER_STUDY * DIST_TRIALS_EACH
+    completed = 0
+    for name in study_names:
+        response = stub.ListTrials(
+            vizier_service_pb2.ListTrialsRequest(parent=name)
+        )
+        completed += sum(
+            1 for t in response.trials if t.state == study_pb2.Trial.SUCCEEDED
+        )
+    assert completed == total, (completed, total)
+    return {
+        "studies": DIST_STUDIES,
+        "clients_per_study": DIST_CLIENTS_PER_STUDY,
+        "trials_each": DIST_TRIALS_EACH,
+        "total_trials": total,
+        "completed": completed,
+        "wall_s": round(wall, 3),
+        "trials_per_s": round(total / wall, 1),
+        "study_names": study_names,
+    }
+
+
+def _best_of(fn, repeats: int) -> dict:
+    best = None
+    for rep in range(repeats):
+        row = fn(rep)
+        if best is None or row["trials_per_s"] > best["trials_per_s"]:
+            best = row
+    return best
+
+
+def run_distributed(num_replicas: int, mode: str) -> dict:
+    """The sharded-tier A/B: single gRPC server vs N routed replicas.
+
+    Each arm runs in its OWN subprocess: neither arm's thread pools, gRPC
+    channels, or allocator state can pollute the other's measurement (on a
+    1-core host, teardown noise from a prior arm is a real bias in either
+    direction).
+    """
+    from vizier_tpu.distributed import config as dist_config_lib
+
+    report = {
+        "config": {
+            "replicas": num_replicas,
+            "mode": mode,
+            "studies": DIST_STUDIES,
+            "clients_per_study": DIST_CLIENTS_PER_STUDY,
+            "trials_each": DIST_TRIALS_EACH,
+            "repeats": DIST_REPEATS,
+            "distributed": dist_config_lib.DistributedConfig.from_env().as_dict(),
+        },
+    }
+    for arm in ("multi_replica", "single_server"):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--dist-arm",
+                arm,
+                "--replicas",
+                str(num_replicas),
+                "--replica-mode",
+                mode,
+            ],
+            capture_output=True,
+            text=True,
+            cwd=_REPO_ROOT,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"distributed arm {arm} failed:\n{proc.stderr[-3000:]}"
+            )
+        payload = json.loads(
+            [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+        )
+        report.update(payload)
+        print(json.dumps(payload), flush=True)
+    report["speedup_vs_single_server"] = round(
+        report["multi_replica"]["trials_per_s"]
+        / report["single_server"]["trials_per_s"],
+        2,
+    )
+    print(
+        json.dumps(
+            {"speedup_vs_single_server": report["speedup_vs_single_server"]}
+        ),
+        flush=True,
+    )
+    return report
+
+
+def run_dist_arm(arm: str, num_replicas: int, mode: str) -> None:
+    """Child-process entry: one A/B arm, result JSON on stdout (last line)."""
+    from __graft_entry__ import _honor_platform_env
+
+    _honor_platform_env()
+
+    if arm == "single_server":
+        from vizier_tpu.service import grpc_stubs, vizier_server
+
+        server = vizier_server.DefaultVizierServer(host="localhost")
+        try:
+            stub = grpc_stubs.create_vizier_stub(server.endpoint)
+            _dist_workload(stub, "warm-single")  # first-RPC costs off the clock
+            single = _best_of(
+                lambda rep: _dist_workload(stub, f"single-r{rep}"), DIST_REPEATS
+            )
+        finally:
+            server.stop(0)
+        single.pop("study_names")
+        print(json.dumps({"single_server": single}), flush=True)
+        return
+
+    if mode == "inprocess":
+        row, per_replica = _run_inprocess_tier(num_replicas)
+    else:
+        row, per_replica = _run_subprocess_tier(num_replicas)
+    print(
+        json.dumps({"multi_replica": row, "per_replica": per_replica}),
+        flush=True,
+    )
+
+
+def _per_replica_breakdown(stub_stats: dict, assignments: dict) -> dict:
+    """Merges router request counters with the study->replica map."""
+    out = {}
+    for rid, stats in stub_stats["replicas"].items():
+        out[rid] = {
+            "state": stats["state"],
+            "requests": int(stats["requests"]),
+            "failures": int(stats["failures"]),
+            "studies": sorted(assignments.get(rid, [])),
+        }
+    return out
+
+
+def _run_inprocess_tier(num_replicas: int):
+    from vizier_tpu.distributed import ReplicaManager
+
+    manager = ReplicaManager(num_replicas)
+    try:
+        _dist_workload(manager.stub, "warm-tier")
+        best = _best_of(
+            lambda rep: _dist_workload(manager.stub, f"tier-r{rep}"), DIST_REPEATS
+        )
+        assignments = {rid: [] for rid in manager.router.replica_ids}
+        for name in best.pop("study_names"):
+            assignments[manager.router.replica_for(name)].append(name)
+        per_replica = _per_replica_breakdown(manager.stub.stats(), assignments)
+    finally:
+        manager.shutdown()
+    return best, per_replica
+
+
+def _run_subprocess_tier(num_replicas: int):
+    from vizier_tpu.distributed import router_stub as router_stub_lib
+    from vizier_tpu.service import grpc_stubs
+
+    procs, endpoints = [], []
+    try:
+        for i in range(num_replicas):
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "vizier_tpu.distributed.replica_main",
+                    "--replica-id",
+                    f"replica-{i}",
+                ],
+                stdout=subprocess.PIPE,
+                text=True,
+                cwd=_REPO_ROOT,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            procs.append(proc)
+        for proc in procs:
+            line = proc.stdout.readline().strip()
+            assert line.startswith("READY "), line
+            endpoints.append(line.split(" ", 1)[1])
+        stub = router_stub_lib.RoutedVizierStub(
+            {
+                f"replica-{i}": (lambda ep=ep: grpc_stubs.create_vizier_stub(ep))
+                for i, ep in enumerate(endpoints)
+            }
+        )
+        _dist_workload(stub, "warm-tier")
+        best = _best_of(
+            lambda rep: _dist_workload(stub, f"tier-r{rep}"), DIST_REPEATS
+        )
+        assignments = {rid: [] for rid in stub.router.replica_ids}
+        for name in best.pop("study_names"):
+            assignments[stub.router.replica_for(name)].append(name)
+        per_replica = _per_replica_breakdown(stub.stats(), assignments)
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            proc.wait(timeout=10)
+    return best, per_replica
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     ap.add_argument(
         "--side", choices=("repo", "reference", "both"), default="both"
     )
+    ap.add_argument(
+        "--replicas",
+        type=int,
+        default=0,
+        help="also run the sharded-tier A/B with N replicas (0 = skip)",
+    )
+    ap.add_argument(
+        "--replica-mode",
+        choices=("inprocess", "subprocess"),
+        default="inprocess",
+    )
+    ap.add_argument(
+        "--dist-arm",
+        choices=("single_server", "multi_replica"),
+        default=None,
+        help=argparse.SUPPRESS,  # child-process entry for run_distributed
+    )
     args = ap.parse_args()
+
+    if args.dist_arm:
+        run_dist_arm(args.dist_arm, max(1, args.replicas), args.replica_mode)
+        return
 
     if args.side == "reference":
         rows = run_reference()
@@ -245,6 +535,9 @@ def main() -> None:
             for r, ref in zip(report["repo"], report["reference"])
         }
         print(json.dumps(report["speedup_vs_reference"]))
+
+    if args.replicas:
+        report["distributed"] = run_distributed(args.replicas, args.replica_mode)
 
     if args.out:
         with open(args.out, "w") as f:

@@ -25,6 +25,13 @@ class RandomPolicy(policy_lib.Policy):
         self._supporter = policy_supporter
         self._rng = np.random.default_rng(seed)
 
+    @property
+    def should_be_cached(self) -> bool:
+        # Stateless apart from the RNG (which only needs a stream, not a
+        # fresh seed per request); rebuilding per suggest costs a PCG64
+        # entropy init on the serving hot path for nothing.
+        return True
+
     def suggest(self, request: policy_lib.SuggestRequest) -> policy_lib.SuggestDecision:
         space = request.study_config.search_space
         suggestions = [

@@ -51,6 +51,13 @@ DEFAULT_CRITICAL_LOCKS = (
     "CachedDesignerEntry.lock",
     "RequestCoalescer._lock",
     "grpc_stubs._CHANNEL_LOCK",
+    # Sharded service tier (vizier_tpu.distributed): the router/WAL locks
+    # sit UNDER the study locks on the hot path and must stay leaf-ward
+    # (bookkeeping + local file I/O only — no RPC, no device compute).
+    "StudyRouter._lock",
+    "RoutedVizierStub._lock",
+    "PersistentDataStore._lock",
+    "ReplicaManager._lock",
 )
 
 # Any resolved call landing in these subtrees counts as device compute.

@@ -63,6 +63,7 @@ _REL_DOC = "docs/guides/reliability.md"
 _SRV_DOC = "docs/guides/serving.md"
 _PERF_DOC = "docs/guides/performance.md"
 _SWITCH_DOC = "docs/guides/switching_from_oss_vizier.md"
+_RUN_DOC = "docs/guides/running_the_service.md"
 
 SWITCHES: Tuple[EnvSwitch, ...] = (
     # -- observability (ObservabilityConfig) -------------------------------
@@ -106,6 +107,15 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             "Background AOT compile of batched programs.", "0"),
     _switch("VIZIER_COMPILE_CACHE_DIR", "str", "ServingConfig", _PERF_DOC,
             "JAX persistent compilation cache directory."),
+    # -- distributed (DistributedConfig) -----------------------------------
+    _switch("VIZIER_DISTRIBUTED", "flag", "DistributedConfig", _RUN_DOC,
+            "Study-affinity router (off = first replica serves all).", "1"),
+    _switch("VIZIER_DISTRIBUTED_REPLICAS", "int", "DistributedConfig",
+            _RUN_DOC, "Replica count for env-built sharded tiers.", "4"),
+    _switch("VIZIER_DISTRIBUTED_WAL_DIR", "str", "DistributedConfig",
+            _RUN_DOC, "Snapshot+WAL root ('' = RAM only, no restart warmth)."),
+    _switch("VIZIER_DISTRIBUTED_SNAPSHOT_INTERVAL", "int", "DistributedConfig",
+            _RUN_DOC, "Mutations per shard between WAL compactions.", "256"),
     # -- designers ---------------------------------------------------------
     _switch("VIZIER_DISABLE_MESH", "flag", "GPBanditDesigner", _SWITCH_DOC,
             "Opt out of the multi-device auto-mesh (set = disabled).", "0"),

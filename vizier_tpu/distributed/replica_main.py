@@ -1,0 +1,75 @@
+"""One sharded-tier replica as a standalone gRPC server process.
+
+``python -m vizier_tpu.distributed.replica_main --replica-id replica-0
+--port 28090 [--wal-dir /data/vizier/replica-0]`` starts a
+``DefaultVizierServer`` (Vizier + its own Pythia) whose datastore is a
+snapshot+WAL ``PersistentDataStore`` when ``--wal-dir`` is given — the
+process restarts warm from its directory. It prints ``READY <endpoint>``
+on stdout once serving, which is what ``tools/service_throughput.py
+--replica-mode subprocess`` waits for.
+
+Clients reach the fleet through a client-side
+:class:`~vizier_tpu.distributed.router_stub.RoutedVizierStub` over the
+replica endpoints (see ``vizier_client.environment_variables
+.server_endpoints``); there is no central frontend to scale or fail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--replica-id", default="replica-0")
+    parser.add_argument("--host", default="localhost")
+    parser.add_argument("--port", type=int, default=0, help="0 = pick a free port")
+    parser.add_argument("--wal-dir", default="", help="'' = RAM only")
+    parser.add_argument(
+        "--snapshot-interval", type=int, default=0, help="0 = config default"
+    )
+    args = parser.parse_args(argv)
+
+    # The replica serves studies, not accelerators-by-default: a dead TPU
+    # tunnel must not hang jax init when the subprocess is CPU-bound work.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from vizier_tpu.distributed import wal as wal_lib
+    from vizier_tpu.service import vizier_server
+
+    datastore = None
+    if args.wal_dir:
+        datastore = wal_lib.PersistentDataStore(
+            args.wal_dir,
+            snapshot_interval=(args.snapshot_interval or None),
+        )
+        print(
+            f"[{args.replica_id}] replayed {datastore.recovered_records} "
+            f"WAL records (torn tail: {datastore.recovered_torn_tail})",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    server = vizier_server.DefaultVizierServer(
+        host=args.host,
+        port=args.port or None,
+        datastore=datastore,
+    )
+    print(f"READY {server.endpoint}", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop(grace=1.0)
+    if datastore is not None:
+        datastore.compact_now()
+        datastore.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,396 @@
+"""``ReplicaManager``: an in-process sharded Vizier tier with failover.
+
+N ``VizierServicer`` replicas — each owning its shard of the study
+population and (optionally) a per-replica snapshot+WAL directory — behind
+one :class:`~vizier_tpu.distributed.router_stub.RoutedVizierStub`. All
+replicas feed ONE shared ``PythiaServicer``: the designer cache, request
+coalescer, and cross-study batch executor are fleet-wide, so suggestion
+compute batches across replicas exactly as it batches across studies on a
+single server. The shared Pythia reads trials back through the router too,
+so its view follows failover automatically.
+
+Failure model:
+
+- A dead replica (``kill_replica`` in chaos runs, a crashed process in
+  real life) surfaces as transport errors on its RPCs. The routed stub
+  reports them to :meth:`_on_endpoint_failure`; the manager verifies the
+  replica is really dead (a chaos-injected fault on a live replica is NOT
+  a failover trigger — the client retry handles it), marks it down, and
+  **lifts the dead replica's studies onto their rendezvous successors** by
+  replaying its WAL directory into the successors' datastores (which
+  re-logs every record — the handoff itself is durable). The failing RPC
+  then re-raises; the caller's reliability retries land on the successor.
+- ``revive_replica`` rebuilds a replica from its own WAL (restart warm);
+  if its studies were failed over meanwhile, they are copied back from
+  the successors before the replica is marked up.
+
+Lock order: ``ReplicaManager._lock`` guards the replica/failover tables
+only; WAL replay and datastore writes run OUTSIDE it (the failover path
+serializes on ``_failover_lock`` instead, which never nests inside
+``_lock``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from vizier_tpu.distributed import config as config_lib
+from vizier_tpu.distributed import router_stub
+from vizier_tpu.distributed import routing
+from vizier_tpu.distributed import wal as wal_lib
+from vizier_tpu.service import ram_datastore
+
+_logger = logging.getLogger(__name__)
+
+
+class ReplicaDownError(ConnectionError):
+    """RPC reached a dead replica (transport-shaped, classified transient)."""
+
+
+class _ReplicaEndpoint:
+    """The callable surface of one replica; raises when the replica is dead."""
+
+    def __init__(self, replica: "Replica"):
+        self._replica = replica
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._replica.servicer, name)
+        if not callable(attr) or name.startswith("_"):
+            return attr
+
+        def call(*args, **kwargs):
+            if not self._replica.alive:
+                raise ReplicaDownError(
+                    f"replica {self._replica.replica_id} is down"
+                )
+            return attr(*args, **kwargs)
+
+        return call
+
+
+class Replica:
+    """One shard: servicer + datastore (+ WAL directory when persistent)."""
+
+    def __init__(self, replica_id: str, servicer, datastore, wal_dir: Optional[str]):
+        self.replica_id = replica_id
+        self.servicer = servicer
+        self.datastore = datastore
+        self.wal_dir = wal_dir
+        self.alive = True
+        self.endpoint = _ReplicaEndpoint(self)
+
+
+class ReplicaManager:
+    """Builds, health-checks, and fails over an in-process replica fleet."""
+
+    def __init__(
+        self,
+        num_replicas: Optional[int] = None,
+        *,
+        config: Optional[config_lib.DistributedConfig] = None,
+        wal_root: Optional[str] = None,
+        policy_factory=None,
+        serving_config=None,
+        reliability_config=None,
+    ):
+        import dataclasses
+
+        from vizier_tpu.reliability import config as reliability_config_lib
+        from vizier_tpu.service import pythia_service, vizier_service
+
+        self.config = config or config_lib.DistributedConfig.from_env()
+        self._num_replicas = max(1, num_replicas or self.config.num_replicas)
+        self._wal_root = wal_root if wal_root is not None else self.config.wal_root
+        replica_ids = [f"replica-{i}" for i in range(self._num_replicas)]
+        self.router = routing.StudyRouter(
+            replica_ids, routing=self.config.routing
+        )
+
+        # In-process replicas run the synchronous Pythia dispatch: the
+        # manager (not a per-request watchdog thread) owns wedged-replica
+        # semantics here, and the thread-per-suggest the deadline path
+        # spawns is measurable overhead at tier throughput. Everything
+        # else (retries, breaker, fallback) keeps its configured state.
+        base_reliability = (
+            reliability_config or reliability_config_lib.ReliabilityConfig.from_env()
+        )
+        replica_reliability = dataclasses.replace(
+            base_reliability, deadlines=self.config.replica_deadlines
+        )
+
+        # One Pythia for the whole fleet; its trial reads route like any
+        # other client so failover moves its view too. Constructed first
+        # (the replicas need it), connected to the router stub below.
+        self._pythia = pythia_service.PythiaServicer(
+            None,
+            policy_factory,
+            serving_config=serving_config,
+            reliability_config=base_reliability,
+        )
+        registry = self._pythia.serving_runtime.stats.registry
+        self._failovers = registry.counter(
+            "vizier_replica_failovers", help="Replica failovers performed."
+        )
+        self._restored = registry.counter(
+            "vizier_replica_restored_studies",
+            help="Studies lifted onto successors during failover.",
+        )
+
+        self._lock = threading.Lock()  # replica + failover bookkeeping only
+        self._replicas: Dict[str, Replica] = {}
+        for rid in replica_ids:
+            self._replicas[rid] = self._build_replica(
+                rid, vizier_service, replica_reliability
+            )
+
+        self._stub = router_stub.RoutedVizierStub(
+            {rid: r.endpoint for rid, r in self._replicas.items()},
+            router=self.router,
+            on_failure=self._on_endpoint_failure,
+            registry=registry,
+            retry_sink=self._record_retries,
+        )
+        self._pythia.connect_to_vizier(self._stub)
+
+        # Failover serialization (never nests inside self._lock).
+        self._failover_lock = threading.Lock()
+        self._failed_over: set = set()
+        self._health_stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+
+    # -- construction helpers ---------------------------------------------
+
+    def _build_replica(self, replica_id, vizier_service_mod, reliability):
+        wal_dir = None
+        if self._wal_root:
+            wal_dir = os.path.join(self._wal_root, replica_id)
+            datastore = wal_lib.PersistentDataStore(
+                wal_dir, snapshot_interval=self.config.snapshot_interval
+            )
+        else:
+            datastore = ram_datastore.NestedDictRAMDataStore()
+        servicer = vizier_service_mod.VizierServicer(
+            datastore=datastore, reliability_config=reliability
+        )
+        servicer.set_pythia(self._pythia)
+        return Replica(replica_id, servicer, datastore, wal_dir)
+
+    def _record_retries(self, amount: int) -> None:
+        self._pythia.serving_runtime.stats.increment("retries", amount)
+
+    # -- public surface ----------------------------------------------------
+
+    @property
+    def stub(self) -> router_stub.RoutedVizierStub:
+        """The drop-in service stub clients (and the shared Pythia) use."""
+        return self._stub
+
+    @property
+    def pythia(self):
+        return self._pythia
+
+    def replica(self, replica_id: str) -> Replica:
+        with self._lock:
+            return self._replicas[replica_id]
+
+    def replica_ids(self) -> List[str]:
+        return list(self.router.replica_ids)
+
+    def serving_stats(self) -> dict:
+        """Fleet stats: shared-Pythia counters + router + per-replica."""
+        stats = dict(self._pythia.serving_stats())
+        stats["router"] = self.router.snapshot()
+        stats["replicas"] = self._stub.stats()["replicas"]
+        stats["failovers"] = int(
+            sum(
+                self._failovers.value(**dict(key))
+                for key in self._failovers.label_keys()
+            )
+        )
+        stats["restored_studies"] = int(self._restored.value())
+        return stats
+
+    def prometheus_text(self) -> str:
+        return self._pythia.prometheus_text()
+
+    def shutdown(self) -> None:
+        self.stop_health_loop()
+        self._pythia.shutdown()
+        with self._lock:
+            replicas = list(self._replicas.values())
+        for replica in replicas:
+            close = getattr(replica.datastore, "close", None)
+            if close is not None:
+                close()
+
+    # -- chaos / lifecycle -------------------------------------------------
+
+    def kill_replica(self, replica_id: str) -> None:
+        """Simulates a replica crash: every subsequent RPC to it fails.
+
+        Detection and failover happen through the normal channels (a
+        failed RPC's failure hook, or the health loop) — exactly as they
+        would for a crashed process.
+        """
+        self.replica(replica_id).alive = False
+
+    def fail_over(self, replica_id: str) -> int:
+        """Marks a dead replica down and lifts its studies onto successors.
+
+        Returns the number of studies restored. Idempotent; a no-op for
+        replicas that already failed over.
+        """
+        with self._failover_lock:
+            with self._lock:
+                if replica_id in self._failed_over:
+                    return 0
+                replica = self._replicas[replica_id]
+                if replica.alive:
+                    raise ValueError(
+                        f"Refusing to fail over live replica {replica_id}; "
+                        "kill_replica first."
+                    )
+                self._failed_over.add(replica_id)
+            self.router.mark_down(replica_id)
+            restored = self._restore_from_wal(replica)
+        # Counter updates outside the failover lock: metric locks must not
+        # nest under tier mutexes (serving-stack convention, enforced by
+        # the chaos soak's runtime lock-order cross-check).
+        self._failovers.inc(replica=replica_id)
+        self._restored.inc(restored)
+        return restored
+
+    def _restore_from_wal(self, replica: Replica) -> int:
+        """Replays a dead replica's WAL into its successors' datastores."""
+        if not replica.wal_dir:
+            return 0  # RAM-only replica: its studies are lost until recreated
+        records, torn = wal_lib.read_directory(replica.wal_dir)
+        if torn:
+            _logger.warning(
+                "Dropped a torn WAL tail while failing over %s.",
+                replica.replica_id,
+            )
+        studies: set = set()
+        for opcode, payload in records:
+            study_key = wal_lib.study_key_of(opcode, payload)
+            successor_id = self.router.replica_for(study_key)
+            successor = self.replica(successor_id)
+            # Applying through the successor's datastore re-logs each
+            # record into the successor's own WAL: the handoff is durable.
+            wal_lib.apply_record(successor.datastore, opcode, payload)
+            studies.add(study_key)
+        return len(studies)
+
+    def revive_replica(self, replica_id: str) -> None:
+        """Restarts a replica warm from its WAL and routes its studies back.
+
+        Studies that failed over while it was down are copied back from
+        their interim successors (and deleted there so the owner is unique
+        again). Assumes quiesced traffic for the handback window — the
+        copy-back is not a transactional migration.
+        """
+        from vizier_tpu.reliability import config as reliability_config_lib
+        from vizier_tpu.service import vizier_service
+        import dataclasses
+
+        with self._lock:
+            old = self._replicas[replica_id]
+            was_failed_over = replica_id in self._failed_over
+        if old.alive:
+            return
+        close = getattr(old.datastore, "close", None)
+        if close is not None:
+            close()
+        reliability = dataclasses.replace(
+            reliability_config_lib.ReliabilityConfig.from_env(),
+            deadlines=self.config.replica_deadlines,
+        )
+        fresh = self._build_replica(replica_id, vizier_service, reliability)
+        if was_failed_over:
+            self._copy_back_from_successors(fresh)
+        with self._lock:
+            self._replicas[replica_id] = fresh
+            self._failed_over.discard(replica_id)
+        # _ReplicaEndpoint objects are bound per Replica; repoint the stub.
+        self._stub.set_endpoint(replica_id, fresh.endpoint)
+        self.router.mark_up(replica_id)
+
+    def _copy_back_from_successors(self, fresh: Replica) -> None:
+        """Moves studies the revived replica will own back from successors."""
+        revived_id = fresh.replica_id
+        with self._lock:
+            others = [
+                r
+                for rid, r in self._replicas.items()
+                if rid != revived_id and r.alive
+            ]
+        for successor in others:
+            inner = getattr(successor.datastore, "_inner", successor.datastore)
+            moved: set = set()
+            for opcode, payload in wal_lib.export_records(inner):
+                study_key = wal_lib.study_key_of(opcode, payload)
+                # Full ranking (liveness-blind): will this study route to
+                # the revived replica once it is marked up again?
+                if self.router.ranking(study_key)[0] != revived_id:
+                    continue
+                wal_lib.apply_record(fresh.datastore, opcode, payload)
+                moved.add(study_key)
+            for study_key in moved:
+                try:
+                    successor.datastore.delete_study(study_key)
+                except Exception:  # already gone / never fully copied
+                    pass
+
+    # -- failure detection -------------------------------------------------
+
+    def _on_endpoint_failure(self, replica_id: str, error: BaseException) -> None:
+        """Routed-stub failure hook. Verifies the replica is actually dead
+        before failing over: a chaos-injected transport fault on a LIVE
+        replica is the retry layer's job, not a topology change."""
+        del error
+        replica = self.replica(replica_id)
+        if replica.alive:
+            return
+        self.fail_over(replica_id)
+
+    def check_health(self) -> Dict[str, str]:
+        """One health sweep: fails over dead replicas, returns the map."""
+        with self._lock:
+            replicas = list(self._replicas.values())
+            failed_over = set(self._failed_over)
+        for replica in replicas:
+            if not replica.alive and replica.replica_id not in failed_over:
+                self.fail_over(replica.replica_id)
+        return self.router.snapshot()
+
+    def start_health_loop(self, interval_secs: float = 1.0) -> None:
+        """Background health sweeps (idempotent start)."""
+        with self._lock:
+            if self._health_thread is not None:
+                return
+            self._health_stop.clear()
+            self._health_thread = threading.Thread(
+                target=self._health_loop,
+                args=(interval_secs,),
+                daemon=True,
+                name="vizier-replica-health",
+            )
+            self._health_thread.start()
+
+    def stop_health_loop(self) -> None:
+        with self._lock:
+            thread = self._health_thread
+            self._health_thread = None
+        if thread is not None:
+            self._health_stop.set()
+            thread.join(timeout=5)
+
+    def _health_loop(self, interval_secs: float) -> None:
+        while not self._health_stop.wait(interval_secs):
+            try:
+                self.check_health()
+            except Exception as e:  # sweep must never kill the loop
+                _logger.warning("Health sweep failed: %s", e)

@@ -1,0 +1,495 @@
+"""Snapshot + write-ahead-log persistence for the RAM datastore.
+
+``PersistentDataStore`` wraps a ``NestedDictRAMDataStore``: reads hit RAM
+directly; every mutation is applied to RAM first and then appended to a
+per-shard WAL as a proto-serialized record, so a replica restarted over
+the same directory replays itself back to the exact pre-crash state
+("restart warm"). Every ``snapshot_interval`` mutations the log is
+compacted: the full store state is written as a *snapshot* — itself just a
+compacted WAL whose records recreate the state — and the live log is
+truncated.
+
+Durability protocol (one writer per directory):
+
+- ``wal.log``      — active log: ``[u32 length][u32 crc32][payload]``
+  records, appended + flushed per mutation.
+- ``snapshot.bin`` — last compaction, same record framing. Written to
+  ``snapshot.bin.tmp`` + fsync + atomic rename, THEN the log is truncated.
+
+Crash windows:
+
+- mid-append: the torn tail record fails its length/CRC check and is
+  dropped on replay (the mutation was never acknowledged durable);
+- mid-snapshot-write: the tmp file is ignored; old snapshot + full log
+  still replay;
+- after the snapshot rename but before the log truncate: replay applies
+  log records already folded into the snapshot — replay is *tolerant*
+  (create-of-existing applies as an update, delete-of-missing is skipped),
+  and re-applying a record sequence in order is state-idempotent, so the
+  double apply converges to the same state.
+
+Lock order: ``PersistentDataStore._lock`` serializes mutate+append so the
+log order equals the apply order; it nests OVER the inner RAM store's lock
+and the WAL's file lock, and nothing below ever calls back up (leaf-ward
+only — checked by the lock_order pass).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Iterable, List, Optional, Tuple
+
+from vizier_tpu.service import datastore as datastore_lib
+from vizier_tpu.service import ram_datastore
+from vizier_tpu.service import resources
+from vizier_tpu.service.protos import study_pb2, vizier_service_pb2
+
+# -- record vocabulary -----------------------------------------------------
+
+CREATE_STUDY = 1
+UPDATE_STUDY = 2
+DELETE_STUDY = 3
+CREATE_TRIAL = 4
+UPDATE_TRIAL = 5
+DELETE_TRIAL = 6
+CREATE_SUGGESTION_OP = 7
+UPDATE_SUGGESTION_OP = 8
+CREATE_EARLY_STOPPING_OP = 9
+UPDATE_EARLY_STOPPING_OP = 10
+UPDATE_METADATA = 11
+
+_OPCODES = frozenset(range(CREATE_STUDY, UPDATE_METADATA + 1))
+
+_HEADER = struct.Struct("<II")  # payload length, crc32(opcode byte + payload)
+
+SNAPSHOT_FILE = "snapshot.bin"
+LOG_FILE = "wal.log"
+
+
+def study_key_of(opcode: int, payload: bytes) -> str:
+    """The owning study resource name of a record (failover re-placement)."""
+    if opcode in (CREATE_STUDY, UPDATE_STUDY):
+        study = study_pb2.Study.FromString(payload)
+        return study.name
+    if opcode == DELETE_STUDY:
+        return payload.decode("utf-8")
+    if opcode in (CREATE_TRIAL, UPDATE_TRIAL):
+        trial = study_pb2.Trial.FromString(payload)
+        return resources.TrialResource.from_name(trial.name).study_resource.name
+    if opcode == DELETE_TRIAL:
+        name = payload.decode("utf-8")
+        return resources.TrialResource.from_name(name).study_resource.name
+    if opcode in (CREATE_SUGGESTION_OP, UPDATE_SUGGESTION_OP):
+        op = vizier_service_pb2.Operation.FromString(payload)
+        r = resources.SuggestionOperationResource.from_name(op.name)
+        return resources.StudyResource(r.owner_id, r.study_id).name
+    if opcode in (CREATE_EARLY_STOPPING_OP, UPDATE_EARLY_STOPPING_OP):
+        op = vizier_service_pb2.EarlyStoppingOperation.FromString(payload)
+        r = resources.EarlyStoppingOperationResource.from_name(op.name)
+        return resources.StudyResource(r.owner_id, r.study_id).name
+    if opcode == UPDATE_METADATA:
+        req = vizier_service_pb2.UpdateMetadataRequest.FromString(payload)
+        return req.name
+    raise ValueError(f"Unknown WAL opcode: {opcode}")
+
+
+class WriteAheadLog:
+    """Append-only mutation log with atomic snapshot compaction."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()  # file handle + counters only
+        self._log_path = os.path.join(directory, LOG_FILE)
+        self._snapshot_path = os.path.join(directory, SNAPSHOT_FILE)
+        self._log = open(self._log_path, "ab")
+        self._appended = 0
+
+    # -- framing -----------------------------------------------------------
+
+    @staticmethod
+    def _frame(opcode: int, payload: bytes) -> bytes:
+        if opcode not in _OPCODES:
+            raise ValueError(f"Unknown WAL opcode: {opcode}")
+        body = bytes((opcode,)) + payload
+        return _HEADER.pack(len(body), zlib.crc32(body)) + body
+
+    @staticmethod
+    def _read_records(path: str) -> Tuple[List[Tuple[int, bytes]], bool]:
+        """Records of one file; second element is True when a torn/corrupt
+        tail was dropped. Reading stops at the first bad record — with one
+        appender flushing sequentially, damage can only be a tail."""
+        records: List[Tuple[int, bytes]] = []
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return records, False
+        offset = 0
+        while offset < len(data):
+            if offset + _HEADER.size > len(data):
+                return records, True  # torn header
+            length, crc = _HEADER.unpack_from(data, offset)
+            start = offset + _HEADER.size
+            end = start + length
+            if length < 1 or end > len(data):
+                return records, True  # torn payload
+            body = data[start:end]
+            if zlib.crc32(body) != crc:
+                return records, True  # corrupt tail
+            records.append((body[0], body[1:]))
+            offset = end
+        return records, False
+
+    # -- API ---------------------------------------------------------------
+
+    def append(self, opcode: int, payload: bytes) -> None:
+        frame = self._frame(opcode, payload)
+        with self._lock:
+            self._log.write(frame)
+            self._log.flush()
+            self._appended += 1
+
+    @property
+    def appended_since_snapshot(self) -> int:
+        with self._lock:
+            return self._appended
+
+    def load(self) -> Tuple[List[Tuple[int, bytes]], bool]:
+        """Snapshot records + live log records, in apply order.
+
+        Second element reports whether a torn/corrupt log tail was dropped
+        (a crash mid-append; the dropped mutation was never durable).
+        """
+        snapshot_records, snapshot_torn = self._read_records(self._snapshot_path)
+        if snapshot_torn:
+            # A torn snapshot can only be a crashed *tmp* promoted by an
+            # outside force; never trust it over replaying nothing.
+            snapshot_records = []
+        log_records, log_torn = self._read_records(self._log_path)
+        return snapshot_records + log_records, log_torn or snapshot_torn
+
+    def compact(self, records: Iterable[Tuple[int, bytes]]) -> None:
+        """Atomically replaces the snapshot with ``records``, truncates the log.
+
+        The caller must hold whatever lock serializes its mutations (the
+        compaction must see a quiescent state and no append may interleave
+        with the truncate).
+        """
+        tmp_path = self._snapshot_path + ".tmp"
+        with self._lock:
+            with open(tmp_path, "wb") as f:
+                for opcode, payload in records:
+                    f.write(self._frame(opcode, payload))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp_path, self._snapshot_path)
+            # Crash between replace and truncate double-applies the log
+            # over the snapshot — tolerated by replay (module docstring).
+            self._log.close()
+            self._log = open(self._log_path, "wb")
+            self._appended = 0
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._log.close()
+            except Exception:
+                pass
+
+
+class PersistentDataStore(datastore_lib.DataStore):
+    """RAM datastore + snapshot/WAL durability (one writer per directory)."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        snapshot_interval: Optional[int] = None,
+        inner: Optional[ram_datastore.NestedDictRAMDataStore] = None,
+    ):
+        from vizier_tpu.distributed import config as config_lib
+
+        self._inner = inner or ram_datastore.NestedDictRAMDataStore()
+        self._wal = WriteAheadLog(directory)
+        self._snapshot_interval = (
+            snapshot_interval
+            if snapshot_interval is not None
+            else config_lib.DistributedConfig.from_env().snapshot_interval
+        )
+        # Serializes apply+append so log order == apply order; nests over
+        # the inner store's lock and the WAL file lock only.
+        self._lock = threading.Lock()
+        records, self.recovered_torn_tail = self._wal.load()
+        self.recovered_records = len(records)
+        for opcode, payload in records:
+            apply_record(self._inner, opcode, payload)
+
+    # -- plumbing ----------------------------------------------------------
+
+    @property
+    def wal(self) -> WriteAheadLog:
+        return self._wal
+
+    def _mutate(self, fn, opcode: int, payload: bytes):
+        """Applies ``fn`` to the inner store, then logs it (apply-then-log:
+        a rejected mutation — duplicate create, missing target — raises
+        before anything reaches the log)."""
+        with self._lock:
+            result = fn()
+            self._wal.append(opcode, payload)
+            if self._wal.appended_since_snapshot >= self._snapshot_interval:
+                self._wal.compact(export_records(self._inner))
+        return result
+
+    def compact_now(self) -> None:
+        """Forces a snapshot compaction (tests, graceful shutdown)."""
+        with self._lock:
+            self._wal.compact(export_records(self._inner))
+
+    def close(self) -> None:
+        self._wal.close()
+
+    # -- studies -----------------------------------------------------------
+
+    def create_study(self, study):
+        return self._mutate(
+            lambda: self._inner.create_study(study),
+            CREATE_STUDY,
+            study.SerializeToString(),
+        )
+
+    def load_study(self, study_name):
+        return self._inner.load_study(study_name)
+
+    def update_study(self, study):
+        return self._mutate(
+            lambda: self._inner.update_study(study),
+            UPDATE_STUDY,
+            study.SerializeToString(),
+        )
+
+    def delete_study(self, study_name):
+        return self._mutate(
+            lambda: self._inner.delete_study(study_name),
+            DELETE_STUDY,
+            study_name.encode("utf-8"),
+        )
+
+    def list_studies(self, owner_name):
+        return self._inner.list_studies(owner_name)
+
+    # -- trials ------------------------------------------------------------
+
+    def create_trial(self, trial):
+        return self._mutate(
+            lambda: self._inner.create_trial(trial),
+            CREATE_TRIAL,
+            trial.SerializeToString(),
+        )
+
+    def get_trial(self, trial_name):
+        return self._inner.get_trial(trial_name)
+
+    def update_trial(self, trial):
+        return self._mutate(
+            lambda: self._inner.update_trial(trial),
+            UPDATE_TRIAL,
+            trial.SerializeToString(),
+        )
+
+    def delete_trial(self, trial_name):
+        return self._mutate(
+            lambda: self._inner.delete_trial(trial_name),
+            DELETE_TRIAL,
+            trial_name.encode("utf-8"),
+        )
+
+    def list_trials(self, study_name, *, states=None):
+        return self._inner.list_trials(study_name, states=states)
+
+    def max_trial_id(self, study_name):
+        return self._inner.max_trial_id(study_name)
+
+    # -- suggestion operations --------------------------------------------
+
+    def create_suggestion_operation(self, operation):
+        return self._mutate(
+            lambda: self._inner.create_suggestion_operation(operation),
+            CREATE_SUGGESTION_OP,
+            operation.SerializeToString(),
+        )
+
+    def get_suggestion_operation(self, operation_name):
+        return self._inner.get_suggestion_operation(operation_name)
+
+    def update_suggestion_operation(self, operation):
+        return self._mutate(
+            lambda: self._inner.update_suggestion_operation(operation),
+            UPDATE_SUGGESTION_OP,
+            operation.SerializeToString(),
+        )
+
+    def list_suggestion_operations(
+        self, study_name, client_id, filter_fn=None, *, done=None
+    ):
+        return self._inner.list_suggestion_operations(
+            study_name, client_id, filter_fn, done=done
+        )
+
+    def max_suggestion_operation_number(self, study_name, client_id):
+        return self._inner.max_suggestion_operation_number(study_name, client_id)
+
+    # -- early stopping operations ----------------------------------------
+
+    def create_early_stopping_operation(self, operation):
+        return self._mutate(
+            lambda: self._inner.create_early_stopping_operation(operation),
+            CREATE_EARLY_STOPPING_OP,
+            operation.SerializeToString(),
+        )
+
+    def get_early_stopping_operation(self, operation_name):
+        return self._inner.get_early_stopping_operation(operation_name)
+
+    def update_early_stopping_operation(self, operation):
+        return self._mutate(
+            lambda: self._inner.update_early_stopping_operation(operation),
+            UPDATE_EARLY_STOPPING_OP,
+            operation.SerializeToString(),
+        )
+
+    # -- metadata ----------------------------------------------------------
+
+    def update_metadata(self, study_name, study_metadata, trial_metadata):
+        # Materialize the iterables once: they are consumed both by the
+        # store apply and the wire record.
+        study_kvs = list(study_metadata)
+        trial_kvs = [(int(tid), kv) for tid, kv in trial_metadata]
+        request = vizier_service_pb2.UpdateMetadataRequest(name=study_name)
+        for kv in study_kvs:
+            unit = request.deltas.add()
+            unit.trial_id = 0
+            unit.key_value.CopyFrom(kv)
+        for trial_id, kv in trial_kvs:
+            unit = request.deltas.add()
+            unit.trial_id = trial_id
+            unit.key_value.CopyFrom(kv)
+        return self._mutate(
+            lambda: self._inner.update_metadata(study_name, study_kvs, trial_kvs),
+            UPDATE_METADATA,
+            request.SerializeToString(),
+        )
+
+
+# -- replay / snapshot helpers ---------------------------------------------
+
+
+def export_records(
+    store: ram_datastore.NestedDictRAMDataStore,
+) -> List[Tuple[int, bytes]]:
+    """The store's full state as a compacted record sequence.
+
+    Replaying these records into an empty store recreates the state —
+    a snapshot IS a compacted WAL, so there is exactly one on-disk format
+    and one replay path.
+    """
+    studies, trials, ops, es_ops = store.export_protos()
+    records: List[Tuple[int, bytes]] = []
+    for study in studies:
+        records.append((CREATE_STUDY, study.SerializeToString()))
+    for trial in trials:
+        records.append((CREATE_TRIAL, trial.SerializeToString()))
+    for op in ops:
+        records.append((CREATE_SUGGESTION_OP, op.SerializeToString()))
+    for op in es_ops:
+        records.append((CREATE_EARLY_STOPPING_OP, op.SerializeToString()))
+    return records
+
+
+def apply_record(
+    store: datastore_lib.DataStore, opcode: int, payload: bytes
+) -> None:
+    """Applies one record to ``store``, tolerantly.
+
+    Tolerant replay is what makes the crash windows safe: a create of an
+    existing resource applies as an update (double-applied log over a
+    fresh snapshot), a delete/update of a missing resource is skipped
+    (the delete already happened / its study was deleted later in the
+    log). Applying a record SEQUENCE in order therefore always converges
+    to the state the sequence describes.
+    """
+    if opcode in (CREATE_STUDY, UPDATE_STUDY):
+        study = study_pb2.Study.FromString(payload)
+        try:
+            store.create_study(study)
+        except datastore_lib.AlreadyExistsError:
+            store.update_study(study)
+    elif opcode == DELETE_STUDY:
+        try:
+            store.delete_study(payload.decode("utf-8"))
+        except datastore_lib.NotFoundError:
+            pass
+    elif opcode in (CREATE_TRIAL, UPDATE_TRIAL):
+        trial = study_pb2.Trial.FromString(payload)
+        try:
+            try:
+                store.create_trial(trial)
+            except datastore_lib.AlreadyExistsError:
+                store.update_trial(trial)
+        except datastore_lib.NotFoundError:
+            pass  # study deleted later in the log
+    elif opcode == DELETE_TRIAL:
+        try:
+            store.delete_trial(payload.decode("utf-8"))
+        except datastore_lib.NotFoundError:
+            pass
+    elif opcode in (CREATE_SUGGESTION_OP, UPDATE_SUGGESTION_OP):
+        op = vizier_service_pb2.Operation.FromString(payload)
+        try:
+            try:
+                store.create_suggestion_operation(op)
+            except datastore_lib.AlreadyExistsError:
+                store.update_suggestion_operation(op)
+        except datastore_lib.NotFoundError:
+            pass
+    elif opcode in (CREATE_EARLY_STOPPING_OP, UPDATE_EARLY_STOPPING_OP):
+        op = vizier_service_pb2.EarlyStoppingOperation.FromString(payload)
+        try:
+            # create doubles as upsert for early-stopping ops in the RAM
+            # store, but go through update for missing-create symmetry.
+            store.create_early_stopping_operation(op)
+        except datastore_lib.NotFoundError:
+            pass
+    elif opcode == UPDATE_METADATA:
+        request = vizier_service_pb2.UpdateMetadataRequest.FromString(payload)
+        study_kvs = [d.key_value for d in request.deltas if d.trial_id == 0]
+        trial_kvs = [
+            (int(d.trial_id), d.key_value)
+            for d in request.deltas
+            if d.trial_id != 0
+        ]
+        try:
+            store.update_metadata(request.name, study_kvs, trial_kvs)
+        except datastore_lib.NotFoundError:
+            pass
+    else:
+        raise ValueError(f"Unknown WAL opcode: {opcode}")
+
+
+def read_directory(
+    directory: str,
+) -> Tuple[List[Tuple[int, bytes]], bool]:
+    """Snapshot+log records of a (possibly dead) replica's WAL directory.
+
+    Read-only: used by failover to lift a dead replica's studies into
+    their successor replicas without opening the directory for append.
+    """
+    snapshot, _ = WriteAheadLog._read_records(
+        os.path.join(directory, SNAPSHOT_FILE)
+    )
+    log, torn = WriteAheadLog._read_records(os.path.join(directory, LOG_FILE))
+    return snapshot + log, torn

@@ -194,13 +194,21 @@ class Metadata(_NamespaceView):
     namespace. Iteration/getitem on a view only sees that namespace's keys.
     """
 
+    # One shared root-namespace instance: Metadata() construction sits on
+    # every trial proto conversion of the serving hot path, and Namespace
+    # is immutable, so all roots can be the same tuple.
+    _ROOT_NS = Namespace(())
+
     def __init__(
         self,
         *args,
         **kwargs,
     ):
         self._stores: Dict[Namespace, Dict[str, MetadataValue]] = {}
-        super().__init__(self, Namespace(()))
+        # Inlined _NamespaceView.__init__(self, self, _ROOT_NS) — measured
+        # on the suggest hot path (4 Metadata per served trial).
+        self._metadata = self
+        self._ns = Metadata._ROOT_NS
         if args or kwargs:
             self.update(*args, **kwargs)
 

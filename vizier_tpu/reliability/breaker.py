@@ -168,8 +168,13 @@ class CircuitBreakerRegistry:
 
     def states(self) -> Dict[str, str]:
         """study -> breaker state, for observability snapshots."""
+        # Snapshot the map under the registry lock, read each breaker's
+        # state OUTSIDE it: b.state takes the breaker's own lock, and the
+        # registry lock must stay map bookkeeping only (the runtime
+        # lock-order cross-check flagged the nested read).
         with self._lock:
-            return {name: b.state for name, b in self._breakers.items()}
+            breakers = list(self._breakers.items())
+        return {name: b.state for name, b in breakers}
 
     def open_count(self) -> int:
         return sum(1 for s in self.states().values() if s != CLOSED)

@@ -104,6 +104,12 @@ class Trial(client_abc.TrialInterface):
 class Study(client_abc.StudyInterface):
     def __init__(self, client: vizier_client.VizierClient):
         self._client = client
+        # client_id -> VizierClient scoped to it. Building a VizierClient
+        # is not free (RetryPolicy + jitter RNG construction), and the
+        # multi-worker stress shape calls suggest(client_id=...) per trial;
+        # clients are stateless wrappers over the shared service handle, so
+        # caching per worker id is safe.
+        self._scoped_clients: Dict[str, vizier_client.VizierClient] = {}
 
     # -- factories ---------------------------------------------------------
 
@@ -151,9 +157,11 @@ class Study(client_abc.StudyInterface):
         self, *, count: Optional[int] = None, client_id: Optional[str] = None
     ) -> List[Trial]:
         if client_id is not None and client_id != self._client.client_id:
-            scoped = vizier_client.VizierClient(
-                self._client._service, self._client.study_name, client_id
-            )
+            scoped = self._scoped_clients.get(client_id)
+            if scoped is None:
+                scoped = self._scoped_clients[client_id] = vizier_client.VizierClient(
+                    self._client._service, self._client.study_name, client_id
+                )
         else:
             scoped = self._client
         trials = scoped.get_suggestions(count or 1)

@@ -52,6 +52,12 @@ class PythiaServicer:
         )
         # Cache for policies that declare should_be_cached.
         self._policy_cache = {}
+        # study_name -> (serialized StudySpec, parsed StudyConfig). The
+        # spec is immutable after creation except metadata, and the bytes
+        # equality check catches exactly those updates — so the hot path
+        # skips a full Python proto->pyvizier parse per suggest without
+        # ever serving a stale search space.
+        self._config_cache = {}
         # Early-stopping policies cached per study (regression rule holds a
         # trained GBM; see EarlyStop dispatch).
         self._stopping_policies = {}
@@ -109,8 +115,21 @@ class PythiaServicer:
         """Drops every piece of per-study serving state (study deleted)."""
         self._serving.invalidate_study(study_name)
         self._stopping_policies.pop(study_name, None)
+        self._config_cache.pop(study_name, None)
         for key in [k for k in self._policy_cache if k[0] == study_name]:
             del self._policy_cache[key]
+
+    def _parsed_study_config(self, request) -> vz.StudyConfig:
+        """The request's StudyConfig, cached per study by spec bytes."""
+        spec = request.study_descriptor.config
+        spec_bytes = spec.SerializeToString()
+        cached = self._config_cache.get(request.study_name)
+        if cached is not None and cached[0] == spec_bytes:
+            return cached[1]
+        config = pc.study_config_from_proto(spec)
+        if request.study_name:
+            self._config_cache[request.study_name] = (spec_bytes, config)
+        return config
 
     def _get_policy(
         self, study_config: vz.StudyConfig, algorithm: str, study_name: str
@@ -194,7 +213,7 @@ class PythiaServicer:
         # search space or unknown algorithm is permanent — retrying or
         # falling back would serve a misconfigured study forever.
         try:
-            config = pc.study_config_from_proto(request.study_descriptor.config)
+            config = self._parsed_study_config(request)
             config.algorithm = request.algorithm or config.algorithm
             policy = self._get_policy(config, config.algorithm, request.study_name)
             descriptor = vz.StudyDescriptor(

@@ -3,14 +3,28 @@
 Parity with ``/root/reference/vizier/_src/service/resources.py:38-199``:
 ``owners/{owner}``, ``owners/{o}/studies/{s}``, ``.../trials/{id}``,
 ``.../earlyStoppingOperations/{op}``, ``.../clients/{c}/operations/{n}``.
+
+``from_name`` parses are memoized: the service hot path re-parses the same
+handful of study/trial names ~20x per suggest (measured), and the parsed
+resources are frozen (hashable, immutable) so returning a shared instance
+is safe. Invalid names still raise every time — ``lru_cache`` does not
+cache exceptions.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import re
 
 _SEGMENT = r"[^/]+"
+
+_PARSE_CACHE_SIZE = 16384
+
+
+def _memoized_parser(fn):
+    """Caches a ``from_name`` classmethod per (class, name)."""
+    return classmethod(functools.lru_cache(maxsize=_PARSE_CACHE_SIZE)(fn))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -21,7 +35,7 @@ class OwnerResource:
     def name(self) -> str:
         return f"owners/{self.owner_id}"
 
-    @classmethod
+    @_memoized_parser
     def from_name(cls, name: str) -> "OwnerResource":
         m = re.fullmatch(rf"owners/({_SEGMENT})", name)
         if not m:
@@ -38,7 +52,7 @@ class StudyResource:
     def name(self) -> str:
         return f"owners/{self.owner_id}/studies/{self.study_id}"
 
-    @classmethod
+    @_memoized_parser
     def from_name(cls, name: str) -> "StudyResource":
         m = re.fullmatch(rf"owners/({_SEGMENT})/studies/({_SEGMENT})", name)
         if not m:
@@ -59,7 +73,7 @@ class TrialResource:
     def name(self) -> str:
         return f"owners/{self.owner_id}/studies/{self.study_id}/trials/{self.trial_id}"
 
-    @classmethod
+    @_memoized_parser
     def from_name(cls, name: str) -> "TrialResource":
         m = re.fullmatch(
             rf"owners/({_SEGMENT})/studies/({_SEGMENT})/trials/(\d+)", name
@@ -90,7 +104,7 @@ class EarlyStoppingOperationResource:
     def operation_id(self) -> str:
         return f"earlystopping-{self.trial_id}"
 
-    @classmethod
+    @_memoized_parser
     def from_name(cls, name: str) -> "EarlyStoppingOperationResource":
         m = re.fullmatch(
             rf"owners/({_SEGMENT})/studies/({_SEGMENT})/trials/(\d+)/"
@@ -120,7 +134,7 @@ class SuggestionOperationResource:
             f"{self.client_id}/operations/{self.operation_number}"
         )
 
-    @classmethod
+    @_memoized_parser
     def from_name(cls, name: str) -> "SuggestionOperationResource":
         m = re.fullmatch(
             rf"owners/({_SEGMENT})/studies/({_SEGMENT})/clients/({_SEGMENT})/operations/(\d+)",

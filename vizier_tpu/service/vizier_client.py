@@ -30,6 +30,11 @@ class EnvironmentVariables:
     """Process-global client defaults (reference ``vizier_client.py:46-72``)."""
 
     server_endpoint: str = NO_ENDPOINT
+    # Sharded tier: the replica endpoints, in replica-id order (position i
+    # is "replica-i"). When set, clients route each study to its owning
+    # replica through a RoutedVizierStub — VizierClient code is unchanged.
+    # Takes precedence over ``server_endpoint``.
+    server_endpoints: Optional[List[str]] = None
     servicer_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # Initial GetOperation poll delay; grows by bounded exponential backoff
     # (doubling with jitter, capped at 8x) while an op stays not-done.
@@ -40,6 +45,7 @@ class EnvironmentVariables:
 environment_variables = EnvironmentVariables()
 
 _local_servicer = None
+_routed_stubs: Dict[tuple, Any] = {}
 
 
 def _get_local_servicer():
@@ -57,8 +63,35 @@ def _get_local_servicer():
     return _local_servicer
 
 
+def _routed_stub(endpoints) -> Any:
+    """One RoutedVizierStub per endpoint list (shared, like gRPC channels)."""
+    key = tuple(endpoints)
+    stub = _routed_stubs.get(key)
+    if stub is None:
+        from vizier_tpu.analysis import registry as _registry
+        from vizier_tpu.distributed import router_stub
+        from vizier_tpu.observability import metrics as metrics_lib
+        from vizier_tpu.service import grpc_stubs
+
+        stub = router_stub.RoutedVizierStub(
+            {
+                f"replica-{i}": (lambda ep=ep: grpc_stubs.create_vizier_stub(ep))
+                for i, ep in enumerate(key)
+            },
+            routing_enabled=_registry.env_on("VIZIER_DISTRIBUTED"),
+            registry=metrics_lib.default_registry(),
+        )
+        _routed_stubs[key] = stub
+    return stub
+
+
 def create_service_stub(endpoint: Optional[str] = None):
-    """Returns a gRPC stub or the in-process servicer (duck-typed alike)."""
+    """Returns a gRPC stub, a routed multi-replica stub, or the in-process
+    servicer — all duck-typed alike, so callers cannot tell them apart."""
+    if endpoint is None and environment_variables.server_endpoints:
+        return _routed_stub(environment_variables.server_endpoints)
+    if isinstance(endpoint, (list, tuple)):
+        return _routed_stub(endpoint)
     endpoint = endpoint or environment_variables.server_endpoint
     if endpoint == NO_ENDPOINT:
         return _get_local_servicer()

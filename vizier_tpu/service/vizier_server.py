@@ -31,13 +31,19 @@ class DefaultVizierServer:
         policy_factory=None,
         port: Optional[int] = None,
         serving_config=None,
+        datastore=None,
     ):
         from vizier_tpu.service import grpc_stubs
         from vizier_tpu.service import pythia_service
         from vizier_tpu.service import vizier_service
 
         self._port = port or _pick_port()
-        self._servicer = vizier_service.VizierServicer(database_url=database_url)
+        # ``datastore`` injects a storage backend (e.g. the sharded tier's
+        # snapshot+WAL PersistentDataStore — vizier_tpu.distributed);
+        # mutually exclusive with database_url.
+        self._servicer = vizier_service.VizierServicer(
+            database_url=database_url, datastore=datastore
+        )
         # ``serving_config`` (vizier_tpu.serving.ServingConfig) tunes or
         # disables the stateful serving runtime — designer cache, warm ARD
         # starts, request coalescing. None -> defaults + env overrides

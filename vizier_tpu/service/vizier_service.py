@@ -51,11 +51,19 @@ class VizierServicer:
         self,
         *,
         database_url: Optional[str] = None,
+        datastore: Optional[datastore_lib.DataStore] = None,
         early_stop_recycle_period: datetime.timedelta = datetime.timedelta(seconds=60),
         reliability_config: Optional[reliability_config_lib.ReliabilityConfig] = None,
     ):
-        if database_url is None:
-            self.datastore: datastore_lib.DataStore = ram_datastore.NestedDictRAMDataStore()
+        # An injected datastore wins: the sharded tier hands each replica
+        # its own snapshot+WAL-backed store (vizier_tpu.distributed), and a
+        # ShardedDataStore partitions one servicer across shard stores.
+        if datastore is not None:
+            if database_url is not None:
+                raise ValueError("Pass either datastore or database_url, not both.")
+            self.datastore: datastore_lib.DataStore = datastore
+        elif database_url is None:
+            self.datastore = ram_datastore.NestedDictRAMDataStore()
         else:
             self.datastore = sql_datastore.SQLDataStore(database_url)
         self._early_stop_recycle_period = early_stop_recycle_period
