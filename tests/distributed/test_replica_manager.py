@@ -224,3 +224,83 @@ class TestHealthAndRevive:
         assert manager.router.replica_for(name) == owner
         assert manager.replica(owner).datastore.max_trial_id(name) == 3
         assert manager.serving_stats()["failovers"] == 0
+
+    def test_delete_during_downtime_is_not_resurrected(self, manager):
+        """Review regression: a study deleted on its interim successor
+        while the owner was down must not come back from the owner's
+        stale WAL on revival."""
+        doomed = create_study(manager, "doomed")
+        kept = create_study(manager, "kept")
+        run_trials(make_client(manager, doomed), 2)
+        owner = manager.router.replica_for(doomed)
+        manager.kill_replica(owner)
+        manager.check_health()  # failover: both studies lift to successors
+        # Delete while the owner is down: the tombstone lands on the
+        # successor's store (and WAL), never on the owner's.
+        manager.stub.DeleteStudy(
+            vizier_service_pb2.DeleteStudyRequest(name=doomed)
+        )
+
+        manager.revive_replica(owner)
+        assert manager.router.is_up(owner)
+        revived = manager.replica(owner)
+        with pytest.raises(KeyError):
+            revived.datastore.load_study(doomed)
+        # The convergence is durable: a COLD restart over the revived
+        # replica's WAL dir must not bring the study back either.
+        restarted = wal_lib.PersistentDataStore(revived.wal_dir)
+        try:
+            with pytest.raises(KeyError):
+                restarted.load_study(doomed)
+        finally:
+            restarted.close()
+        # Studies NOT deleted during the downtime are untouched.
+        if manager.router.replica_for(kept) == owner:
+            assert revived.datastore.load_study(kept).name == kept
+        else:
+            owner_of_kept = manager.replica(manager.router.replica_for(kept))
+            assert owner_of_kept.datastore.load_study(kept).name == kept
+
+
+class TestListStudiesAcrossFailover:
+    """Review regression: a down replica must never silently shrink
+    ListStudies — either its studies are restored (complete listing) or
+    the fan-out fails loudly."""
+
+    LIST = vizier_service_pb2.ListStudiesRequest(parent="owners/o")
+
+    def test_listing_complete_after_wal_failover(self, manager):
+        names = {create_study(manager, f"ls{i}") for i in range(6)}
+        victim = manager.router.replica_for(next(iter(names)))
+        manager.kill_replica(victim)
+        # The first fan-out hits the dead replica: transport error, which
+        # synchronously triggers failover through the failure hook.
+        with pytest.raises(ConnectionError):
+            manager.stub.ListStudies(self.LIST)
+        # The retry (here: the caller's next call) sees the complete
+        # population, served from the successors.
+        response = manager.stub.ListStudies(self.LIST)
+        assert {s.name for s in response.studies} == names
+
+    def test_ram_only_down_replica_keeps_listing_loud(self):
+        manager = ReplicaManager(3, wal_root=None)
+        try:
+            names = [create_study(manager, f"ram{i}") for i in range(6)]
+            victim = manager.router.replica_for(names[0])
+            manager.kill_replica(victim)
+            assert manager.fail_over(victim) == 0  # nothing restorable
+            # The victim's studies are gone for good; a listing keeps
+            # raising rather than pretending the subset is everything.
+            with pytest.raises(ConnectionError, match="partial"):
+                manager.stub.ListStudies(self.LIST)
+        finally:
+            manager.shutdown()
+
+    def test_revive_restores_complete_quiet_listing(self, manager):
+        names = {create_study(manager, f"rv{i}") for i in range(6)}
+        victim = manager.router.replica_for(next(iter(names)))
+        manager.kill_replica(victim)
+        manager.check_health()
+        manager.revive_replica(victim)
+        response = manager.stub.ListStudies(self.LIST)
+        assert {s.name for s in response.studies} == names

@@ -101,6 +101,36 @@ class TestDropIn:
         )
         assert polled.name == op.name and polled.done
 
+    def test_list_studies_refuses_silent_partial_results(self, tier):
+        """Review regression: a down replica with unaccounted studies must
+        fail the fan-out loudly, not shrink the listing."""
+        servicers, stub = tier
+        names = {create_study(stub, f"part{i}") for i in range(8)}
+        stub.router.mark_down("replica-1")
+        with pytest.raises(ConnectionError, match="partial"):
+            stub.ListStudies(
+                vizier_service_pb2.ListStudiesRequest(parent="owners/o")
+            )
+        # Once something declares the studies failed over to successors,
+        # the live fan-out counts as complete again.
+        stub.note_failed_over("replica-1")
+        response = stub.ListStudies(
+            vizier_service_pb2.ListStudiesRequest(parent="owners/o")
+        )
+        expected = names - {
+            s.name
+            for s in servicers["replica-1"].datastore.list_studies("owners/o")
+        }
+        assert {s.name for s in response.studies} == expected
+        # A restarted replica owns its studies again: the declaration is
+        # dropped with the old endpoint.
+        stub.set_endpoint("replica-1", servicers["replica-1"])
+        stub.router.mark_up("replica-1")
+        response = stub.ListStudies(
+            vizier_service_pb2.ListStudiesRequest(parent="owners/o")
+        )
+        assert {s.name for s in response.studies} == names
+
     def test_routing_disabled_uses_first_replica_only(self):
         servicers = {f"replica-{i}": make_servicer() for i in range(3)}
         stub = router_stub.RoutedVizierStub(servicers, routing_enabled=False)

@@ -152,6 +152,78 @@ class TestCrashWindows:
         assert ds.list_studies("owners/o") == []
 
 
+class TestDurabilityModes:
+    """Review regression: the default mode flushes (process-crash durable
+    only); VIZIER_DISTRIBUTED_WAL_FSYNC / fsync=True syncs per append."""
+
+    def test_default_appends_flush_without_fsync(self, tmp_path, monkeypatch):
+        synced = []
+        monkeypatch.setattr(os, "fsync", lambda fd: synced.append(fd))
+        ds = wal.PersistentDataStore(str(tmp_path), fsync=False)
+        ds.create_study(datastore_test_lib.make_study(study="s0"))
+        assert not synced  # appends hand the record to the OS only...
+        ds.compact_now()
+        assert len(synced) == 1  # ...snapshots always sync
+        ds.close()
+
+    def test_fsync_mode_syncs_every_append(self, tmp_path, monkeypatch):
+        real_fsync = os.fsync
+        synced = []
+
+        def counting_fsync(fd):
+            synced.append(fd)
+            real_fsync(fd)
+
+        monkeypatch.setattr(os, "fsync", counting_fsync)
+        ds = wal.PersistentDataStore(str(tmp_path), fsync=True)
+        ds.create_study(datastore_test_lib.make_study(study="s0"))
+        ds.create_study(datastore_test_lib.make_study(study="s1"))
+        assert len(synced) == 2
+        ds.close()
+        revived = wal.PersistentDataStore(str(tmp_path))
+        assert len(revived.list_studies("owners/o")) == 2
+
+    def test_env_switch_feeds_config(self, monkeypatch):
+        from vizier_tpu.distributed import config as config_lib
+
+        monkeypatch.delenv("VIZIER_DISTRIBUTED_WAL_FSYNC", raising=False)
+        assert not config_lib.DistributedConfig.from_env().wal_fsync
+        monkeypatch.setenv("VIZIER_DISTRIBUTED_WAL_FSYNC", "1")
+        assert config_lib.DistributedConfig.from_env().wal_fsync
+
+
+class TestDivergenceFailStop:
+    """Review regression: a WAL write failing AFTER its mutation applied
+    must not leave readers observing state a restart would revert."""
+
+    def test_failed_append_poisons_the_store(self, tmp_path, monkeypatch):
+        ds = wal.PersistentDataStore(str(tmp_path))
+        ds.create_study(datastore_test_lib.make_study(study="s0"))
+
+        def full_disk(opcode, payload):
+            raise OSError("No space left on device")
+
+        monkeypatch.setattr(ds.wal, "append", full_disk)
+        with pytest.raises(OSError):
+            ds.create_study(datastore_test_lib.make_study(study="s1"))
+        # Fail-stop: the store refuses reads AND writes instead of serving
+        # the un-logged mutation.
+        with pytest.raises(wal.StoreDivergedError):
+            ds.load_study("owners/o/studies/s1")
+        with pytest.raises(wal.StoreDivergedError):
+            ds.list_studies("owners/o")
+        with pytest.raises(wal.StoreDivergedError):
+            ds.create_study(datastore_test_lib.make_study(study="s2"))
+        with pytest.raises(wal.StoreDivergedError):
+            ds.compact_now()
+        ds.close()
+        # A restart recovers to exactly the logged state.
+        revived = wal.PersistentDataStore(str(tmp_path))
+        assert [s.name for s in revived.list_studies("owners/o")] == [
+            "owners/o/studies/s0"
+        ]
+
+
 class TestRecordFraming:
     def test_unknown_opcode_rejected_at_append(self, tmp_path):
         log = wal.WriteAheadLog(str(tmp_path))

@@ -535,6 +535,42 @@ class TestReviewRegressions:
         assert study.materialize_state() == vz.StudyState.COMPLETED
 
 
+class TestAlgorithmOverrideIsolation:
+    """Review regression: a request's algorithm override must stay
+    per-request — the cached StudyConfig parse is shared across requests
+    (and servicer threads), so mutating it would make one client's
+    override leak into every later no-override suggest for the study."""
+
+    def test_suggest_override_leaves_cached_config_untouched(self):
+        from vizier_tpu.service.protos import pythia_service_pb2
+
+        servicer = _make_servicer()
+        pythia = servicer._pythia
+        name = "owners/o/studies/override"
+        servicer.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(
+                parent="owners/o",
+                study=pc.study_to_proto(_config("RANDOM_SEARCH"), name),
+            )
+        )
+        spec = servicer.datastore.load_study(name).study_spec
+
+        def suggest(algorithm):
+            request = pythia_service_pb2.PythiaSuggestRequest(
+                count=1, algorithm=algorithm, study_name=name
+            )
+            request.study_descriptor.config.CopyFrom(spec)
+            request.study_descriptor.guid = name
+            return pythia.Suggest(request)
+
+        assert not suggest("QUASI_RANDOM_SEARCH").error
+        # The override served that one request only: the cached parse (and
+        # with it the next no-override request) keeps the study's own
+        # algorithm.
+        assert pythia._config_cache[name][1].algorithm == "RANDOM_SEARCH"
+        assert not suggest("").error
+
+
 class TestListStudies:
     def test_lists_owner_studies(self):
         vizier_client._local_servicer = None

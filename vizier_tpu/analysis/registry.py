@@ -116,6 +116,8 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             _RUN_DOC, "Snapshot+WAL root ('' = RAM only, no restart warmth)."),
     _switch("VIZIER_DISTRIBUTED_SNAPSHOT_INTERVAL", "int", "DistributedConfig",
             _RUN_DOC, "Mutations per shard between WAL compactions.", "256"),
+    _switch("VIZIER_DISTRIBUTED_WAL_FSYNC", "flag", "DistributedConfig",
+            _RUN_DOC, "fsync the WAL per append (power-loss durability).", "0"),
     # -- designers ---------------------------------------------------------
     _switch("VIZIER_DISABLE_MESH", "flag", "GPBanditDesigner", _SWITCH_DOC,
             "Opt out of the multi-device auto-mesh (set = disabled).", "0"),

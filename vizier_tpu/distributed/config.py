@@ -12,7 +12,10 @@ in the central registry (``vizier_tpu.analysis.registry``) and documented in
 - ``VIZIER_DISTRIBUTED_WAL_DIR=/path``     — root directory for per-replica
   snapshot+WAL persistence ('' = RAM only, no restart warmth);
 - ``VIZIER_DISTRIBUTED_SNAPSHOT_INTERVAL`` — mutations per shard between
-  snapshot compactions (smaller = shorter replay, more snapshot I/O).
+  snapshot compactions (smaller = shorter replay, more snapshot I/O);
+- ``VIZIER_DISTRIBUTED_WAL_FSYNC=1``       — fsync the WAL per append:
+  mutations survive OS crashes/power loss, not just process crashes, at
+  the cost of a disk sync on every write (off by default).
 """
 
 from __future__ import annotations
@@ -42,6 +45,10 @@ class DistributedConfig:
     wal_root: Optional[str] = None
     # Mutations between snapshot compactions (per shard).
     snapshot_interval: int = DEFAULT_SNAPSHOT_INTERVAL
+    # fsync the WAL on every append. Off = appends are flushed to the OS
+    # (durable across process crashes only); on = durable across OS
+    # crashes/power loss too, at a per-mutation disk-sync cost.
+    wal_fsync: bool = False
     # Deadline-bounded Pythia dispatch on in-process replicas. The router
     # already owns wedged-replica semantics (health check -> mark down ->
     # failover), so the per-suggest dispatch thread the deadline path
@@ -68,6 +75,7 @@ class DistributedConfig:
                     DEFAULT_SNAPSHOT_INTERVAL,
                 ),
             ),
+            wal_fsync=_registry.env_on("VIZIER_DISTRIBUTED_WAL_FSYNC"),
         )
 
     def as_dict(self) -> dict:

@@ -168,7 +168,9 @@ class ReplicaManager:
         if self._wal_root:
             wal_dir = os.path.join(self._wal_root, replica_id)
             datastore = wal_lib.PersistentDataStore(
-                wal_dir, snapshot_interval=self.config.snapshot_interval
+                wal_dir,
+                snapshot_interval=self.config.snapshot_interval,
+                fsync=self.config.wal_fsync,
             )
         else:
             datastore = ram_datastore.NestedDictRAMDataStore()
@@ -256,6 +258,12 @@ class ReplicaManager:
                 self._failed_over.add(replica_id)
             self.router.mark_down(replica_id)
             restored = self._restore_from_wal(replica)
+            if replica.wal_dir:
+                # Its studies now live on successors: a live-replica
+                # ListStudies fan-out is complete again. RAM-only replicas
+                # stay unaccounted — their studies are gone, and listings
+                # keep failing loudly rather than silently shrinking.
+                self._stub.note_failed_over(replica_id)
         # Counter updates outside the failover lock: metric locks must not
         # nest under tier mutexes (serving-stack convention, enforced by
         # the chaos soak's runtime lock-order cross-check).
@@ -289,8 +297,10 @@ class ReplicaManager:
 
         Studies that failed over while it was down are copied back from
         their interim successors (and deleted there so the owner is unique
-        again). Assumes quiesced traffic for the handback window — the
-        copy-back is not a transactional migration.
+        again); studies DELETED while it was down exist on no successor
+        and are deleted from the rebuilt store too, not resurrected from
+        its stale WAL. Assumes quiesced traffic for the handback window —
+        the copy-back is not a transactional migration.
         """
         from vizier_tpu.reliability import config as reliability_config_lib
         from vizier_tpu.service import vizier_service
@@ -319,7 +329,14 @@ class ReplicaManager:
         self.router.mark_up(replica_id)
 
     def _copy_back_from_successors(self, fresh: Replica) -> None:
-        """Moves studies the revived replica will own back from successors."""
+        """Moves studies the revived replica will own back from successors.
+
+        Successor CURRENT state, not WAL history, is what comes back — so
+        after the copy, any study the revived replica rebuilt from its own
+        (stale) WAL that exists on NO live successor was deleted while the
+        replica was down, and is deleted from the fresh store too rather
+        than resurrected.
+        """
         revived_id = fresh.replica_id
         with self._lock:
             others = [
@@ -327,11 +344,13 @@ class ReplicaManager:
                 for rid, r in self._replicas.items()
                 if rid != revived_id and r.alive
             ]
+        on_successors: set = set()
         for successor in others:
             inner = getattr(successor.datastore, "_inner", successor.datastore)
             moved: set = set()
             for opcode, payload in wal_lib.export_records(inner):
                 study_key = wal_lib.study_key_of(opcode, payload)
+                on_successors.add(study_key)
                 # Full ranking (liveness-blind): will this study route to
                 # the revived replica once it is marked up again?
                 if self.router.ranking(study_key)[0] != revived_id:
@@ -343,6 +362,20 @@ class ReplicaManager:
                     successor.datastore.delete_study(study_key)
                 except Exception:  # already gone / never fully copied
                     pass
+        fresh_inner = getattr(fresh.datastore, "_inner", fresh.datastore)
+        for opcode, payload in wal_lib.export_records(fresh_inner):
+            if opcode != wal_lib.CREATE_STUDY:
+                continue
+            study_key = wal_lib.study_key_of(opcode, payload)
+            if (
+                study_key in on_successors
+                or self.router.ranking(study_key)[0] != revived_id
+            ):
+                continue
+            try:
+                fresh.datastore.delete_study(study_key)
+            except Exception:  # pragma: no cover - already gone
+                pass
 
     # -- failure detection -------------------------------------------------
 

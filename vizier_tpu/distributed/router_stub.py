@@ -10,7 +10,11 @@ Per-method routing keys come from the request protos themselves (study
 ``name``/``parent`` fields, trial and operation names parsed back to their
 study), so the router needs no out-of-band placement metadata. The one
 owner-scoped RPC, ``ListStudies``, fans out across live replicas and
-merges.
+merges — and is LOUD about partiality: when a replica is down and nothing
+has declared its studies failed over to successors
+(:meth:`RoutedVizierStub.note_failed_over`, called by the manager after a
+WAL-restore), the fan-out raises a transport-shaped error instead of
+silently returning a subset.
 
 Failure handling: transport-shaped errors (``ConnectionError``, gRPC
 ``UNAVAILABLE``) are reported to the failure hook — a
@@ -122,6 +126,10 @@ class RoutedVizierStub:
         self._lock = threading.Lock()  # resolved-endpoint + failure tables
         self._resolved: Dict[str, Any] = {}
         self._consecutive_failures: Dict[str, int] = {}
+        # Down replicas whose studies ARE served elsewhere (WAL-restored
+        # onto successors): a ListStudies fan-out over the live set is
+        # still complete with these down.
+        self._failed_over: set = set()
         reg = registry or metrics_lib.MetricsRegistry()
         self._requests = reg.counter(
             "vizier_replica_requests", help="RPCs routed per replica."
@@ -165,6 +173,14 @@ class RoutedVizierStub:
             self._endpoint_spec[replica_id] = endpoint
             self._resolved.pop(replica_id, None)
             self._consecutive_failures.pop(replica_id, None)
+            # A restarted replica owns its studies again.
+            self._failed_over.discard(replica_id)
+
+    def note_failed_over(self, replica_id: str) -> None:
+        """Declares a down replica's studies restored onto successors, so
+        a live-replica ``ListStudies`` fan-out counts as complete."""
+        with self._lock:
+            self._failed_over.add(replica_id)
 
     def _note_success(self, replica_id: str) -> None:
         with self._lock:
@@ -209,8 +225,26 @@ class RoutedVizierStub:
         return call
 
     def _list_studies(self, request):
+        live = self.router.live_replicas()
+        with self._lock:
+            failed_over = set(self._failed_over)
+        unaccounted = [
+            rid
+            for rid in self.router.replica_ids
+            if rid not in live and rid not in failed_over
+        ]
+        if unaccounted:
+            # A silent subset would read as "those studies don't exist";
+            # fail transport-shaped instead so the caller's retry machinery
+            # re-lists once failover has restored the studies (or surfaces
+            # a loud error when nothing will).
+            raise ConnectionError(
+                "ListStudies would be partial: replica(s) "
+                f"{', '.join(unaccounted)} are down and their studies have "
+                "not been failed over to successors."
+            )
         response = vizier_service_pb2.ListStudiesResponse()
-        for replica_id in self.router.live_replicas():
+        for replica_id in live:
             self._requests.inc(replica=replica_id, method="ListStudies")
             endpoint = self._endpoint(replica_id)
             try:

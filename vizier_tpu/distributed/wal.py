@@ -12,14 +12,20 @@ truncated.
 Durability protocol (one writer per directory):
 
 - ``wal.log``      — active log: ``[u32 length][u32 crc32][payload]``
-  records, appended + flushed per mutation.
+  records, appended + flushed per mutation. A flush hands the record to
+  the OS, so by default an acknowledged mutation survives a PROCESS
+  crash only — an OS crash / power loss may still drop flushed-but-
+  unsynced tail records. ``VIZIER_DISTRIBUTED_WAL_FSYNC=1`` (or
+  ``fsync=True``) adds an fsync per append, extending the guarantee to
+  OS crashes at a per-mutation disk-sync cost.
 - ``snapshot.bin`` — last compaction, same record framing. Written to
-  ``snapshot.bin.tmp`` + fsync + atomic rename, THEN the log is truncated.
+  ``snapshot.bin.tmp`` + fsync + atomic rename, THEN the log is truncated
+  (snapshots are always fsynced, in both modes).
 
 Crash windows:
 
 - mid-append: the torn tail record fails its length/CRC check and is
-  dropped on replay (the mutation was never acknowledged durable);
+  dropped on replay (the mutation was never acknowledged);
 - mid-snapshot-write: the tmp file is ignored; old snapshot + full log
   still replay;
 - after the snapshot rename but before the log truncate: replay applies
@@ -99,10 +105,11 @@ def study_key_of(opcode: int, payload: bytes) -> str:
 class WriteAheadLog:
     """Append-only mutation log with atomic snapshot compaction."""
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, *, fsync: bool = False):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()  # file handle + counters only
+        self._fsync = fsync
         self._log_path = os.path.join(directory, LOG_FILE)
         self._snapshot_path = os.path.join(directory, SNAPSHOT_FILE)
         self._log = open(self._log_path, "ab")
@@ -151,6 +158,8 @@ class WriteAheadLog:
         with self._lock:
             self._log.write(frame)
             self._log.flush()
+            if self._fsync:
+                os.fsync(self._log.fileno())
             self._appended += 1
 
     @property
@@ -162,7 +171,8 @@ class WriteAheadLog:
         """Snapshot records + live log records, in apply order.
 
         Second element reports whether a torn/corrupt log tail was dropped
-        (a crash mid-append; the dropped mutation was never durable).
+        (a crash mid-append, or — without per-append fsync — an OS crash
+        that lost flushed-but-unsynced tail records).
         """
         snapshot_records, snapshot_torn = self._read_records(self._snapshot_path)
         if snapshot_torn:
@@ -201,6 +211,12 @@ class WriteAheadLog:
                 pass
 
 
+class StoreDivergedError(RuntimeError):
+    """The RAM state and the WAL no longer agree (a log write failed after
+    its mutation was applied); the store fail-stops rather than serve
+    state a restart would silently revert."""
+
+
 class PersistentDataStore(datastore_lib.DataStore):
     """RAM datastore + snapshot/WAL durability (one writer per directory)."""
 
@@ -209,20 +225,25 @@ class PersistentDataStore(datastore_lib.DataStore):
         directory: str,
         *,
         snapshot_interval: Optional[int] = None,
+        fsync: Optional[bool] = None,
         inner: Optional[ram_datastore.NestedDictRAMDataStore] = None,
     ):
         from vizier_tpu.distributed import config as config_lib
 
+        env = config_lib.DistributedConfig.from_env()
         self._inner = inner or ram_datastore.NestedDictRAMDataStore()
-        self._wal = WriteAheadLog(directory)
+        self._wal = WriteAheadLog(
+            directory, fsync=env.wal_fsync if fsync is None else fsync
+        )
         self._snapshot_interval = (
             snapshot_interval
             if snapshot_interval is not None
-            else config_lib.DistributedConfig.from_env().snapshot_interval
+            else env.snapshot_interval
         )
         # Serializes apply+append so log order == apply order; nests over
         # the inner store's lock and the WAL file lock only.
         self._lock = threading.Lock()
+        self._diverged: Optional[str] = None
         records, self.recovered_torn_tail = self._wal.load()
         self.recovered_records = len(records)
         for opcode, payload in records:
@@ -234,20 +255,40 @@ class PersistentDataStore(datastore_lib.DataStore):
     def wal(self) -> WriteAheadLog:
         return self._wal
 
+    def _check_converged(self) -> None:
+        if self._diverged is not None:
+            raise StoreDivergedError(self._diverged)
+
     def _mutate(self, fn, opcode: int, payload: bytes):
         """Applies ``fn`` to the inner store, then logs it (apply-then-log:
         a rejected mutation — duplicate create, missing target — raises
-        before anything reaches the log)."""
+        before anything reaches the log).
+
+        A FAILED log write after the apply is a fail-stop: the RAM state
+        now holds a mutation the WAL lost, so instead of serving state a
+        restart would silently revert, the store poisons itself and every
+        subsequent operation raises :class:`StoreDivergedError`.
+        """
         with self._lock:
+            self._check_converged()
             result = fn()
-            self._wal.append(opcode, payload)
-            if self._wal.appended_since_snapshot >= self._snapshot_interval:
-                self._wal.compact(export_records(self._inner))
+            try:
+                self._wal.append(opcode, payload)
+                if self._wal.appended_since_snapshot >= self._snapshot_interval:
+                    self._wal.compact(export_records(self._inner))
+            except BaseException as e:
+                self._diverged = (
+                    f"WAL write failed after the mutation was applied "
+                    f"({type(e).__name__}: {e}); RAM and log have diverged "
+                    f"— restart the replica to recover to the logged state."
+                )
+                raise
         return result
 
     def compact_now(self) -> None:
         """Forces a snapshot compaction (tests, graceful shutdown)."""
         with self._lock:
+            self._check_converged()
             self._wal.compact(export_records(self._inner))
 
     def close(self) -> None:
@@ -263,6 +304,7 @@ class PersistentDataStore(datastore_lib.DataStore):
         )
 
     def load_study(self, study_name):
+        self._check_converged()
         return self._inner.load_study(study_name)
 
     def update_study(self, study):
@@ -280,6 +322,7 @@ class PersistentDataStore(datastore_lib.DataStore):
         )
 
     def list_studies(self, owner_name):
+        self._check_converged()
         return self._inner.list_studies(owner_name)
 
     # -- trials ------------------------------------------------------------
@@ -292,6 +335,7 @@ class PersistentDataStore(datastore_lib.DataStore):
         )
 
     def get_trial(self, trial_name):
+        self._check_converged()
         return self._inner.get_trial(trial_name)
 
     def update_trial(self, trial):
@@ -309,9 +353,11 @@ class PersistentDataStore(datastore_lib.DataStore):
         )
 
     def list_trials(self, study_name, *, states=None):
+        self._check_converged()
         return self._inner.list_trials(study_name, states=states)
 
     def max_trial_id(self, study_name):
+        self._check_converged()
         return self._inner.max_trial_id(study_name)
 
     # -- suggestion operations --------------------------------------------
@@ -324,6 +370,7 @@ class PersistentDataStore(datastore_lib.DataStore):
         )
 
     def get_suggestion_operation(self, operation_name):
+        self._check_converged()
         return self._inner.get_suggestion_operation(operation_name)
 
     def update_suggestion_operation(self, operation):
@@ -336,11 +383,13 @@ class PersistentDataStore(datastore_lib.DataStore):
     def list_suggestion_operations(
         self, study_name, client_id, filter_fn=None, *, done=None
     ):
+        self._check_converged()
         return self._inner.list_suggestion_operations(
             study_name, client_id, filter_fn, done=done
         )
 
     def max_suggestion_operation_number(self, study_name, client_id):
+        self._check_converged()
         return self._inner.max_suggestion_operation_number(study_name, client_id)
 
     # -- early stopping operations ----------------------------------------
@@ -353,6 +402,7 @@ class PersistentDataStore(datastore_lib.DataStore):
         )
 
     def get_early_stopping_operation(self, operation_name):
+        self._check_converged()
         return self._inner.get_early_stopping_operation(operation_name)
 
     def update_early_stopping_operation(self, operation):

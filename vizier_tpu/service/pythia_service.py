@@ -9,6 +9,7 @@ by design, unlike the reference's ``jax_enable_x64`` at ``:50-57``.)
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 import traceback
@@ -214,8 +215,13 @@ class PythiaServicer:
         # falling back would serve a misconfigured study forever.
         try:
             config = self._parsed_study_config(request)
-            config.algorithm = request.algorithm or config.algorithm
-            policy = self._get_policy(config, config.algorithm, request.study_name)
+            algorithm = request.algorithm or config.algorithm
+            if algorithm != config.algorithm:
+                # The cached config is shared across requests (and threads):
+                # a per-request algorithm override goes on a shallow copy so
+                # it never leaks into later requests for the same study.
+                config = dataclasses.replace(config, algorithm=algorithm)
+            policy = self._get_policy(config, algorithm, request.study_name)
             descriptor = vz.StudyDescriptor(
                 config=config,
                 guid=request.study_descriptor.guid,
