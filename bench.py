@@ -100,6 +100,13 @@ def _static_flop_budget(
 _PEAK_FLOPS = {"tpu": 49.0e12, "cpu": 50.0e9}
 
 
+def _surrogate_env_config() -> dict:
+    """The process-wide VIZIER_SPARSE* config, for artifact provenance."""
+    from vizier_tpu.surrogates import SurrogateConfig
+
+    return SurrogateConfig.from_env().as_dict()
+
+
 def main() -> None:
     backend_tag = None
     platforms = os.environ.get("JAX_PLATFORMS", "")
@@ -346,6 +353,16 @@ def main() -> None:
         # plus one split across the rest (~2 sweeps per suggest) — r1-r3
         # e2e numbers spent a full budget on EVERY pick (25 sweeps).
         "e2e_budget_policy": designer.acquisition_budget_policy,
+        # Which surrogate path produced these numbers: bench drives the
+        # exact-GP device programs directly (and the DEFAULT UCB-PE
+        # designer for e2e, which has no sparse path), so the measured
+        # mode is always "exact"; the env config rides along so future
+        # artifacts that DO auto-switch are distinguishable
+        # (tools/surrogate_ab.py measures the sparse path).
+        "surrogates": {
+            "active_mode": "exact",
+            **_surrogate_env_config(),
+        },
     }
     if backend_tag:
         line["backend"] = backend_tag

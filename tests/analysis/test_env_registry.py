@@ -53,11 +53,12 @@ class TestRealTree:
                 )
 
     def test_registry_covers_the_trees_switch_count(self):
-        # 25 in-tree env switches (incl. the 5 VIZIER_DISTRIBUTED* tier
-        # knobs) + 3 bench switches + the 2 reserved grpc constants.
-        # Growing the tree means growing this registry.
-        assert len(registry.SWITCHES) == 30
-        assert len(registry.env_switch_names()) == 28
+        # 30 in-tree env switches (incl. the 6 VIZIER_DISTRIBUTED* tier
+        # knobs and the 4 VIZIER_SPARSE* surrogate knobs) + 3 bench
+        # switches + the 2 reserved grpc constants. Growing the tree means
+        # growing this registry.
+        assert len(registry.SWITCHES) == 35
+        assert len(registry.env_switch_names()) == 33
 
     def test_known_switches_declared(self):
         for name in (
@@ -66,6 +67,8 @@ class TestRealTree:
             "VIZIER_RELIABILITY",
             "VIZIER_OBSERVABILITY",
             "VIZIER_BENCH_SCALE",
+            "VIZIER_SPARSE",
+            "VIZIER_DISTRIBUTED_ROUTE_CACHE_SIZE",
         ):
             assert registry.declared(name)
         assert registry.BY_NAME["VIZIER_METHODS"].kind == "constant"

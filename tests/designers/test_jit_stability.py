@@ -120,6 +120,111 @@ class TestGPUCBPEJitStability:
         assert gp_ucb_pe_lib._suggest_batch._cache_size() == sweep_base + 1
 
 
+class TestSparseJitStability:
+    """The sparse programs compile once per (n-bucket, m-bucket) pair."""
+
+    def _sparse_designer(self, seed, num_inducing=6):
+        from vizier_tpu.surrogates import SurrogateConfig
+
+        cfg = SurrogateConfig(
+            sparse_threshold_trials=1, hysteresis_trials=0,
+            num_inducing=num_inducing,
+        )
+        return gp_bandit_lib.VizierGPBandit(
+            _problem(), rng_seed=seed, surrogate=cfg, num_seed_trials=1,
+            **_FAST,
+        )
+
+    def test_stable_within_bucket_one_retrace_at_n_boundary(self):
+        from vizier_tpu.surrogates import sparse_bandit
+
+        fns = (
+            sparse_bandit._train_sparse_gp,
+            sparse_bandit._maximize_sparse_acquisition,
+        )
+        designer = self._sparse_designer(seed=0)
+        designer.update(core_lib.CompletedTrials(_trials(1, 4, seed=0)))
+        designer.suggest(1)
+        assert designer.surrogate_mode == "sparse"
+        baseline = _cache_sizes(fns)
+
+        # Growing 4 -> 8 trials stays inside the pad_trials=8 bucket (the
+        # m-bucket is fixed at 8 inducing slots): no retrace allowed.
+        for step in range(4):
+            designer.update(
+                core_lib.CompletedTrials(_trials(5 + step, 1, seed=10 + step))
+            )
+            designer.suggest(1)
+            assert _cache_sizes(fns) == baseline, (
+                f"sparse retrace inside padding bucket at {5 + step} trials"
+            )
+
+        # Trial 9 crosses into the pad_trials=16 n-bucket: exactly one new
+        # entry per program.
+        designer.update(core_lib.CompletedTrials(_trials(9, 1, seed=99)))
+        designer.suggest(1)
+        grown = _cache_sizes(fns)
+        assert grown == tuple(b + 1 for b in baseline), (
+            f"n-bucket boundary must add exactly one entry: {baseline} -> {grown}"
+        )
+
+        # And the new (n, m) pair is itself stable.
+        designer.update(core_lib.CompletedTrials(_trials(10, 1, seed=100)))
+        designer.suggest(1)
+        assert _cache_sizes(fns) == grown
+
+    def test_m_bucket_boundary_and_same_bucket_m_values(self):
+        from vizier_tpu.surrogates import sparse_bandit
+
+        train = sparse_bandit._train_sparse_gp
+        base = self._sparse_designer(seed=1, num_inducing=6)
+        base.update(core_lib.CompletedTrials(_trials(1, 4, seed=1)))
+        base.suggest(1)
+        size = train._cache_size()
+
+        # m=7 pads to the SAME 8-slot m-bucket as m=6: one shared program.
+        same_bucket = self._sparse_designer(seed=2, num_inducing=7)
+        same_bucket.update(core_lib.CompletedTrials(_trials(1, 4, seed=2)))
+        same_bucket.suggest(1)
+        assert train._cache_size() == size, (
+            "m values inside one inducing bucket must share a program"
+        )
+
+        # m=12 pads to 16 slots: a new m-bucket, exactly one new entry.
+        new_bucket = self._sparse_designer(seed=3, num_inducing=12)
+        new_bucket.update(core_lib.CompletedTrials(_trials(1, 4, seed=3)))
+        new_bucket.suggest(1)
+        assert train._cache_size() == size + 1
+
+    def test_sparse_flush_program_stable_across_flushes_within_bucket(self):
+        from vizier_tpu.surrogates import sparse_bandit
+
+        def fresh(seed, n):
+            d = self._sparse_designer(seed)
+            d.update(core_lib.CompletedTrials(_trials(1, n, seed=seed)))
+            return d
+
+        def flush(seeds, n):
+            designers = [fresh(s, n) for s in seeds]
+            # Same calling convention as the executor: the bucket key
+            # refreshes each designer's surrogate mode before prepare.
+            keys = [d.batch_bucket_key(1) for d in designers]
+            assert len(set(keys)) == 1 and keys[0].kind == "gp_bandit_sparse"
+            items = [d.batch_prepare(1) for d in designers]
+            outs = designers[0].batch_execute(items, pad_to=len(items))
+            for d, i, o in zip(designers, items, outs):
+                d.batch_finalize(i, o)
+
+        program = sparse_bandit._sparse_flush_program
+        flush((40, 41), n=4)
+        size = program._cache_size()
+        flush((42, 43), n=5)  # same (n, m) bucket pair, different studies
+        assert program._cache_size() == size
+
+        flush((44, 45), n=9)  # n-bucket boundary: exactly one new entry
+        assert program._cache_size() == size + 1
+
+
 class TestBatchedProgramJitStability:
     def test_batched_programs_stable_across_flushes_within_bucket(self):
         # Two batched flushes over different studies in the same bucket

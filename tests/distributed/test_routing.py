@@ -107,3 +107,39 @@ class TestLiveness:
         router = make_router(2)
         router.mark_down("replica-1")
         assert router.snapshot() == {"replica-0": "up", "replica-1": "down"}
+
+
+class TestRouteCacheLRU:
+    """Million-study churn must not grow the placement cache unboundedly."""
+
+    def test_cache_bounded_at_cap(self):
+        router = make_router(route_cache_size=16)
+        for k in KEYS:  # 200 distinct studies through a 16-entry cache
+            router.replica_for(k)
+        assert len(router._route_cache) == 16
+
+    def test_lru_recency_keeps_hot_studies(self):
+        router = make_router(route_cache_size=4)
+        for k in KEYS[:4]:
+            router.replica_for(k)
+        router.replica_for(KEYS[0])  # touch: KEYS[0] becomes most-recent
+        router.replica_for(KEYS[4])  # evicts the LRU entry (KEYS[1])
+        assert KEYS[0] in router._route_cache
+        assert KEYS[1] not in router._route_cache
+
+    def test_evicted_study_reroutes_identically(self):
+        # Eviction costs a re-rank, never a different placement.
+        router = make_router(route_cache_size=2)
+        want = {k: router.replica_for(k) for k in KEYS[:50]}
+        for k in KEYS[:50]:
+            assert router.replica_for(k) == want[k]
+
+    def test_env_override_and_validation(self, monkeypatch):
+        monkeypatch.setenv("VIZIER_DISTRIBUTED_ROUTE_CACHE_SIZE", "8")
+        router = make_router()
+        assert router._route_cache_size == 8
+        with pytest.raises(ValueError):
+            make_router(route_cache_size=0)
+
+    def test_default_cap_is_large(self):
+        assert make_router()._route_cache_size == 65536

@@ -78,3 +78,27 @@ class TestBreakdown:
     def test_trace_tree_missing(self, tmp_path):
         spans = obs_report.load_spans(_trace_file(tmp_path))
         assert "No spans" in obs_report.render_trace(spans, "nope")
+
+
+class TestSurrogateActivity:
+    def _spans(self, names):
+        return [{"name": n, "duration_secs": 0.01} for n in names]
+
+    def test_exact_only(self):
+        act = obs_report.surrogate_activity(
+            self._spans(["gp_bandit.train_gp", "gp_ucb_pe.train_gp", "other"])
+        )
+        assert act == {"mode": "exact", "exact": 2, "sparse": 0}
+
+    def test_sparse_only(self):
+        act = obs_report.surrogate_activity(
+            self._spans(["sparse_gp.train", "sparse_gp.acquisition"])
+        )
+        assert act == {"mode": "sparse", "exact": 0, "sparse": 2}
+
+    def test_mixed_and_none(self):
+        mixed = obs_report.surrogate_activity(
+            self._spans(["sparse_gp.train", "gp_bandit.train_gp"])
+        )
+        assert mixed["mode"] == "mixed"
+        assert obs_report.surrogate_activity(self._spans(["rpc"]))["mode"] == "none"

@@ -1,0 +1,110 @@
+"""SurrogateConfig: validation, hysteresis semantics, env overrides."""
+
+import pytest
+
+from vizier_tpu.surrogates import SurrogateConfig
+from vizier_tpu.surrogates import config as config_lib
+
+
+class TestValidation:
+    def test_defaults_valid(self):
+        cfg = SurrogateConfig()
+        assert cfg.sparse
+        assert cfg.sparse_threshold_trials > cfg.hysteresis_trials
+
+    @pytest.mark.parametrize(
+        "kwargs",
+        [
+            dict(sparse_threshold_trials=0),
+            dict(sparse_threshold_trials=-5),
+            dict(hysteresis_trials=-1),
+            dict(num_inducing=0),
+        ],
+    )
+    def test_bad_knobs_rejected(self, kwargs):
+        with pytest.raises(ValueError):
+            SurrogateConfig(**kwargs)
+
+    def test_disabled_is_exact_everywhere(self):
+        cfg = SurrogateConfig.disabled()
+        assert not cfg.sparse
+        for n in (0, 10, 10_000):
+            assert cfg.mode_for(n) == config_lib.MODE_EXACT
+            assert (
+                cfg.mode_for(n, current=config_lib.MODE_SPARSE)
+                == config_lib.MODE_EXACT
+            )
+
+
+class TestModeFor:
+    def test_threshold_crossing(self):
+        cfg = SurrogateConfig(sparse_threshold_trials=50, hysteresis_trials=10)
+        assert cfg.mode_for(49) == config_lib.MODE_EXACT
+        assert cfg.mode_for(50) == config_lib.MODE_SPARSE
+        assert cfg.mode_for(500) == config_lib.MODE_SPARSE
+
+    def test_hysteresis_band_is_sticky(self):
+        cfg = SurrogateConfig(sparse_threshold_trials=50, hysteresis_trials=10)
+        # Inside [40, 50): a sparse study stays sparse, an exact study
+        # stays exact — the boundary cannot flap.
+        for n in range(40, 50):
+            assert cfg.mode_for(n, current=config_lib.MODE_SPARSE) == (
+                config_lib.MODE_SPARSE
+            )
+            assert cfg.mode_for(n, current=config_lib.MODE_EXACT) == (
+                config_lib.MODE_EXACT
+            )
+        # Below the band, sparse drops back to exact.
+        assert (
+            cfg.mode_for(39, current=config_lib.MODE_SPARSE)
+            == config_lib.MODE_EXACT
+        )
+
+    def test_zero_hysteresis(self):
+        cfg = SurrogateConfig(sparse_threshold_trials=8, hysteresis_trials=0)
+        assert cfg.mode_for(8, current=config_lib.MODE_SPARSE) == (
+            config_lib.MODE_SPARSE
+        )
+        assert cfg.mode_for(7, current=config_lib.MODE_SPARSE) == (
+            config_lib.MODE_EXACT
+        )
+
+
+class TestEnv:
+    def test_from_env_defaults(self, monkeypatch):
+        for name in (
+            "VIZIER_SPARSE",
+            "VIZIER_SPARSE_THRESHOLD",
+            "VIZIER_SPARSE_HYSTERESIS",
+            "VIZIER_SPARSE_INDUCING",
+        ):
+            monkeypatch.delenv(name, raising=False)
+        cfg = SurrogateConfig.from_env()
+        assert cfg == SurrogateConfig()
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("VIZIER_SPARSE_THRESHOLD", "100")
+        monkeypatch.setenv("VIZIER_SPARSE_HYSTERESIS", "7")
+        monkeypatch.setenv("VIZIER_SPARSE_INDUCING", "32")
+        cfg = SurrogateConfig.from_env()
+        assert cfg.sparse_threshold_trials == 100
+        assert cfg.hysteresis_trials == 7
+        assert cfg.num_inducing == 32
+
+    def test_master_off_switch(self, monkeypatch):
+        monkeypatch.setenv("VIZIER_SPARSE", "0")
+        cfg = SurrogateConfig.from_env()
+        assert not cfg.sparse
+        assert cfg.mode_for(10_000) == config_lib.MODE_EXACT
+
+    def test_as_dict_stampable(self):
+        d = SurrogateConfig().as_dict()
+        assert set(d) == {
+            "sparse",
+            "sparse_threshold_trials",
+            "hysteresis_trials",
+            "num_inducing",
+        }
+        import json
+
+        json.dumps(d)  # must be JSON-serializable for bench artifacts

@@ -90,6 +90,39 @@ def phase_breakdown(spans: List[dict]) -> List[dict]:
     return out
 
 
+# Device-phase span prefixes per surrogate path. jax_timing.device_phase
+# emits spans named "jax.<phase>" (phase names in designers/gp_bandit.py
+# and the surrogates callers); the bare prefixes also match raw phase rows
+# from a metrics dump fed back through this report.
+_SPARSE_PHASES = ("jax.sparse_gp.", "sparse_gp.")
+_EXACT_PHASES = ("jax.gp_bandit.", "jax.gp_ucb_pe.", "gp_bandit.", "gp_ucb_pe.")
+
+
+def surrogate_activity(spans: List[dict]) -> dict:
+    """Which surrogate path(s) produced this span file's device phases.
+
+    Counts device-phase spans by family so every report says whether its
+    numbers came from the exact O(n³) path, the sparse inducing-point
+    path, or a mix (auto-switched studies mid-file).
+    """
+    counts = {"exact": 0, "sparse": 0}
+    for span in spans:
+        name = span.get("name", "")
+        if any(name.startswith(p) for p in _SPARSE_PHASES):
+            counts["sparse"] += 1
+        elif any(name.startswith(p) for p in _EXACT_PHASES):
+            counts["exact"] += 1
+    if counts["sparse"] and counts["exact"]:
+        mode = "mixed"
+    elif counts["sparse"]:
+        mode = "sparse"
+    elif counts["exact"]:
+        mode = "exact"
+    else:
+        mode = "none"
+    return {"mode": mode, **counts}
+
+
 def render_table(rows: List[dict]) -> str:
     with_occ = any("mean_occupancy" in row for row in rows)
     header = f"{'phase':<34} {'count':>6} {'p50 ms':>9} {'p95 ms':>9} {'p99 ms':>9} {'max ms':>9} {'total ms':>10}"
@@ -156,10 +189,25 @@ def main() -> None:
         print(render_trace(spans, args.trace))
         return
     rows = phase_breakdown(spans)
+    activity = surrogate_activity(spans)
     if args.json:
-        print(json.dumps({"spans": len(spans), "phases": rows}, indent=2))
+        print(
+            json.dumps(
+                {
+                    "spans": len(spans),
+                    "surrogate_activity": activity,
+                    "phases": rows,
+                },
+                indent=2,
+            )
+        )
     else:
         print(f"{len(spans)} spans")
+        print(
+            f"surrogate mode: {activity['mode']} "
+            f"(exact device phases: {activity['exact']}, "
+            f"sparse: {activity['sparse']})"
+        )
         print(render_table(rows))
 
 

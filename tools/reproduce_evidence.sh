@@ -30,6 +30,12 @@ echo "== 3b. failover chaos: kill one replica mid-study (~1 min) =="
 #    lock-order cross-check (router/WAL locks vs the static graph)
 JAX_PLATFORMS=cpu python tools/chaos_ab.py --distributed 4 --instrument-locks
 
+echo "== 3c. sparse-surrogate A/B at the north-star scale (~10 min) =="
+#    -> SPARSE_AB.json: sparse SGPR vs exact O(n^3) device-side suggest
+#    p50 at 1000x20-D (target >= 10x), rank-sum regret parity at 5
+#    seeds, and the VIZIER_SPARSE=0 bit-identity check
+JAX_PLATFORMS=cpu python tools/surrogate_ab.py
+
 echo "== 4. budget-policy A/B, 5 seeds x 3 families (~45 min) =="
 #    -> budget_ab_r5.json
 JAX_PLATFORMS=cpu python tools/budget_policy_ab.py

@@ -118,6 +118,18 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             _RUN_DOC, "Mutations per shard between WAL compactions.", "256"),
     _switch("VIZIER_DISTRIBUTED_WAL_FSYNC", "flag", "DistributedConfig",
             _RUN_DOC, "fsync the WAL per append (power-loss durability).", "0"),
+    _switch("VIZIER_DISTRIBUTED_ROUTE_CACHE_SIZE", "int", "StudyRouter",
+            _RUN_DOC, "LRU cap on the router's placement cache.", "65536"),
+    # -- surrogates (SurrogateConfig) --------------------------------------
+    _switch("VIZIER_SPARSE", "flag", "SurrogateConfig", _PERF_DOC,
+            "Sparse-GP surrogate auto-switch (off = exact GP always).", "1"),
+    _switch("VIZIER_SPARSE_THRESHOLD", "int", "SurrogateConfig", _PERF_DOC,
+            "Completed trials at which a study turns sparse.", "512"),
+    _switch("VIZIER_SPARSE_HYSTERESIS", "int", "SurrogateConfig", _PERF_DOC,
+            "Trial hysteresis before a sparse study returns to exact.", "64"),
+    _switch("VIZIER_SPARSE_INDUCING", "int", "SurrogateConfig", _PERF_DOC,
+            "Inducing-point budget m (padded to the trial bucket grid).",
+            "128"),
     # -- designers ---------------------------------------------------------
     _switch("VIZIER_DISABLE_MESH", "flag", "GPBanditDesigner", _SWITCH_DOC,
             "Opt out of the multi-device auto-mesh (set = disabled).", "0"),

@@ -40,6 +40,9 @@ from vizier_tpu.optimizers import eagle as eagle_lib
 from vizier_tpu.optimizers import lbfgs as lbfgs_lib
 from vizier_tpu.observability import jax_timing
 from vizier_tpu.optimizers import vectorized as vectorized_lib
+from vizier_tpu.surrogates import config as surrogate_config_lib
+from vizier_tpu.surrogates import sparse_bandit
+from vizier_tpu.surrogates import sparse_gp
 from vizier_tpu.pyvizier import base_study_config
 from vizier_tpu.pyvizier import trial as trial_
 from vizier_tpu.utils import profiler
@@ -316,6 +319,13 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
     # more than one exists and route ARD restarts + acquisition pools through
     # vizier_tpu.parallel); True/False force it on/off.
     use_mesh: Optional[bool] = None
+    # Scalable-surrogate auto-switch (vizier_tpu.surrogates): above the
+    # config's trial threshold the single-objective suggest path trains an
+    # SGPR sparse posterior (O(n·m²)) instead of the exact GP (O(n³)), with
+    # hysteresis at the boundary. None (and SurrogateConfig(sparse=False))
+    # keep the exact path everywhere — bit-identical to the seed. The
+    # serving runtime threads its process-wide config in here.
+    surrogate: Optional[surrogate_config_lib.SurrogateConfig] = None
 
     def __post_init__(self):
         if self.problem.search_space.is_conditional:
@@ -389,6 +399,14 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         # and the warm/cold accounting below.
         self._warm_is_trained = False
         self._ard_train_counts = {"warm": 0, "cold": 0}
+        # Sparse-surrogate auto-switch state (vizier_tpu.surrogates): the
+        # mode is sticky (hysteresis) and a crossover drops all warm/
+        # posterior state so neither surrogate ever trains from the
+        # other's optimum (see _refresh_surrogate_mode).
+        self._surrogate_mode = surrogate_config_lib.MODE_EXACT
+        self._sparse_model_cache: Optional[sparse_gp.SparseGaussianProcess] = None
+        self._last_sparse_state: Optional[sparse_gp.SparseGPState] = None
+        self._surrogate_counts = {"sparse_suggests": 0, "crossovers": 0}
 
     # -- Designer ----------------------------------------------------------
 
@@ -473,6 +491,124 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         """Copies of the warm/cold ARD train counters (serving stats)."""
         return dict(self._ard_train_counts)
 
+    # -- scalable-surrogate auto-switch (vizier_tpu.surrogates) -------------
+
+    @property
+    def surrogate_mode(self) -> str:
+        """The active surrogate mode ("exact" | "sparse")."""
+        return self._surrogate_mode
+
+    @property
+    def surrogate_counts(self) -> dict:
+        """Copies of the sparse-suggest / crossover counters (serving stats)."""
+        return dict(self._surrogate_counts)
+
+    def sparse_inducing_state(self) -> Optional[sparse_gp.SparseGPState]:
+        """The last trained sparse posterior (inducing set + factorization);
+        None on the exact path or before the first sparse train."""
+        return self._last_sparse_state
+
+    def _sparse_model(self) -> sparse_gp.SparseGaussianProcess:
+        if self._sparse_model_cache is None:
+            # m rides the SAME bucket grid as trial counts so every
+            # (n-bucket, m-bucket) pair is one compiled program family.
+            m_pad = self._converter.padding.pad_trials(
+                self.surrogate.num_inducing
+            )
+            self._sparse_model_cache = sparse_gp.SparseGaussianProcess(
+                base=self._model, num_inducing=m_pad
+            )
+        return self._sparse_model_cache
+
+    def _refresh_surrogate_mode(self) -> str:
+        """Applies the auto-switch for the current trial count.
+
+        A crossover (either direction) drops every piece of cross-surrogate
+        state: the warm ARD seed is re-randomized (a fresh placeholder keeps
+        the train program's pytree structure stable) and the cached
+        posterior cleared, so stale exact-GP params can never seed — or be
+        served from — the sparse posterior, and vice versa. The next train
+        after a crossover is therefore a full-budget cold train.
+        """
+        cfg = self.surrogate
+        if cfg is None:
+            return self._surrogate_mode
+        mode = cfg.mode_for(len(self._trials), current=self._surrogate_mode)
+        if mode != self._surrogate_mode:
+            self._surrogate_mode = mode
+            self._surrogate_counts["crossovers"] += 1
+            self._warm_params = (
+                self._model.param_collection().random_init_unconstrained(
+                    jax.random.PRNGKey(
+                        self.rng_seed + 1 + self._surrogate_counts["crossovers"]
+                    )
+                )
+            )
+            self._warm_is_trained = False
+            self._last_predictive = None
+            self._last_sparse_state = None
+        return mode
+
+    def _suggest_sparse(self, count: int) -> List[trial_.TrialSuggestion]:
+        """The sparse twin of the single-objective suggest: SGPR collapsed-
+        bound train (k-center inducing selection inside the program) + the
+        same UCB/EI + trust-region eagle sweep over the sparse posterior.
+        Consumes the RNG stream in the exact order of the exact path (train
+        key, then acquisition key)."""
+        with profiler.timeit("convert_trials"):
+            data = gp_lib.GPData.from_model_data(self._warped_model_data())
+        model = self._sparse_model()
+        restarts = max(
+            self._warm_restart_budget() or self.ard_restarts, self.ensemble_size
+        )
+        with profiler.timeit("train_gp"):
+            with jax_timing.device_phase("sparse_gp.train") as phase:
+                states = sparse_bandit._train_sparse_gp(
+                    model,
+                    self._ard,
+                    data,
+                    self._next_rng(),
+                    restarts,
+                    self.ensemble_size,
+                    self._warm_params,
+                )
+                phase.block(states)
+        self._record_train()
+        if self._warm_update_allowed():
+            coll = self._model.param_collection()
+            self._warm_params = coll.unconstrain(
+                jax.tree_util.tree_map(lambda a: a[0], states.params)
+            )
+            self._warm_is_trained = True
+        predictive = sparse_gp.SparseEnsemblePredictive(states)
+        self._last_predictive = predictive
+        self._last_sparse_state = states
+        best_label = jnp.max(jnp.where(data.row_mask, data.labels, -jnp.inf))
+        trust = (
+            acquisitions.TrustRegion.from_data(data)
+            if self.use_trust_region
+            else None
+        )
+        scoring = acquisitions.ScoringFunction(
+            predictive=predictive,
+            acquisition=self._make_acquisition(),
+            best_label=best_label,
+            trust_region=trust,
+        )
+        prior = self._prior_features(data)
+        with profiler.timeit("acquisition_optimizer"):
+            with jax_timing.device_phase("sparse_gp.acquisition") as phase:
+                result = sparse_bandit._maximize_sparse_acquisition(
+                    self._vec_opt, scoring, self._next_rng(), count, prior
+                )
+                jax.block_until_ready(result.scores)
+                phase.block(result)
+        self._surrogate_counts["sparse_suggests"] += 1
+        with profiler.timeit("best_candidates_to_trials"):
+            return self._decode_result(
+                result, count, kind=f"{self.acquisition}+sparse"
+            )
+
     # -- cross-study batch protocol (vizier_tpu.parallel.batch_executor) ----
 
     def _batch_restarts(self) -> int:
@@ -501,6 +637,28 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             return None
         from vizier_tpu.parallel import batch_executor
 
+        if self._refresh_surrogate_mode() == surrogate_config_lib.MODE_SPARSE:
+            # Sparse studies batch among themselves: the sparse model (with
+            # its padded inducing-slot count — the m-bucket) rides in the
+            # statics, so equal keys ⇒ one compiled _sparse_flush_program
+            # per (n-bucket, m-bucket) pair.
+            return batch_executor.BucketKey(
+                kind="gp_bandit_sparse",
+                pad_trials=self._converter.padding.pad_trials(len(self._trials)),
+                cont_width=self._cont_width,
+                cat_width=self._cat_width,
+                metric_count=1,
+                count=count,
+                statics=(
+                    self._sparse_model(),
+                    self._ard,
+                    self._vec_opt,
+                    self._batch_restarts(),
+                    self.ensemble_size,
+                    self._make_acquisition(),
+                    self.use_trust_region,
+                ),
+            )
         return batch_executor.BucketKey(
             kind="gp_bandit",
             pad_trials=self._converter.padding.pad_trials(len(self._trials)),
@@ -539,6 +697,9 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             rng_acq=self._next_rng(),
             warm=self._warm_params,
             restarts=self._batch_restarts(),
+            # The bucket key (computed just before prepare) already refreshed
+            # the auto-switch; equal keys guarantee a whole bucket agrees.
+            sparse=self._surrogate_mode == surrogate_config_lib.MODE_SPARSE,
         )
 
     @classmethod
@@ -552,14 +713,31 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
             [it[name] for it in items], pad_to
         )
-        with jax_timing.device_phase("gp_bandit.suggest_batched") as phase:
-            states, warm_next, result = _gp_bandit_flush_program(
-                d0._model, d0._ard, d0._vec_opt, d0._make_acquisition(),
-                stack("md"), stack("rng_train"), stack("rng_acq"), stack("warm"),
-                items[0]["restarts"], d0.ensemble_size,
-                items[0]["count"], d0.use_trust_region,
-            )
-            phase.block(result)
+        sparse = bool(items[0].get("sparse"))
+        if sparse:
+            # The sparse twin of the fused flush below — same stages, SGPR
+            # posterior, its own device-phase bucket so
+            # vizier_jax_phase_seconds separates sparse from exact time.
+            with jax_timing.device_phase("sparse_gp.suggest_batched") as phase:
+                states, warm_next, result = sparse_bandit._sparse_flush_program(
+                    d0._sparse_model(), d0._ard, d0._vec_opt,
+                    d0._make_acquisition(),
+                    stack("md"), stack("rng_train"), stack("rng_acq"),
+                    stack("warm"),
+                    items[0]["restarts"], d0.ensemble_size,
+                    items[0]["count"], d0.use_trust_region,
+                )
+                phase.block(result)
+        else:
+            with jax_timing.device_phase("gp_bandit.suggest_batched") as phase:
+                states, warm_next, result = _gp_bandit_flush_program(
+                    d0._model, d0._ard, d0._vec_opt, d0._make_acquisition(),
+                    stack("md"), stack("rng_train"), stack("rng_acq"),
+                    stack("warm"),
+                    items[0]["restarts"], d0.ensemble_size,
+                    items[0]["count"], d0.use_trust_region,
+                )
+                phase.block(result)
         # ONE device->host fetch for the whole batch; per-slot demux is then
         # free numpy views (per-slot device slices would be ~20 dispatches
         # per slot and dominated the executor's wall time).
@@ -569,6 +747,7 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
                 states=batch_executor.slice_pytree(states, i),
                 warm_next=batch_executor.slice_pytree(warm_next, i),
                 result=batch_executor.slice_pytree(result, i),
+                sparse=sparse,
             )
             for i in range(len(items))
         ]
@@ -582,10 +761,15 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             # The unconstrain already ran (vmapped) inside the flush program.
             self._warm_params = output["warm_next"]
             self._warm_is_trained = True
-        self._last_predictive = gp_lib.EnsemblePredictive(states)
-        return self._decode_result(
-            output["result"], item["count"], kind=self.acquisition
-        )
+        if output.get("sparse"):
+            self._last_predictive = sparse_gp.SparseEnsemblePredictive(states)
+            self._last_sparse_state = states
+            self._surrogate_counts["sparse_suggests"] += 1
+            kind = f"{self.acquisition}+sparse"
+        else:
+            self._last_predictive = gp_lib.EnsemblePredictive(states)
+            kind = self.acquisition
+        return self._decode_result(output["result"], item["count"], kind=kind)
 
     def _maximize(
         self,
@@ -674,6 +858,15 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             return self._suggest_multiobjective(count)
         if getattr(self, "_priors", None):
             return self._suggest_with_priors(count)
+        if (
+            self._refresh_surrogate_mode() == surrogate_config_lib.MODE_SPARSE
+            # Joint qEI optimizes the whole batch through predict_joint,
+            # which the collapsed sparse posterior does not expose — q-batch
+            # qEI studies stay exact rather than silently degrading to
+            # independent EI picks.
+            and not (self.acquisition == "qei" and count > 1)
+        ):
+            return self._suggest_sparse(count)
 
         with profiler.timeit("convert_trials"):
             data = gp_lib.GPData.from_model_data(self._warped_model_data())

@@ -21,6 +21,7 @@ way.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -46,6 +47,7 @@ class StudyRouter:
         replica_ids: Sequence[str],
         *,
         routing: bool = True,
+        route_cache_size: Optional[int] = None,
     ):
         if not replica_ids:
             raise ValueError("StudyRouter needs at least one replica id.")
@@ -59,10 +61,24 @@ class StudyRouter:
         # is pure given the liveness set, so a cached entry stays valid
         # until any replica changes state (the epoch bumps); this turns
         # the per-RPC route into a dict hit instead of N hashes + a sort.
-        # Grows one entry per distinct study served; callers with study
-        # churn in the millions should front it with an LRU.
+        # LRU-bounded (VIZIER_DISTRIBUTED_ROUTE_CACHE_SIZE) so million-study
+        # churn cannot grow it without bound: an evicted study just pays
+        # the N-hash ranking again on its next request.
+        if route_cache_size is None:
+            from vizier_tpu.analysis import registry as _registry
+
+            route_cache_size = _registry.env_int(
+                "VIZIER_DISTRIBUTED_ROUTE_CACHE_SIZE", 65536
+            )
+        if route_cache_size < 1:
+            raise ValueError(
+                f"route_cache_size must be >= 1, got {route_cache_size}."
+            )
+        self._route_cache_size = route_cache_size
         self._epoch = 0
-        self._route_cache: Dict[str, Tuple[int, str]] = {}
+        self._route_cache: "collections.OrderedDict[str, Tuple[int, str]]" = (
+            collections.OrderedDict()
+        )
 
     # -- placement ---------------------------------------------------------
 
@@ -86,6 +102,7 @@ class StudyRouter:
         with self._lock:
             cached = self._route_cache.get(study_key)
             if cached is not None and cached[0] == self._epoch:
+                self._route_cache.move_to_end(study_key)
                 return cached[1]
             down = set(self._down)
             epoch = self._epoch
@@ -94,6 +111,9 @@ class StudyRouter:
                 with self._lock:
                     if self._epoch == epoch:
                         self._route_cache[study_key] = (epoch, rid)
+                        self._route_cache.move_to_end(study_key)
+                        while len(self._route_cache) > self._route_cache_size:
+                            self._route_cache.popitem(last=False)
                 return rid
         raise NoLiveReplicaError(
             f"All {len(self._replica_ids)} replicas are down."

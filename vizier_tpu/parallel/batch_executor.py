@@ -485,6 +485,12 @@ class BatchExecutor:
                         if size == 1:
                             designers[0].suggest(count)
                         else:
+                            # Same calling convention as suggest() above: the
+                            # bucket key refreshes per-designer mode state
+                            # (e.g. the exact↔sparse surrogate auto-switch)
+                            # that batch_prepare snapshots into its item.
+                            for d in designers:
+                                d.batch_bucket_key(count)
                             items = [d.batch_prepare(count) for d in designers]
                             pad_to = (
                                 self.max_batch_size if self.pad_partial else None

@@ -37,6 +37,12 @@ class DefaultPolicyFactory:
         kwargs = {"use_warm_start_ard": cfg.warm_start}
         if cfg.warm_start:
             kwargs["warm_ard_restarts"] = cfg.warm_ard_restarts
+        # The process-wide exact↔sparse surrogate policy
+        # (vizier_tpu.surrogates): every GP designer the factory builds
+        # shares the runtime's auto-switch config.
+        surrogates = getattr(self._serving, "surrogates", None)
+        if surrogates is not None:
+            kwargs["surrogate"] = surrogates
         return kwargs
 
     def _gp_policy(

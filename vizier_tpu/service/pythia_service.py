@@ -36,6 +36,7 @@ class PythiaServicer:
         policy_factory=None,
         serving_config=None,
         reliability_config=None,
+        surrogate_config=None,
     ):
         from vizier_tpu.serving import runtime as serving_runtime_lib
 
@@ -44,9 +45,13 @@ class PythiaServicer:
         # per-study circuit breakers); ``serving_config`` (a
         # vizier_tpu.serving.ServingConfig) and ``reliability_config`` (a
         # vizier_tpu.reliability.ReliabilityConfig) disable parts or all of
-        # it. None -> defaults with env-var overrides.
+        # it; ``surrogate_config`` (a vizier_tpu.surrogates.SurrogateConfig)
+        # sets the exact↔sparse auto-switch every GP designer shares.
+        # None -> defaults with env-var overrides.
         self._serving = serving_runtime_lib.ServingRuntime(
-            serving_config, reliability=reliability_config
+            serving_config,
+            reliability=reliability_config,
+            surrogates=surrogate_config,
         )
         self._policy_factory = policy_factory or policy_factory_lib.DefaultPolicyFactory(
             serving_runtime=self._serving

@@ -41,6 +41,14 @@ class CachedDesignerEntry:
         # designer's ``warm_start_state()`` returns); None until the first
         # trained suggest.
         self.warm_params: Any = None
+        # Scalable-surrogate mirrors (vizier_tpu.surrogates): the active
+        # exact/sparse mode and the last trained sparse posterior (inducing
+        # set + factorization) — the inspection/hand-off surface, kept in
+        # lock-step with the live designer by the serving policy. Both die
+        # with the entry: DeleteStudy invalidation drops cached inducing
+        # state along with everything else.
+        self.surrogate_mode: Any = None
+        self.sparse_state: Any = None
         # Completed-trial ids already fed to the designer (incremental
         # updates only hand over the delta).
         self.incorporated_trial_ids: Set[int] = set()

@@ -95,6 +95,7 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
         ]
         active = self._supporter.GetTrials(status_matches=trial_.TrialStatus.ACTIVE)
         before = self._train_counts(designer)
+        surrogate_before = self._surrogate_counts(designer)
         with tracer.span(
             "designer.update",
             designer=type(designer).__name__,
@@ -121,12 +122,21 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
             else:
                 suggestions = list(designer.suggest(count))
         self._account_trains(before, self._train_counts(designer))
+        self._account_surrogate(
+            surrogate_before, self._surrogate_counts(designer)
+        )
         # Mirror the trained unconstrained ARD params into the entry: the
         # stats/inspection surface for "what would seed the next train",
         # and the hand-off if the designer is ever rebuilt around them.
         get_state = getattr(designer, "warm_start_state", None)
         if get_state is not None:
             entry.warm_params = get_state()
+        # Scalable-surrogate mirrors: the active mode and the cached
+        # inducing-point state (None on the exact path — a crossover back
+        # to exact clears it here too, so no stale sparse state lingers).
+        entry.surrogate_mode = getattr(designer, "surrogate_mode", None)
+        get_sparse = getattr(designer, "sparse_inducing_state", None)
+        entry.sparse_state = get_sparse() if get_sparse is not None else None
         entry.num_suggests += 1
         return suggestions
 
@@ -134,6 +144,24 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
     def _train_counts(designer: Any) -> Optional[dict]:
         counts = getattr(designer, "ard_train_counts", None)
         return dict(counts) if counts is not None else None
+
+    @staticmethod
+    def _surrogate_counts(designer: Any) -> Optional[dict]:
+        counts = getattr(designer, "surrogate_counts", None)
+        return dict(counts) if counts is not None else None
+
+    def _account_surrogate(
+        self, before: Optional[dict], after: Optional[dict]
+    ) -> None:
+        if before is None or after is None:
+            return
+        stats = self._runtime.stats
+        sparse = after.get("sparse_suggests", 0) - before.get("sparse_suggests", 0)
+        crossed = after.get("crossovers", 0) - before.get("crossovers", 0)
+        if sparse > 0:
+            stats.increment("sparse_suggests", sparse)
+        if crossed > 0:
+            stats.increment("surrogate_crossovers", crossed)
 
     def _account_trains(self, before: Optional[dict], after: Optional[dict]) -> None:
         if before is None or after is None:

@@ -25,6 +25,7 @@ from vizier_tpu.serving import coalescer as coalescer_lib
 from vizier_tpu.serving import config as config_lib
 from vizier_tpu.serving import designer_cache as cache_lib
 from vizier_tpu.serving import stats as stats_lib
+from vizier_tpu.surrogates import config as surrogate_config_lib
 
 _logger = logging.getLogger(__name__)
 
@@ -61,10 +62,18 @@ class ServingRuntime:
         stats: Optional[stats_lib.ServingStats] = None,
         reliability: Optional[reliability_config_lib.ReliabilityConfig] = None,
         observability: Optional[obs_config_lib.ObservabilityConfig] = None,
+        surrogates: Optional[surrogate_config_lib.SurrogateConfig] = None,
     ):
         self.config = config or config_lib.ServingConfig.from_env()
         self.observability = (
             observability or obs_config_lib.ObservabilityConfig.from_env()
+        )
+        # Scalable-surrogate auto-switch (vizier_tpu.surrogates): threaded
+        # into every GP designer the policy factory builds, so the whole
+        # serving tier shares one exact↔sparse policy. VIZIER_SPARSE=0
+        # keeps every study on the exact path (the seed behavior).
+        self.surrogates = (
+            surrogates or surrogate_config_lib.SurrogateConfig.from_env()
         )
         self.stats = stats or stats_lib.ServingStats()
         # One registry for this runtime's whole metric surface. A caller

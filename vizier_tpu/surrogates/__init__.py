@@ -1,0 +1,26 @@
+"""Scalable surrogate tier: sparse-GP posteriors behind the designer seam.
+
+The exact GP's O(n³) Cholesky makes large, long-lived studies infeasible
+(BENCH_CPU_FULLSCALE.json: 72 s device-side suggest p50 at 1000 trials ×
+20-D). This package provides the sparse inducing-point alternative —
+O(n·m²) training, O(m²) posterior — plus the :class:`SurrogateConfig`
+auto-switch that moves a study from the exact to the sparse path at a
+trial-count threshold (with hysteresis), serving-tier-wide via
+``ServingRuntime.surrogates``.
+
+Modules:
+
+- ``config``        — :class:`SurrogateConfig` + ``VIZIER_SPARSE*`` env reads
+  (importable without jax; the analysis CLI and config plumbing need that);
+- ``sparse_gp``     — SGPR/Nyström collapsed-bound model, k-center inducing
+  selection, mask-safe like the exact GP (``models.gp``);
+- ``sparse_bandit`` — the jitted train/sweep/flush programs the GP-bandit
+  designer and the cross-study batch executor consume.
+
+Evidence: SPARSE_AB.json (tools/surrogate_ab.py) — device-side suggest
+latency at the north-star scale plus rank-sum regret parity vs exact.
+"""
+
+from vizier_tpu.surrogates.config import SurrogateConfig  # noqa: F401
+
+__all__ = ["SurrogateConfig"]

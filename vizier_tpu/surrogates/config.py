@@ -1,0 +1,108 @@
+"""SurrogateConfig: the exact↔sparse auto-switch policy.
+
+Stdlib-only (the serving runtime and the analysis CLI import this without
+jax). The decision is made per suggest from the study's completed-trial
+count:
+
+- below ``sparse_threshold_trials`` the study runs the exact GP — the
+  bit-identical seed path;
+- at or above it the study switches to the sparse inducing-point surrogate
+  (``surrogates.sparse_gp``);
+- once sparse, a study only switches back when its trial count drops below
+  ``sparse_threshold_trials - hysteresis_trials``, so a study sitting at
+  the boundary (e.g. trials being deleted/re-added, or a rebuilt designer
+  replaying a truncated study) cannot flap between compiled program
+  families on alternate suggests.
+
+Every knob has a ``VIZIER_SPARSE*`` environment override (declared in
+``vizier_tpu/analysis/registry.py``, documented in
+``docs/guides/performance.md``). ``VIZIER_SPARSE=0`` disables the switch
+entirely: every study runs the exact path, bit-identical to the seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# All VIZIER_* switches are declared in (and read through) the central
+# registry; an undeclared name raises instead of silently reading an
+# always-unset variable. Enforced by the env_registry analysis pass.
+from vizier_tpu.analysis import registry as _registry
+
+MODE_EXACT = "exact"
+MODE_SPARSE = "sparse"
+
+
+@dataclasses.dataclass(frozen=True)
+class SurrogateConfig:
+    """Knobs for the sparse-surrogate auto-switch."""
+
+    # Master switch: False = exact GP always (the seed path, bit-identical).
+    sparse: bool = True
+    # Completed trials at which a study crosses exact -> sparse. The default
+    # sits where the exact path's O(n³) train starts to dominate suggest
+    # latency on every backend (docs/guides/performance.md has the cost
+    # model); studies below it keep the seed-exact behavior.
+    sparse_threshold_trials: int = 512
+    # A sparse study only returns to exact below threshold - hysteresis, so
+    # the boundary cannot flap between compiled program families.
+    hysteresis_trials: int = 64
+    # Inducing-point budget m. The designer pads it up the same bucket grid
+    # as trial counts (``padding.trial_bucket_grid``) so every (n-bucket,
+    # m-bucket) pair is one compiled program.
+    num_inducing: int = 128
+
+    def __post_init__(self):
+        if self.sparse_threshold_trials < 1:
+            raise ValueError(
+                f"sparse_threshold_trials must be >= 1, got "
+                f"{self.sparse_threshold_trials}."
+            )
+        if self.hysteresis_trials < 0:
+            raise ValueError(
+                f"hysteresis_trials must be >= 0, got {self.hysteresis_trials}."
+            )
+        if self.num_inducing < 1:
+            raise ValueError(
+                f"num_inducing must be >= 1, got {self.num_inducing}."
+            )
+
+    @classmethod
+    def from_env(cls) -> "SurrogateConfig":
+        """The default config with per-knob environment overrides applied."""
+        return cls(
+            sparse=_registry.env_on("VIZIER_SPARSE"),
+            sparse_threshold_trials=_registry.env_int(
+                "VIZIER_SPARSE_THRESHOLD", 512
+            ),
+            hysteresis_trials=_registry.env_int("VIZIER_SPARSE_HYSTERESIS", 64),
+            num_inducing=_registry.env_int("VIZIER_SPARSE_INDUCING", 128),
+        )
+
+    @classmethod
+    def disabled(cls) -> "SurrogateConfig":
+        """Exact GP always — the seed path."""
+        return cls(sparse=False)
+
+    def mode_for(self, num_trials: int, current: str = MODE_EXACT) -> str:
+        """The surrogate mode for a study with ``num_trials`` completed
+        trials, given its ``current`` mode (hysteresis needs history)."""
+        if not self.sparse:
+            return MODE_EXACT
+        if current == MODE_SPARSE:
+            floor = self.sparse_threshold_trials - self.hysteresis_trials
+            return MODE_SPARSE if num_trials >= floor else MODE_EXACT
+        return (
+            MODE_SPARSE
+            if num_trials >= self.sparse_threshold_trials
+            else MODE_EXACT
+        )
+
+    def as_dict(self) -> dict:
+        """JSON-stampable form (bench.py / tools artifacts)."""
+        return {
+            "sparse": self.sparse,
+            "sparse_threshold_trials": self.sparse_threshold_trials,
+            "hysteresis_trials": self.hysteresis_trials,
+            "num_inducing": self.num_inducing,
+        }

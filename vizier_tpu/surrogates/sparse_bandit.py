@@ -1,0 +1,189 @@
+"""The sparse-GP bandit programs: jitted train / sweep / batched flush.
+
+These mirror the exact-GP programs in ``designers.gp_bandit``
+(``_train_gp`` / ``_sweep_one`` / ``_gp_bandit_flush_program``) one-for-one
+so the sparse path inherits every serving discipline for free:
+
+- the SAME multi-restart L-BFGS ARD program shape (the collapsed bound
+  needs no variational loop), with the SAME warm-seed-as-extra-restart-row
+  semantics — a trained sparse optimum seeds the next sparse train exactly
+  like the exact path's (PARITY.md "Warm-start ARD seeding");
+- the SAME acquisition machinery (ScoringFunction / TrustRegion / eagle
+  sweep) over the :class:`~vizier_tpu.surrogates.sparse_gp.SparseEnsemblePredictive`;
+- ONE fused flush program per (trial-bucket, inducing-bucket) pair for the
+  cross-study batch executor, vmapped over a leading study axis — sparse
+  studies batch, prewarm, fail-isolate and trace exactly like exact ones.
+
+Layering: this module sits BELOW the designers (``designers.gp_bandit``
+imports it), so it depends only on models/optimizers/acquisitions.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from vizier_tpu import types
+from vizier_tpu.designers.gp import acquisitions
+from vizier_tpu.models import gp as gp_lib
+from vizier_tpu.models import kernels
+from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+from vizier_tpu.optimizers import vectorized as vectorized_lib
+from vizier_tpu.surrogates import sparse_gp
+
+Array = jax.Array
+
+
+def _heuristic_init(coll) -> gp_lib.Params:
+    """A deterministic mid-scale restart seed for the collapsed bound.
+
+    The Titsias trace term 1/(2σ²)·tr(Knn − Qnn) is stiff at small noise:
+    a random init with tiny ``noise_stddev`` sees a huge penalty whose
+    gradient drives the amplitude to its lower clip before the noise can
+    rise, and EVERY random restart can land in that degenerate
+    (amp→min, ls→max, noise→max) corner — measured on a 60×3 study, 8/8
+    random restarts collapsed there while the exact GP trained fine. One
+    always-present init at unit scales (labels are z-scored by the output
+    warper, so amplitude=1 / length-scale=1 / noise=0.1 is the
+    neutral prior) starts inside the well-behaved basin and reliably
+    converges to the non-degenerate optimum; the random restarts keep
+    their full exploration role on top.
+    """
+    constrained = {
+        spec.name: jnp.full(
+            spec.shape,
+            0.1 if spec.name == "noise_stddev" else 1.0,
+            jnp.float32,
+        )
+        for spec in coll.specs
+    }
+    return coll.unconstrain(constrained)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "optimizer", "num_restarts", "ensemble_size")
+)
+def _train_sparse_gp(
+    model: sparse_gp.SparseGaussianProcess,
+    optimizer: lbfgs_lib.LbfgsOptimizer,
+    data: gp_lib.GPData,
+    rng: Array,
+    num_restarts: int,
+    ensemble_size: int,
+    warm_start: Optional[gp_lib.Params] = None,
+) -> sparse_gp.SparseGPState:
+    """Sparse ARD: k-center inducing selection → restarts → L-BFGS → top-k.
+
+    The inducing set is selected INSIDE the program (deterministic given
+    the data) and shared by every restart; ``warm_start`` is prepended as
+    an extra restart row, identical to ``gp_bandit._train_gp``, after the
+    deterministic :func:`_heuristic_init` row that anchors the restart
+    pool outside the collapsed bound's degenerate basin.
+    """
+    sdata = sparse_gp.select_inducing_kcenter(data, model.num_inducing)
+    coll = model.param_collection()
+    inits = coll.batch_random_init_unconstrained(rng, num_restarts)
+    rows = [_heuristic_init(coll)]
+    if warm_start is not None:
+        rows.insert(0, warm_start)
+    inits = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate([x[None] for x in xs[:-1]] + [xs[-1]], axis=0),
+        *rows,
+        inits,
+    )
+    loss_fn = lambda p: model.neg_log_likelihood(p, sdata)
+    result = optimizer(loss_fn, inits, best_n=ensemble_size)
+    return jax.vmap(lambda p: model.precompute(p, sdata))(result.params)
+
+
+@functools.partial(jax.jit, static_argnames=("vec_opt", "count"))
+def _maximize_sparse_acquisition(
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    scoring: acquisitions.ScoringFunction,
+    rng: Array,
+    count: int,
+    prior_features: kernels.MixedFeatures,
+) -> vectorized_lib.VectorizedOptimizerResult:
+    return vec_opt(scoring.score, rng, count=count, prior_features=prior_features)
+
+
+def _prior_features_from_data(data: gp_lib.GPData) -> kernels.MixedFeatures:
+    """Top observed points (by warped label) to seed the eagle pool —
+    trace-identical to the exact path's helper (k derives from the padded
+    row count, so shapes are stable within a padding bucket)."""
+    labels = jnp.where(data.row_mask, data.labels, -jnp.inf)
+    k = min(10, data.num_rows)
+    _, idx = jax.lax.top_k(labels, k)
+    num_valid = jnp.sum(data.row_mask)
+    idx = jnp.where(jnp.arange(k) < num_valid, idx, idx[0])
+    return kernels.MixedFeatures(data.continuous[idx], data.categorical[idx])
+
+
+def _sweep_one(vec_opt, acquisition, s, d, k, count, use_trust_region):
+    """Per-study scoring + eagle sweep over the SPARSE posterior (the
+    sequential suggest and the batched flush share this trace)."""
+    best_label = jnp.max(jnp.where(d.row_mask, d.labels, -jnp.inf))
+    trust = acquisitions.TrustRegion.from_data(d) if use_trust_region else None
+    scoring = acquisitions.ScoringFunction(
+        predictive=sparse_gp.SparseEnsemblePredictive(s),
+        acquisition=acquisition,
+        best_label=best_label,
+        trust_region=trust,
+    )
+    return _maximize_sparse_acquisition(
+        vec_opt, scoring, k, count, _prior_features_from_data(d)
+    )
+
+
+def _warm_next_batched(
+    model: sparse_gp.SparseGaussianProcess, states: sparse_gp.SparseGPState
+) -> gp_lib.Params:
+    """Per-slot warm seed for the NEXT sparse train: best member's params
+    mapped back through the bijectors, vmapped over the study axis."""
+    coll = model.param_collection()
+    return jax.vmap(
+        lambda p: coll.unconstrain(jax.tree_util.tree_map(lambda a: a[0], p))
+    )(states.params)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "model", "optimizer", "vec_opt", "acquisition",
+        "num_restarts", "ensemble_size", "count", "use_trust_region",
+    ),
+)
+def _sparse_flush_program(
+    model: sparse_gp.SparseGaussianProcess,
+    optimizer: lbfgs_lib.LbfgsOptimizer,
+    vec_opt: vectorized_lib.VectorizedOptimizer,
+    acquisition,
+    md: types.ModelData,  # stacked host ModelData, leading study axis
+    rng_train: Array,  # [B]
+    rng_acq: Array,  # [B]
+    warm: gp_lib.Params,  # [B]
+    num_restarts: int,
+    ensemble_size: int,
+    count: int,
+    use_trust_region: bool,
+):
+    """ONE device program per sparse-bucket flush: encode → select inducing
+    → train collapsed bound → sweep → warm seed. The sparse twin of
+    ``gp_bandit._gp_bandit_flush_program``; slot i matches study i run
+    alone through the sequential sparse path.
+    """
+    data = jax.vmap(lambda m: gp_lib.GPData.from_model_data(m))(md)
+    states = jax.vmap(
+        lambda d, k, w: _train_sparse_gp(
+            model, optimizer, d, k, num_restarts, ensemble_size, w
+        )
+    )(data, rng_train, warm)
+    result = jax.vmap(
+        lambda s, d, k: _sweep_one(
+            vec_opt, acquisition, s, d, k, count, use_trust_region
+        )
+    )(states, data, rng_acq)
+    return states, _warm_next_batched(model, states), result
