@@ -107,6 +107,13 @@ def _surrogate_env_config() -> dict:
     return SurrogateConfig.from_env().as_dict()
 
 
+def _speculative_env_config() -> dict:
+    """The process-wide VIZIER_SPECULATIVE* config, for provenance."""
+    from vizier_tpu.serving.speculative import SpeculativeConfig
+
+    return SpeculativeConfig.from_env().as_dict()
+
+
 def main() -> None:
     backend_tag = None
     platforms = os.environ.get("JAX_PLATFORMS", "")
@@ -362,6 +369,15 @@ def main() -> None:
         "surrogates": {
             "active_mode": "exact",
             **_surrogate_env_config(),
+        },
+        # Speculative pre-compute (serving.speculative): bench drives the
+        # designers directly, so no suggest here is ever served from a
+        # parked batch — the env config rides along so artifacts from
+        # speculative-enabled processes are distinguishable
+        # (tools/speculative_ab.py measures the served-hit path).
+        "speculative": {
+            "active": False,
+            **_speculative_env_config(),
         },
     }
     if backend_tag:

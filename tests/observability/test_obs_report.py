@@ -102,3 +102,45 @@ class TestSurrogateActivity:
         )
         assert mixed["mode"] == "mixed"
         assert obs_report.surrogate_activity(self._spans(["rpc"]))["mode"] == "none"
+
+
+class TestSpeculativeActivity:
+    def test_counts_serve_events_and_precompute_spans(self):
+        spans = [
+            {
+                "name": "pythia.suggest",
+                "duration_secs": 0.001,
+                "events": [{"name": "speculative.hit", "attributes": {}}],
+            },
+            {
+                "name": "pythia.suggest",
+                "duration_secs": 0.8,
+                "events": [{"name": "speculative.miss", "attributes": {}}],
+            },
+            {
+                "name": "pythia.suggest",
+                "duration_secs": 0.9,
+                "events": [{"name": "speculative.stale", "attributes": {}}],
+            },
+            {
+                "name": "speculative.precompute",
+                "duration_secs": 0.7,
+                "attributes": {"outcome": "stored"},
+            },
+            {
+                "name": "speculative.precompute",
+                "duration_secs": 0.7,
+                "attributes": {"outcome": "superseded"},
+            },
+        ]
+        act = obs_report.speculative_activity(spans)
+        assert act["hit"] == 1 and act["miss"] == 1 and act["stale"] == 1
+        assert act["precomputes"] == 2 and act["stored"] == 1
+        assert act["hit_rate"] == round(1 / 3, 4)
+
+    def test_no_activity_is_all_zero(self):
+        act = obs_report.speculative_activity(
+            [{"name": "pythia.suggest", "duration_secs": 0.1}]
+        )
+        assert act["hit"] == act["miss"] == act["precomputes"] == 0
+        assert act["hit_rate"] == 0.0

@@ -1,6 +1,7 @@
 """Cross-study batch executor: bucketing, parity, masking, fail isolation."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -468,3 +469,201 @@ class TestPrewarm:
             assert all(r["seconds"] >= 0 for r in report)
         finally:
             ex.close()
+
+
+class TestSpeculativeLane:
+    """The low-priority lane for serving.speculative pre-computes."""
+
+    def test_queue_depth_reports_lanes(self):
+        executor = BatchExecutor(max_batch_size=8, max_wait_ms=10_000)
+        try:
+            order = []
+
+            def run(designer, speculative):
+                order.append(executor.suggest(designer, 1, speculative=speculative))
+
+            live = StubDesigner(1.0, group="live")
+            spec = StubDesigner(2.0, group="spec")
+            threads = [
+                threading.Thread(target=run, args=(spec, True)),
+                threading.Thread(target=run, args=(live, False)),
+            ]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                depth = executor.queue_depth()
+                if depth == {"live": 1, "speculative": 1}:
+                    break
+                time.sleep(0.001)
+            assert executor.queue_depth() == {"live": 1, "speculative": 1}
+            assert executor.live_pending() == 1
+        finally:
+            executor.close()
+            for t in threads:
+                t.join(timeout=10)
+
+    def test_live_singleton_never_waits_behind_speculative_flush(self):
+        """A queued speculative-only bucket must not become due while a
+        live slot is queued: the live singleton flushes first.
+
+        Deterministic via a fake clock: nothing becomes due until the
+        clock advances, so the speculative bucket cannot sneak an
+        idle-window flush in before the live slot is even submitted (a
+        real-time race on a loaded machine)."""
+        clock = [0.0]
+        executor = BatchExecutor(
+            max_batch_size=8,
+            max_wait_ms=30.0,
+            speculative_max_wait_ms=10_000,
+            time_fn=lambda: clock[0],
+        )
+        flush_order = []
+        flush_lock = threading.Lock()
+
+        class Recording(StubDesigner):
+            def __init__(self, value, group, tag):
+                super().__init__(value, group=group)
+                self.tag = tag
+
+            def suggest(self, count=1):
+                with flush_lock:
+                    flush_order.append(self.tag)
+                return super().suggest(count)
+
+            def batch_finalize(self, item, output):
+                with flush_lock:
+                    flush_order.append(self.tag)
+                return super().batch_finalize(item, output)
+
+        try:
+            results = {}
+
+            def run(tag, designer, speculative):
+                results[tag] = executor.suggest(
+                    designer, 1, speculative=speculative
+                )
+
+            # Two speculative slots share a bucket (so they'd flush
+            # batched); the live singleton arrives afterwards in its own
+            # bucket, i.e. with a LATER timeout window — yet must run
+            # first because pure-speculative buckets defer to queued live.
+            spec_a = Recording(1.0, "spec", "spec")
+            spec_b = Recording(2.0, "spec", "spec")
+            live = Recording(3.0, "live", "live")
+            t1 = threading.Thread(target=run, args=("a", spec_a, True))
+            t2 = threading.Thread(target=run, args=("b", spec_b, True))
+            t1.start()
+            t2.start()
+            deadline = time.monotonic() + 5.0
+            while (
+                executor.queue_depth()["speculative"] < 2
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.001)
+            t3 = threading.Thread(target=run, args=("live", live, False))
+            t3.start()
+            deadline = time.monotonic() + 5.0
+            while (
+                executor.live_pending() < 1 and time.monotonic() < deadline
+            ):
+                time.sleep(0.001)
+            # Everything queued at t=0; advance past every window at once.
+            clock[0] = 1.0
+            for t in (t1, t2, t3):
+                t.join(timeout=30)
+            assert flush_order[0] == "live", flush_order
+            assert set(flush_order) == {"live", "spec"}
+        finally:
+            executor.close()
+
+    def test_speculative_flushes_in_idle_window(self):
+        executor = BatchExecutor(max_batch_size=8, max_wait_ms=5.0)
+        try:
+            spec = StubDesigner(1.0, group="spec")
+            out = executor.suggest(spec, 1, speculative=True)
+            assert [s.parameters["x"].value for s in out] == [1.0]
+        finally:
+            executor.close()
+
+    def test_speculative_rides_a_live_flush(self):
+        """A speculative slot in a bucket a live slot joins flushes WITH
+        the live batch (shared compute is the good case)."""
+        executor = BatchExecutor(max_batch_size=2, max_wait_ms=10_000)
+        try:
+            spec = StubDesigner(1.0, group="g")
+            live = StubDesigner(2.0, group="g")
+            results, errors = [None, None], [None, None]
+
+            def run(i, designer, speculative):
+                results[i] = executor.suggest(designer, 1, speculative=speculative)
+
+            t1 = threading.Thread(target=run, args=(0, spec, True))
+            t1.start()
+            deadline = time.monotonic() + 5.0
+            while (
+                executor.queue_depth()["speculative"] < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.001)
+            t2 = threading.Thread(target=run, args=(1, live, False))
+            t2.start()
+            t1.join(timeout=30)
+            t2.join(timeout=30)
+            # Full flush at size 2: both went through the batched path.
+            assert spec.batched and live.batched
+        finally:
+            executor.close()
+
+    def test_starvation_cap_flushes_speculative_under_constant_live(self):
+        """speculative_max_wait bounds the hold: a speculative slot is
+        flushed eventually even while live slots keep the queues busy."""
+        executor = BatchExecutor(
+            max_batch_size=8,
+            max_wait_ms=10_000,  # live bucket never times out on its own
+            speculative_max_wait_ms=30.0,
+        )
+        try:
+            spec = StubDesigner(1.0, group="spec")
+            live = StubDesigner(2.0, group="live")
+            results = {}
+
+            def run(tag, designer, speculative):
+                results[tag] = executor.suggest(
+                    designer, 1, speculative=speculative
+                )
+
+            t_live = threading.Thread(target=run, args=("live", live, False))
+            t_spec = threading.Thread(target=run, args=("spec", spec, True))
+            t_live.start()
+            t_spec.start()
+            # The speculative slot must complete despite the live slot
+            # still parked in its (never-due) bucket.
+            t_spec.join(timeout=10)
+            assert not t_spec.is_alive()
+            assert results["spec"] is not None
+        finally:
+            executor.close()
+            t_live.join(timeout=10)
+
+    def test_close_drains_speculative_slots(self):
+        executor = BatchExecutor(
+            max_batch_size=8, max_wait_ms=10_000, speculative_max_wait_ms=10_000
+        )
+        spec = StubDesigner(1.0, group="spec")
+        result = []
+        t = threading.Thread(
+            target=lambda: result.append(
+                executor.suggest(spec, 1, speculative=True)
+            )
+        )
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while (
+            executor.queue_depth()["speculative"] < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.001)
+        executor.close()
+        t.join(timeout=10)
+        assert result and result[0] is not None

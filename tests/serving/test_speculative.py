@@ -1,0 +1,731 @@
+"""Speculative pre-compute: engine mechanics, invalidation races, serving
+integration, and the bit-equality contract (a hit IS the live compute,
+run early)."""
+
+import threading
+import time
+
+import pytest
+
+from vizier_tpu.serving import designer_cache as cache_lib
+from vizier_tpu.serving import speculative as spec_lib
+from vizier_tpu.serving.speculative import (
+    SpeculativeConfig,
+    SpeculativeEngine,
+    make_fingerprint,
+)
+from vizier_tpu.serving.stats import ServingStats
+from vizier_tpu.surrogates import config as surrogate_config_lib
+
+
+class TestConfig:
+    def test_default_is_off(self):
+        assert SpeculativeConfig().speculative is False
+        assert SpeculativeConfig.from_env().speculative is False
+
+    def test_env_opt_in(self, monkeypatch):
+        monkeypatch.setenv("VIZIER_SPECULATIVE", "1")
+        monkeypatch.setenv("VIZIER_SPECULATIVE_WORKERS", "3")
+        monkeypatch.setenv("VIZIER_SPECULATIVE_MAX_AGE_S", "12.5")
+        monkeypatch.setenv("VIZIER_SPECULATIVE_ON_FILL", "1")
+        cfg = SpeculativeConfig.from_env()
+        assert cfg.speculative is True
+        assert cfg.workers == 3
+        assert cfg.max_speculation_age_s == 12.5
+        assert cfg.speculate_on_fill is True
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SpeculativeConfig(workers=0)
+        with pytest.raises(ValueError):
+            SpeculativeConfig(max_speculation_age_s=0.0)
+
+    def test_as_dict_is_json_shaped(self):
+        d = SpeculativeConfig().as_dict()
+        assert set(d) == {
+            "speculative",
+            "workers",
+            "max_speculation_age_s",
+            "speculate_on_fill",
+        }
+
+
+class TestFingerprint:
+    def test_order_insensitive_ids(self):
+        a = make_fingerprint(b"cfg", [3, 1, 2], [7, 5])
+        b = make_fingerprint(b"cfg", [2, 3, 1], [5, 7])
+        assert a == b
+
+    def test_sensitive_to_every_component(self):
+        base = make_fingerprint(b"cfg", [1, 2], [3])
+        assert base != make_fingerprint(b"cfg2", [1, 2], [3])
+        assert base != make_fingerprint(b"cfg", [1, 2, 4], [3])
+        assert base != make_fingerprint(b"cfg", [1, 2], [3, 4])
+        # A completion moving a trial active->completed changes both sets.
+        assert base != make_fingerprint(b"cfg", [1, 2, 3], [])
+
+
+# ---------------------------------------------------------------------------
+# Engine unit tests: a fake compute path with controllable latency.
+# ---------------------------------------------------------------------------
+
+
+class _FakeResponse:
+    """Stands in for a PythiaSuggestResponse (opaque to the engine)."""
+
+    def __init__(self, batch, error=""):
+        self.batch = batch
+        self.error = error
+
+
+class _Harness:
+    """A bound engine over a real designer cache and scripted frontiers."""
+
+    def __init__(self, config=None, executor=None, time_fn=None):
+        self.stats = ServingStats()
+        self.cache = cache_lib.DesignerStateCache(stats=self.stats)
+        self.engine = SpeculativeEngine(
+            config or SpeculativeConfig(speculative=True),
+            cache=self.cache,
+            stats=self.stats,
+            executor=executor,
+            time_fn=time_fn or time.monotonic,
+        )
+        self.frontier = ([], [], 0)  # completed, active, max_id
+        self.spec_bytes = b"study-config"
+        self.computes = 0
+        self.compute_started = threading.Event()
+        self.compute_release = threading.Event()
+        self.compute_release.set()  # compute returns immediately by default
+        self.compute_result = lambda study, count: _FakeResponse(
+            [f"{study}#{count}"] * count
+        )
+        self.engine.bind(
+            fingerprint_fn=self._fingerprint,
+            compute_fn=self._compute,
+            accept_fn=self._accept,
+        )
+
+    def _fingerprint(self, study):
+        completed, active, max_id = self.frontier
+        return make_fingerprint(self.spec_bytes, completed, active), max_id
+
+    def _compute(self, study, count, max_trial_id):
+        assert spec_lib.in_speculative_compute()
+        self.computes += 1
+        self.compute_started.set()
+        assert self.compute_release.wait(timeout=30.0)
+        return self.compute_result(study, count)
+
+    @staticmethod
+    def _accept(response):
+        if response is None or response.error or not response.batch:
+            return None
+        return len(response.batch)
+
+    def fill_entry(self, study="s"):
+        """A live suggest would have created the designer entry; fake it."""
+        return self.cache.get_or_create(study, lambda: object())
+
+    def current_fp(self):
+        completed, active, _ = self.frontier
+        return make_fingerprint(self.spec_bytes, completed, active)
+
+    def close(self):
+        self.engine.close()
+
+
+@pytest.fixture
+def harness():
+    h = _Harness()
+    yield h
+    h.close()
+
+
+def _spec_stats(stats):
+    return {
+        k.replace("speculative_", ""): v
+        for k, v in stats.snapshot().items()
+        if k.startswith("speculative_")
+    }
+
+
+class TestEngineParkAndServe:
+    def test_completion_park_then_one_shot_hit(self, harness):
+        harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        assert harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        response, outcome = harness.engine.try_serve("s", 1, harness.current_fp())
+        assert outcome == "hit"
+        assert response.batch == ["s#1"]
+        # One-shot: the slot was consumed.
+        response2, outcome2 = harness.engine.try_serve(
+            "s", 1, harness.current_fp()
+        )
+        assert response2 is None and outcome2 == "miss"
+        counters = _spec_stats(harness.stats)
+        assert counters["hits"] == 1
+        assert counters["precomputes"] == 1
+
+    def test_fingerprint_mismatch_is_a_miss_and_drops_slot(self, harness):
+        entry = harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        moved = make_fingerprint(harness.spec_bytes, [1, 2], [])
+        response, outcome = harness.engine.try_serve("s", 1, moved)
+        assert response is None and outcome == "miss"
+        assert entry.speculative is None  # unservable batch dropped
+
+    def test_config_change_is_a_miss(self, harness):
+        harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        other_config = make_fingerprint(b"other-config", [1], [])
+        response, outcome = harness.engine.try_serve("s", 1, other_config)
+        assert response is None and outcome == "miss"
+
+    def test_count_reconciliation(self):
+        h = _Harness()
+        try:
+            h.fill_entry("s")
+            h.frontier = ([1], [], 1)
+            h.engine.note_live_suggest("s", 3)  # speculate batches of 3
+            h.engine.notify_completion("s")
+            assert h.engine.wait_idle(10.0)
+            # Larger request: miss, slot retained for a matching peer.
+            response, outcome = h.engine.try_serve("s", 4, h.current_fp())
+            assert response is None and outcome == "miss"
+            # Smaller request: hit (Pythia serves the batch prefix).
+            response, outcome = h.engine.try_serve("s", 2, h.current_fp())
+            assert outcome == "hit" and len(response.batch) == 3
+        finally:
+            h.close()
+
+    def test_staleness_deadline(self):
+        clock = [0.0]
+        h = _Harness(
+            config=SpeculativeConfig(speculative=True, max_speculation_age_s=5.0),
+            time_fn=lambda: clock[0],
+        )
+        try:
+            h.fill_entry("s")
+            h.frontier = ([1], [], 1)
+            h.engine.notify_completion("s")
+            assert h.engine.wait_idle(10.0)
+            clock[0] = 6.0
+            response, outcome = h.engine.try_serve("s", 1, h.current_fp())
+            assert response is None and outcome == "stale"
+            assert _spec_stats(h.stats)["stale"] == 1
+        finally:
+            h.close()
+
+    def test_no_cache_entry_skips_the_compute(self, harness):
+        # Bulk trial loading before any suggest: no designer entry exists,
+        # so speculating would burn RNG state for an unservable batch.
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("nobody-served-me")
+        assert harness.engine.wait_idle(10.0)
+        assert harness.computes == 0
+        assert _spec_stats(harness.stats)["cancelled"] == 1
+
+
+class TestInvalidationRaces:
+    def test_completion_mid_flight_discards_the_result(self, harness):
+        harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.compute_release.clear()
+        harness.engine.notify_completion("s")
+        assert harness.compute_started.wait(10.0)
+        # A second completion lands while the job computes for the OLD
+        # frontier: its result must be discarded, not served. The new
+        # job recomputes against the new frontier.
+        harness.frontier = ([1, 2], [], 2)
+        harness.engine.notify_completion("s")
+        harness.compute_release.set()
+        assert harness.engine.wait_idle(10.0)
+        # Only the superseding job's batch parked: the slot's fingerprint
+        # is the NEW frontier's, so the first job's result (computed for
+        # the old frontier) was discarded, never served.
+        entry = harness.cache.peek("s")
+        assert entry.speculative is not None
+        assert entry.speculative.fingerprint == harness.current_fp()
+        response, outcome = harness.engine.try_serve("s", 1, harness.current_fp())
+        assert outcome == "hit"
+        assert harness.computes == 2
+
+    def test_delete_study_mid_flight(self, harness):
+        harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.compute_release.clear()
+        harness.engine.notify_completion("s")
+        assert harness.compute_started.wait(10.0)
+        harness.engine.invalidate("s", reason="delete_study")
+        harness.cache.invalidate("s")
+        harness.compute_release.set()
+        assert harness.engine.wait_idle(10.0)
+        # Nothing served for the deleted (then recreated) study.
+        harness.fill_entry("s")
+        response, outcome = harness.engine.try_serve("s", 1, harness.current_fp())
+        assert response is None and outcome == "miss"
+
+    def test_invalidate_drops_parked_slot_and_queued_job(self, harness):
+        entry = harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        assert entry.speculative is not None
+        harness.engine.invalidate("s", reason="surgery")
+        assert entry.speculative is None
+
+    def test_crossover_hook_invalidates(self, harness):
+        entry = harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        assert entry.speculative is not None
+
+        class _Designer:
+            pass
+
+        designer = _Designer()
+        surrogate_config_lib.install_crossover_listener(
+            designer,
+            lambda old, new: harness.engine.invalidate(
+                "s", reason=f"crossover:{old}->{new}"
+            ),
+        )
+        surrogate_config_lib.fire_crossover_hook(designer, "exact", "sparse")
+        assert entry.speculative is None
+
+    def test_crossover_hook_swallows_listener_errors(self):
+        class _Designer:
+            pass
+
+        designer = _Designer()
+        surrogate_config_lib.install_crossover_listener(
+            designer, lambda old, new: 1 / 0
+        )
+        # Must not raise: a broken observer cannot fail the compute.
+        surrogate_config_lib.fire_crossover_hook(designer, "exact", "sparse")
+        # No listener installed is a no-op too.
+        surrogate_config_lib.fire_crossover_hook(object(), "a", "b")
+
+
+class TestFailureIsolation:
+    def test_compute_error_leaves_no_slot(self, harness):
+        entry = harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+
+        def boom(study, count):
+            raise RuntimeError("designer died")
+
+        harness.compute_result = boom
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        assert entry.speculative is None
+        assert _spec_stats(harness.stats)["errors"] == 1
+
+    def test_error_response_rejected(self, harness):
+        entry = harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.compute_result = lambda s, c: _FakeResponse([], error="TRANSIENT: x")
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        assert entry.speculative is None
+
+    def test_worker_survives_fingerprint_failure(self, harness):
+        harness.fill_entry("s")
+        original = harness.engine._fingerprint_fn
+        harness.engine._fingerprint_fn = lambda study: 1 / 0
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        # The pool is still alive and serves the next job.
+        harness.engine._fingerprint_fn = original
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        _, outcome = harness.engine.try_serve("s", 1, harness.current_fp())
+        assert outcome == "hit"
+
+
+class _FakeExecutor:
+    def __init__(self, live=0):
+        self.live = live
+
+    def live_pending(self):
+        return self.live
+
+
+class TestAdmissionGate:
+    def test_busy_executor_drops_the_job(self):
+        executor = _FakeExecutor(live=5)
+        h = _Harness(
+            config=SpeculativeConfig(
+                speculative=True,
+                admission_backoff_s=0.005,
+                admission_max_wait_s=0.02,
+            ),
+            executor=executor,
+        )
+        try:
+            h.fill_entry("s")
+            h.frontier = ([1], [], 1)
+            h.engine.notify_completion("s")
+            assert h.engine.wait_idle(10.0)
+            assert h.computes == 0  # refused: live traffic owns the buckets
+            assert _spec_stats(h.stats)["cancelled"] == 1
+        finally:
+            h.close()
+
+    def test_gate_opens_when_live_drains(self):
+        executor = _FakeExecutor(live=5)
+        h = _Harness(
+            config=SpeculativeConfig(
+                speculative=True,
+                admission_backoff_s=0.005,
+                admission_max_wait_s=5.0,
+            ),
+            executor=executor,
+        )
+        try:
+            h.fill_entry("s")
+            h.frontier = ([1], [], 1)
+            h.engine.notify_completion("s")
+            time.sleep(0.02)
+            executor.live = 0  # live traffic drained mid-backoff
+            assert h.engine.wait_idle(10.0)
+            assert h.computes == 1
+        finally:
+            h.close()
+
+
+class TestShutdown:
+    def test_close_joins_workers_no_thread_leak(self, harness):
+        harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        harness.engine.close()
+        assert not any(
+            t.name.startswith("vizier-speculative") and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_close_under_load_cancels_and_discards(self, harness):
+        entry = harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.compute_release.clear()
+        harness.engine.notify_completion("s")
+        assert harness.compute_started.wait(10.0)
+        # Queue a second study's job behind the wedged compute.
+        harness.fill_entry("s2")
+        harness.engine.notify_completion("s2")
+        closer = threading.Thread(target=harness.engine.close)
+        closer.start()
+        time.sleep(0.02)
+        harness.compute_release.set()  # the in-flight compute finishes late
+        closer.join(timeout=10.0)
+        assert not closer.is_alive()
+        assert entry.speculative is None  # late result discarded, not parked
+        assert harness.computes == 1  # queued job never started
+        assert not any(
+            t.name.startswith("vizier-speculative") and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_close_is_idempotent(self, harness):
+        harness.engine.close()
+        harness.engine.close()
+
+    def test_notify_after_close_is_refused(self, harness):
+        harness.fill_entry("s")
+        harness.engine.close()
+        assert harness.engine.notify_completion("s") is False
+
+
+class TestRuntimeWiring:
+    def test_runtime_default_has_no_engine(self):
+        from vizier_tpu.serving import ServingRuntime
+
+        runtime = ServingRuntime()
+        try:
+            assert runtime.speculative_engine is None
+        finally:
+            runtime.shutdown()
+
+    def test_runtime_builds_engine_when_opted_in(self):
+        from vizier_tpu.serving import ServingRuntime
+
+        runtime = ServingRuntime(
+            speculative=SpeculativeConfig(speculative=True)
+        )
+        try:
+            engine = runtime.speculative_engine
+            assert engine is not None
+            assert not engine.bound  # needs a Pythia servicer to bind
+        finally:
+            runtime.shutdown()
+
+    def test_requires_designer_cache(self):
+        from vizier_tpu.serving import ServingConfig, ServingRuntime
+
+        runtime = ServingRuntime(
+            ServingConfig(designer_cache=False),
+            speculative=SpeculativeConfig(speculative=True),
+        )
+        try:
+            assert runtime.speculative_engine is None
+        finally:
+            runtime.shutdown()
+
+    def test_shutdown_closes_engine(self):
+        from vizier_tpu.serving import ServingRuntime
+
+        runtime = ServingRuntime(
+            speculative=SpeculativeConfig(speculative=True)
+        )
+        runtime.shutdown()
+        assert runtime.speculative_engine._closed
+
+    def test_invalidate_study_reaches_engine(self):
+        from vizier_tpu.serving import ServingRuntime
+
+        runtime = ServingRuntime(
+            speculative=SpeculativeConfig(speculative=True)
+        )
+        try:
+            entry = runtime.designer_cache.get_or_create("s", lambda: object())
+            entry.speculative = spec_lib.SpeculativeSlot(
+                "s", make_fingerprint(b"c", [], []), object(), 1, 0.0
+            )
+            runtime.invalidate_study("s")
+            assert runtime.designer_cache.peek("s") is None
+        finally:
+            runtime.shutdown()
+
+
+class TestCachePeek:
+    def test_peek_never_creates(self):
+        cache = cache_lib.DesignerStateCache()
+        assert cache.peek("missing") is None
+        assert len(cache) == 0
+
+    def test_peek_touch_refreshes_lru(self):
+        cache = cache_lib.DesignerStateCache(max_entries=2)
+        cache.get_or_create("a", lambda: object())
+        cache.get_or_create("b", lambda: object())
+        cache.peek("a")  # refresh: "b" becomes the LRU victim
+        cache.get_or_create("c", lambda: object())
+        assert "a" in cache and "c" in cache and "b" not in cache
+
+    def test_peek_honors_ttl(self):
+        clock = [0.0]
+        cache = cache_lib.DesignerStateCache(
+            ttl_seconds=10.0, time_fn=lambda: clock[0]
+        )
+        cache.get_or_create("a", lambda: object())
+        clock[0] = 11.0
+        assert cache.peek("a") is None
+
+    def test_peek_no_touch_is_pure(self):
+        clock = [0.0]
+        cache = cache_lib.DesignerStateCache(
+            ttl_seconds=10.0, time_fn=lambda: clock[0]
+        )
+        cache.get_or_create("a", lambda: object())
+        clock[0] = 5.0
+        entry = cache.peek("a", touch=False)
+        assert entry is not None
+        assert entry.last_used_at == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Serving-stack integration: real service + cheap GP designer. The contract
+# under test is the headline one: a speculative hit is bit-equal to the
+# live compute it replaced, and the whole trajectory matches the
+# non-speculative path suggestion-for-suggestion.
+# ---------------------------------------------------------------------------
+
+
+def _fast_gp_factory(runtime):
+    from vizier_tpu.designers import gp_ucb_pe
+    from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+    from vizier_tpu.serving.policy import CachedDesignerStatePolicy
+
+    kwargs = dict(
+        max_acquisition_evaluations=200,
+        ard_restarts=2,
+        ard_optimizer=lbfgs_lib.AdamOptimizer(maxiter=10),
+        warm_start_min_trials=0,
+        rng_seed=7,
+    )
+
+    class _Factory:
+        def __init__(self, serving):
+            self._serving = serving
+
+        def __call__(self, problem, algorithm, supporter, study_name):
+            kw = dict(kwargs)
+            cfg = self._serving.config
+            kw["use_warm_start_ard"] = cfg.warm_start
+            if cfg.warm_start:
+                kw["warm_ard_restarts"] = cfg.warm_ard_restarts
+            return CachedDesignerStatePolicy(
+                supporter,
+                lambda p, **_: gp_ucb_pe.VizierGPUCBPEBandit(p, **kw),
+                self._serving,
+                study_name,
+                use_seeding=True,
+            )
+
+    return _Factory(runtime)
+
+
+def _gp_stack(speculative_config=None):
+    from vizier_tpu.service import pythia_service, vizier_service
+    from vizier_tpu.serving import runtime as runtime_lib
+
+    servicer = vizier_service.VizierServicer()
+    pythia = pythia_service.PythiaServicer(servicer)
+    if speculative_config is not None:
+        pythia._serving = runtime_lib.ServingRuntime(
+            speculative=speculative_config
+        )
+    pythia._policy_factory = _fast_gp_factory(pythia.serving_runtime)
+    pythia._bind_speculative()
+    servicer.set_pythia(pythia)
+    return servicer, pythia
+
+
+def _speculative_study_config():
+    from vizier_tpu import pyvizier as vz
+
+    config = vz.StudyConfig(algorithm="DEFAULT")
+    for d in range(2):
+        config.search_space.root.add_float_param(f"x{d}", 0.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+def _drive_loop(servicer, pythia, study_name, steps):
+    """Sequential complete→suggest loop; the engine's wait_idle models an
+    evaluation that outlasts the pre-compute (the serving steady state).
+    Returns (per-suggest parameter tuples, hit-stamp flags)."""
+    from vizier_tpu.service import proto_converters as pc
+    from vizier_tpu.service.protos import vizier_service_pb2
+    from vizier_tpu.serving import speculative as spec
+
+    servicer.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(
+            parent="owners/o",
+            study=pc.study_to_proto(_speculative_study_config(), study_name),
+        )
+    )
+    engine = pythia.serving_runtime.speculative_engine
+    trajectory, stamped = [], []
+    for _ in range(steps):
+        op = servicer.SuggestTrials(
+            vizier_service_pb2.SuggestTrialsRequest(
+                parent=study_name, suggestion_count=1, client_id="worker"
+            )
+        )
+        assert not op.error, op.error
+        trial = op.response.trials[0]
+        trajectory.append(
+            tuple(
+                sorted(
+                    (p.name, p.value.double_value) for p in trial.parameters
+                )
+            )
+        )
+        stamped.append(
+            any(
+                kv.key == spec.SPECULATIVE_KEY
+                and kv.string_value == spec.SPECULATIVE_HIT_VALUE
+                for kv in trial.metadata
+            )
+        )
+        request = vizier_service_pb2.CompleteTrialRequest(name=trial.name)
+        metric = request.final_measurement.metrics.add()
+        metric.name = "obj"
+        metric.value = -sum(
+            (p.value.double_value - 0.3) ** 2 for p in trial.parameters
+        )
+        servicer.CompleteTrial(request)
+        if engine is not None:
+            assert engine.wait_idle(120.0)
+    return trajectory, stamped
+
+
+class TestServingIntegration:
+    STEPS = 5
+
+    def test_hits_are_bit_equal_to_the_live_path(self):
+        off_servicer, off_pythia = _gp_stack()
+        try:
+            assert off_pythia.serving_runtime.speculative_engine is None
+            baseline, off_stamps = _drive_loop(
+                off_servicer, off_pythia, "owners/o/studies/base", self.STEPS
+            )
+        finally:
+            off_pythia.shutdown()
+        assert not any(off_stamps)
+
+        on_servicer, on_pythia = _gp_stack(SpeculativeConfig(speculative=True))
+        try:
+            speculated, on_stamps = _drive_loop(
+                on_servicer, on_pythia, "owners/o/studies/spec", self.STEPS
+            )
+            counters = {
+                k: v
+                for k, v in on_pythia.serving_stats().items()
+                if k.startswith("speculative_")
+            }
+        finally:
+            on_pythia.shutdown()
+
+        # Suggestion-for-suggestion bit equality: every hit is exactly the
+        # batch live compute would have produced for the same frontier.
+        assert speculated == baseline
+        # Suggest 0 is the seeding stage (no cache entry yet) and suggest 1
+        # computes live (the entry is born there); everything after hits.
+        assert on_stamps == [False, False] + [True] * (self.STEPS - 2)
+        assert counters["speculative_hits"] == self.STEPS - 2
+        assert counters["speculative_errors"] == 0
+
+    def test_delete_study_never_serves_the_predecessors_batch(self):
+        from vizier_tpu.service.protos import vizier_service_pb2
+
+        servicer, pythia = _gp_stack(SpeculativeConfig(speculative=True))
+        study_name = "owners/o/studies/reused"
+        try:
+            _drive_loop(servicer, pythia, study_name, 3)
+            engine = pythia.serving_runtime.speculative_engine
+            entry = pythia.serving_runtime.designer_cache.peek(study_name)
+            assert entry is not None and entry.speculative is not None
+            servicer.DeleteStudy(
+                vizier_service_pb2.DeleteStudyRequest(name=study_name)
+            )
+            assert pythia.serving_runtime.designer_cache.peek(study_name) is None
+            # The reused name starts from scratch: fresh study, no stamp on
+            # its first suggests.
+            trajectory, stamps = _drive_loop(servicer, pythia, study_name, 2)
+            assert not any(stamps)
+        finally:
+            pythia.shutdown()
+
+    def test_shutdown_under_live_speculation(self):
+        servicer, pythia = _gp_stack(SpeculativeConfig(speculative=True))
+        try:
+            _drive_loop(servicer, pythia, "owners/o/studies/load", 3)
+        finally:
+            pythia.shutdown()
+        assert not any(
+            t.name.startswith("vizier-speculative") and t.is_alive()
+            for t in threading.enumerate()
+        )

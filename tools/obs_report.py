@@ -123,6 +123,32 @@ def surrogate_activity(spans: List[dict]) -> dict:
     return {"mode": mode, **counts}
 
 
+def speculative_activity(spans: List[dict]) -> dict:
+    """Hit/miss/stale serving outcomes plus pre-compute counts.
+
+    Serve outcomes ride ``speculative.*`` events on the request-path spans
+    (pythia.suggest and children); the background jobs are their own
+    ``speculative.precompute`` spans with an ``outcome`` attribute. A file
+    with no speculative activity reports all-zero (the default,
+    VIZIER_SPECULATIVE=0).
+    """
+    counts = {"hit": 0, "miss": 0, "stale": 0, "precomputes": 0, "stored": 0}
+    for span in spans:
+        if span.get("name") == "speculative.precompute":
+            counts["precomputes"] += 1
+            if (span.get("attributes") or {}).get("outcome") == "stored":
+                counts["stored"] += 1
+        for event in span.get("events") or []:
+            name = event.get("name", "")
+            if name.startswith("speculative."):
+                outcome = name.split(".", 1)[1]
+                if outcome in ("hit", "miss", "stale"):
+                    counts[outcome] += 1
+    served = counts["hit"] + counts["miss"] + counts["stale"]
+    counts["hit_rate"] = round(counts["hit"] / served, 4) if served else 0.0
+    return counts
+
+
 def render_table(rows: List[dict]) -> str:
     with_occ = any("mean_occupancy" in row for row in rows)
     header = f"{'phase':<34} {'count':>6} {'p50 ms':>9} {'p95 ms':>9} {'p99 ms':>9} {'max ms':>9} {'total ms':>10}"
@@ -190,12 +216,14 @@ def main() -> None:
         return
     rows = phase_breakdown(spans)
     activity = surrogate_activity(spans)
+    speculative = speculative_activity(spans)
     if args.json:
         print(
             json.dumps(
                 {
                     "spans": len(spans),
                     "surrogate_activity": activity,
+                    "speculative_activity": speculative,
                     "phases": rows,
                 },
                 indent=2,
@@ -207,6 +235,12 @@ def main() -> None:
             f"surrogate mode: {activity['mode']} "
             f"(exact device phases: {activity['exact']}, "
             f"sparse: {activity['sparse']})"
+        )
+        print(
+            f"speculative: hit {speculative['hit']} / miss "
+            f"{speculative['miss']} / stale {speculative['stale']} "
+            f"(hit rate {speculative['hit_rate']:.0%}, precomputes "
+            f"{speculative['precomputes']}, stored {speculative['stored']})"
         )
         print(render_table(rows))
 

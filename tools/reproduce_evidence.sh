@@ -36,6 +36,13 @@ echo "== 3c. sparse-surrogate A/B at the north-star scale (~10 min) =="
 #    seeds, and the VIZIER_SPARSE=0 bit-identity check
 JAX_PLATFORMS=cpu python tools/surrogate_ab.py
 
+echo "== 3d. speculative pre-compute A/B (~4 min) =="
+#    -> SPECULATIVE_AB.json: sequential complete->suggest loop, 5 seeds;
+#    speculative-hit suggest p50 < 10 ms vs the full-GP baseline,
+#    hit rate >= 80%, and bit-identical trajectories (a hit is the live
+#    compute run early; VIZIER_SPECULATIVE=0 stays the seed path)
+JAX_PLATFORMS=cpu python tools/speculative_ab.py --trials 25 --seeds 5 --acquisition-evals 0
+
 echo "== 4. budget-policy A/B, 5 seeds x 3 families (~45 min) =="
 #    -> budget_ab_r5.json
 JAX_PLATFORMS=cpu python tools/budget_policy_ab.py

@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""Speculative pre-compute A/B: suggest latency with the background
+pipeline on vs off, on the canonical sequential complete→suggest loop.
+
+Both arms drive the SAME workload through the full in-process service
+stack (VizierServicer → PythiaServicer → coalescer → cached-designer
+policy → DEFAULT UCB-PE designer): one worker runs a study to ``--trials``
+trials, completing each suggestion with a seeded sphere objective before
+asking for the next. Per-study designers, budgets, and seeds are identical
+across arms; only the speculative engine differs:
+
+- **baseline** — every suggest pays the full GP train + acquisition on
+  the request path (the current serving shape);
+- **speculative** — each completion triggers a background pre-compute of
+  the next batch; the worker's evaluation window is modeled by waiting
+  for the engine to go idle before the next suggest (an evaluation that
+  outlasts the pre-compute — the serving steady state this feature
+  targets; ``--think-time`` switches to a fixed sleep instead).
+
+Because a speculative hit is the live compute run early (same cached
+designer, same RNG order), the two arms must produce **bit-identical
+suggestion trajectories** — checked per seed, which simultaneously
+verifies hit bit-equality and that `VIZIER_SPECULATIVE=0` is the seed
+path. Regret parity across seeds is reported as a rank-sum p-value on the
+final best objective values (trivially parity when every trajectory is
+bit-equal, reported anyway as the headline evidence shape).
+
+Evidence lands in ``SPECULATIVE_AB.json``: per-arm suggest p50/p95/p99,
+hit-only latency percentiles, hit rate, per-seed bit-equality, regret
+parity, and the speedup ratio. Acceptance: speculative-hit suggest
+p50 < 10 ms, hit rate >= 80%, bit-equal trajectories at every seed.
+
+Usage:  python tools/speculative_ab.py [--trials 25] [--seeds 5] [--out SPECULATIVE_AB.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("VIZIER_DISABLE_MESH", "1")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from vizier_tpu import pyvizier as vz  # noqa: E402
+from vizier_tpu.serving import runtime as runtime_lib  # noqa: E402
+from vizier_tpu.serving import speculative as spec_lib  # noqa: E402
+from vizier_tpu.service import proto_converters as pc  # noqa: E402
+from vizier_tpu.service import pythia_service, vizier_service  # noqa: E402
+from vizier_tpu.service.protos import vizier_service_pb2  # noqa: E402
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    rank = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = rank - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _pcts_ms(values):
+    values = sorted(values)
+    return {
+        "p50_ms": round(_percentile(values, 50) * 1e3, 3),
+        "p95_ms": round(_percentile(values, 95) * 1e3, 3),
+        "p99_ms": round(_percentile(values, 99) * 1e3, 3),
+        "max_ms": round((values[-1] if values else 0.0) * 1e3, 3),
+        "samples": len(values),
+    }
+
+
+def _study_config(dim: int) -> vz.StudyConfig:
+    config = vz.StudyConfig(algorithm="DEFAULT")
+    for d in range(dim):
+        config.search_space.root.add_float_param(f"x{d}", 0.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+def _sphere(trial_proto) -> float:
+    return -sum(
+        (p.value.double_value - 0.3) ** 2 for p in trial_proto.parameters
+    )
+
+
+def _build_stack(speculative: bool, acquisition_evals: int, seed: int):
+    """The full in-process service stack with the REAL policy factory;
+    the per-run designer rng seed (and an optional trimmed acquisition
+    budget) is injected through the factory's kwargs hook so both arms of
+    a seed share the exact same designer configuration."""
+    from vizier_tpu.service import policy_factory as policy_factory_lib
+
+    servicer = vizier_service.VizierServicer()
+    pythia = pythia_service.PythiaServicer(servicer)
+    runtime = runtime_lib.ServingRuntime(
+        speculative=spec_lib.SpeculativeConfig(speculative=speculative)
+    )
+    pythia._serving = runtime
+
+    base_factory = policy_factory_lib.DefaultPolicyFactory(
+        serving_runtime=runtime
+    )
+    original_kwargs = base_factory._gp_designer_kwargs
+
+    def seeded_kwargs():
+        kwargs = original_kwargs()
+        kwargs["rng_seed"] = seed
+        if acquisition_evals:
+            kwargs["max_acquisition_evaluations"] = acquisition_evals
+        return kwargs
+
+    base_factory._gp_designer_kwargs = seeded_kwargs
+    pythia._policy_factory = base_factory
+    pythia._bind_speculative()
+    servicer.set_pythia(pythia)
+    return servicer, pythia
+
+
+def _run_arm(
+    *,
+    speculative: bool,
+    seed: int,
+    dim: int,
+    trials: int,
+    warmup: int,
+    think_time: float,
+    acquisition_evals: int,
+) -> dict:
+    servicer, pythia = _build_stack(speculative, acquisition_evals, seed)
+    study_name = f"owners/ab/studies/{'spec' if speculative else 'base'}-{seed}"
+    servicer.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(
+            parent="owners/ab",
+            study=pc.study_to_proto(_study_config(dim), study_name),
+        )
+    )
+    engine = pythia.serving_runtime.speculative_engine
+    latencies, hits, trajectory, best = [], [], [], []
+    best_so_far = float("-inf")
+    try:
+        for step in range(trials):
+            t0 = time.perf_counter()
+            op = servicer.SuggestTrials(
+                vizier_service_pb2.SuggestTrialsRequest(
+                    parent=study_name, suggestion_count=1, client_id="worker"
+                )
+            )
+            elapsed = time.perf_counter() - t0
+            if op.error:
+                raise RuntimeError(f"suggest failed at step {step}: {op.error}")
+            trial = op.response.trials[0]
+            hit = any(
+                kv.key == spec_lib.SPECULATIVE_KEY
+                and kv.string_value == spec_lib.SPECULATIVE_HIT_VALUE
+                for kv in trial.metadata
+            )
+            if step >= warmup:
+                latencies.append(elapsed)
+                hits.append(hit)
+            trajectory.append(
+                tuple(
+                    sorted(
+                        (p.name, round(p.value.double_value, 12))
+                        for p in trial.parameters
+                    )
+                )
+            )
+            objective = _sphere(trial)
+            best_so_far = max(best_so_far, objective)
+            best.append(best_so_far)
+            request = vizier_service_pb2.CompleteTrialRequest(name=trial.name)
+            metric = request.final_measurement.metrics.add()
+            metric.name, metric.value = "obj", objective
+            servicer.CompleteTrial(request)
+            # The evaluation window: long enough for the pre-compute to
+            # land (wait_idle), or a fixed think time if requested.
+            if engine is not None:
+                if think_time > 0:
+                    time.sleep(think_time)
+                else:
+                    engine.wait_idle(300.0)
+        stats = {
+            k: v
+            for k, v in pythia.serving_stats().items()
+            if k.startswith("speculative_")
+        }
+    finally:
+        pythia.shutdown()
+    hit_lat = [l for l, h in zip(latencies, hits) if h]
+    miss_lat = [l for l, h in zip(latencies, hits) if not h]
+    return {
+        "seed": seed,
+        "suggest": _pcts_ms(latencies),
+        "hit_suggest": _pcts_ms(hit_lat),
+        "miss_suggest": _pcts_ms(miss_lat),
+        "hits": sum(hits),
+        "measured": len(hits),
+        "stats": stats,
+        "trajectory": trajectory,
+        "best_curve": [round(b, 9) for b in best],
+    }
+
+
+def _ranksum_p(a, b) -> float:
+    """Two-sided rank-sum p-value (scipy when present, else normal approx)."""
+    try:
+        from scipy import stats as sps
+
+        return float(sps.ranksums(a, b).pvalue)
+    except Exception:
+        import math
+
+        n, m = len(a), len(b)
+        ranked = sorted((v, 0) for v in a) + sorted((v, 1) for v in b)
+        ranked.sort()
+        ra = sum(i + 1 for i, (v, g) in enumerate(ranked) if g == 0)
+        mu = n * (n + m + 1) / 2.0
+        sigma = math.sqrt(n * m * (n + m + 1) / 12.0) or 1.0
+        z = (ra - mu) / sigma
+        return 2.0 * (1.0 - 0.5 * (1.0 + math.erf(abs(z) / math.sqrt(2)))) or 1.0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--trials", type=int, default=25)
+    parser.add_argument("--seeds", type=int, default=5)
+    parser.add_argument("--dim", type=int, default=3)
+    parser.add_argument("--warmup", type=int, default=3,
+                        help="Suggests excluded from latency stats (compile).")
+    parser.add_argument("--think-time", type=float, default=0.0,
+                        help="Fixed evaluation sleep instead of wait_idle.")
+    parser.add_argument("--acquisition-evals", type=int, default=1000,
+                        help="Acquisition sweep budget (0 = designer default).")
+    parser.add_argument("--out", default="SPECULATIVE_AB.json")
+    args = parser.parse_args()
+
+    arms = {"baseline": [], "speculative": []}
+    bit_equal, t_start = [], time.time()
+    for seed in range(1, args.seeds + 1):
+        base = _run_arm(
+            speculative=False, seed=seed, dim=args.dim, trials=args.trials,
+            warmup=args.warmup, think_time=args.think_time,
+            acquisition_evals=args.acquisition_evals,
+        )
+        spec = _run_arm(
+            speculative=True, seed=seed, dim=args.dim, trials=args.trials,
+            warmup=args.warmup, think_time=args.think_time,
+            acquisition_evals=args.acquisition_evals,
+        )
+        equal = base["trajectory"] == spec["trajectory"]
+        bit_equal.append(equal)
+        arms["baseline"].append(base)
+        arms["speculative"].append(spec)
+        print(
+            f"[seed {seed}] baseline p50 "
+            f"{base['suggest']['p50_ms']:.0f} ms | speculative hit p50 "
+            f"{spec['hit_suggest']['p50_ms']:.2f} ms | hits "
+            f"{spec['hits']}/{spec['measured']} | bit-equal {equal}",
+            flush=True,
+        )
+
+    hits_total = sum(r["hits"] for r in arms["speculative"])
+    measured_total = sum(r["measured"] for r in arms["speculative"])
+    base_final = [r["best_curve"][-1] for r in arms["baseline"]]
+    spec_final = [r["best_curve"][-1] for r in arms["speculative"]]
+    hit_p50s = [r["hit_suggest"]["p50_ms"] for r in arms["speculative"]]
+    hit_p99s = [r["hit_suggest"]["p99_ms"] for r in arms["speculative"]]
+    base_p50s = [r["suggest"]["p50_ms"] for r in arms["baseline"]]
+    base_p99s = [r["suggest"]["p99_ms"] for r in arms["baseline"]]
+
+    summary = {
+        "workload": {
+            "trials": args.trials,
+            "seeds": args.seeds,
+            "dim": args.dim,
+            "warmup_excluded": args.warmup,
+            "algorithm": "DEFAULT (GP-UCB-PE)",
+            "acquisition_evals": args.acquisition_evals,
+            "evaluation_model": (
+                f"sleep {args.think_time}s" if args.think_time > 0
+                else "wait_idle (evaluation outlasts pre-compute)"
+            ),
+            "backend": "cpu",
+        },
+        "speculative_config": spec_lib.SpeculativeConfig(
+            speculative=True
+        ).as_dict(),
+        "baseline_suggest_p50_ms": round(
+            sum(base_p50s) / len(base_p50s), 3
+        ),
+        "baseline_suggest_p99_ms": round(max(base_p99s), 3),
+        "speculative_hit_p50_ms": round(sum(hit_p50s) / len(hit_p50s), 4),
+        "speculative_hit_p99_ms": round(max(hit_p99s), 4),
+        "speedup_p50": round(
+            (sum(base_p50s) / len(base_p50s))
+            / max(sum(hit_p50s) / len(hit_p50s), 1e-9),
+            1,
+        ),
+        "hit_rate": round(hits_total / max(measured_total, 1), 4),
+        "bit_identical_trajectories": f"{sum(bit_equal)}/{len(bit_equal)}",
+        "regret_parity": {
+            "baseline_final_best": base_final,
+            "speculative_final_best": spec_final,
+            "ranksum_p": round(_ranksum_p(base_final, spec_final), 4),
+        },
+        "acceptance": {
+            "hit_p50_under_10ms": all(p < 10.0 for p in hit_p50s),
+            "hit_rate_ge_80pct": hits_total / max(measured_total, 1) >= 0.80,
+            "bit_equal_all_seeds": all(bit_equal),
+        },
+        "per_seed": {
+            arm: [
+                {k: v for k, v in row.items() if k not in ("trajectory",)}
+                for row in rows
+            ]
+            for arm, rows in arms.items()
+        },
+        "wall_seconds": round(time.time() - t_start, 1),
+    }
+    out_path = pathlib.Path(args.out)
+    out_path.write_text(json.dumps(summary, indent=2) + "\n")
+    print(json.dumps({k: summary[k] for k in (
+        "baseline_suggest_p50_ms", "speculative_hit_p50_ms", "speedup_p50",
+        "hit_rate", "bit_identical_trajectories", "acceptance",
+    )}, indent=2))
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

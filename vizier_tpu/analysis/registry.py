@@ -120,6 +120,21 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             _RUN_DOC, "fsync the WAL per append (power-loss durability).", "0"),
     _switch("VIZIER_DISTRIBUTED_ROUTE_CACHE_SIZE", "int", "StudyRouter",
             _RUN_DOC, "LRU cap on the router's placement cache.", "65536"),
+    # -- speculative pre-compute (SpeculativeConfig) -----------------------
+    _switch("VIZIER_SPECULATIVE", "flag", "SpeculativeConfig", _SRV_DOC,
+            "Background pre-compute of the next suggestion batch after "
+            "each completion (opt-in; unset/0 = the exact request path).",
+            "0"),
+    _switch("VIZIER_SPECULATIVE_WORKERS", "int", "SpeculativeConfig",
+            _SRV_DOC, "Speculative worker-pool size.", "1"),
+    _switch("VIZIER_SPECULATIVE_MAX_AGE_S", "float", "SpeculativeConfig",
+            _SRV_DOC,
+            "Staleness deadline: a parked batch older than this is never "
+            "served.", "300.0"),
+    _switch("VIZIER_SPECULATIVE_ON_FILL", "flag", "SpeculativeConfig",
+            _SRV_DOC,
+            "Also pre-compute after each live suggest (for a second "
+            "client at the post-suggest frontier).", "0"),
     # -- surrogates (SurrogateConfig) --------------------------------------
     _switch("VIZIER_SPARSE", "flag", "SurrogateConfig", _PERF_DOC,
             "Sparse-GP surrogate auto-switch (off = exact GP always).", "1"),

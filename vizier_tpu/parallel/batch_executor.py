@@ -28,6 +28,16 @@ only to that study's waiter, which hands it to the existing reliability
 path (retry / circuit breaker / quasi-random fallback) — batchmates are
 never poisoned.
 
+Priority lanes: slots submitted with ``speculative=True`` (the serving
+tier's background pre-compute, ``vizier_tpu.serving.speculative``) ride a
+live flush that is forming anyway, but a bucket holding ONLY speculative
+slots waits for the idle window — it never becomes due while a live slot
+is queued in any bucket (bounded by ``speculative_max_wait_ms`` so a live
+request coalesced onto an in-flight speculative compute cannot starve),
+and due live batches always execute first. ``queue_depth()`` /
+``live_pending()`` expose per-lane occupancy so the speculative admission
+gate can refuse to enqueue under live saturation.
+
 Batchable designers expose four duck-typed hooks (``gp_bandit`` and
 ``gp_ucb_pe`` implement them; anything else runs sequentially):
 
@@ -95,10 +105,13 @@ class _Slot:
 
     __slots__ = (
         "designer", "count", "enqueued_at", "event", "error",
-        "item", "output", "action", "span",
+        "item", "output", "action", "span", "speculative",
     )
 
-    def __init__(self, designer: Any, count: int, now: float, span) -> None:
+    def __init__(
+        self, designer: Any, count: int, now: float, span,
+        speculative: bool = False,
+    ) -> None:
         self.designer = designer
         self.count = count
         self.enqueued_at = now
@@ -108,6 +121,10 @@ class _Slot:
         self.output: Any = None
         self.action: str = "sequential"
         self.span = span  # the submitter's active span (may be None)
+        # Low-priority lane (serving.speculative): a speculative slot may
+        # ride a live flush that is forming anyway, but a bucket holding
+        # ONLY speculative slots defers to queued live traffic.
+        self.speculative = speculative
 
 
 def stack_pytrees(trees: Sequence[Any], pad_to: Optional[int] = None) -> Any:
@@ -183,11 +200,18 @@ class BatchExecutor:
         stats: Optional[Any] = None,  # serving.stats.ServingStats
         metrics: Optional[metrics_lib.MetricsRegistry] = None,
         time_fn: Callable[[], float] = time.monotonic,
+        speculative_max_wait_ms: float = 250.0,
     ):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
         self.max_batch_size = max_batch_size
         self.max_wait_secs = max(max_wait_ms, 0.0) / 1000.0
+        # Starvation cap for the speculative lane: a speculative-only
+        # bucket normally flushes only when no live slot is queued anywhere
+        # (the idle window), but a live request that COALESCED onto an
+        # in-flight speculative compute is waiting on it, so the hold is
+        # bounded — after this long the speculative flush runs regardless.
+        self.speculative_max_wait_secs = max(speculative_max_wait_ms, 0.0) / 1000.0
         self.pad_partial = pad_partial
         self._stats = stats
         self._time = time_fn
@@ -213,12 +237,20 @@ class BatchExecutor:
 
     # -- submission ---------------------------------------------------------
 
-    def suggest(self, designer: Any, count: Optional[int] = None) -> List[Any]:
+    def suggest(
+        self,
+        designer: Any,
+        count: Optional[int] = None,
+        *,
+        speculative: bool = False,
+    ) -> List[Any]:
         """Routes one study's suggest through the batching engine.
 
         Unbatchable paths (designer without the protocol, seeding stage,
         multi-objective, priors, …) run inline on the caller's thread —
-        identical to batching off.
+        identical to batching off. ``speculative`` marks the slot for the
+        low-priority lane: it never makes a bucket flush while live slots
+        are queued (see :meth:`_take_due`).
         """
         count = count or 1
         key_fn = getattr(designer, "batch_bucket_key", None)
@@ -226,7 +258,10 @@ class BatchExecutor:
         if key is None or self._closed:
             return designer.suggest(count)
         tracer = tracing_lib.get_tracer()
-        slot = _Slot(designer, count, self._time(), tracer.current_span())
+        slot = _Slot(
+            designer, count, self._time(), tracer.current_span(),
+            speculative=speculative,
+        )
         # Joining a non-empty bucket ⇒ this slot will (very likely) ride a
         # batched flush: run its host-side prepare HERE, on the caller's
         # thread, so it overlaps the in-flight flush's device window instead
@@ -292,6 +327,23 @@ class BatchExecutor:
         with self._cond:
             return {k.label(): len(v) for k, v in self._queues.items() if v}
 
+    def queue_depth(self) -> Dict[str, int]:
+        """Queued slots by lane — the speculative admission gate's view of
+        whether live traffic is saturating the flush buckets."""
+        live = spec = 0
+        with self._cond:
+            for slots in self._queues.values():
+                for slot in slots:
+                    if slot.speculative:
+                        spec += 1
+                    else:
+                        live += 1
+        return {"live": live, "speculative": spec}
+
+    def live_pending(self) -> int:
+        """Queued LIVE (non-speculative) slots across all buckets."""
+        return self.queue_depth()["live"]
+
     # -- scheduling ---------------------------------------------------------
 
     def _ensure_scheduler(self) -> None:
@@ -304,30 +356,86 @@ class BatchExecutor:
             self._thread.start()
 
     def _take_due(self) -> List[Tuple[BucketKey, List[_Slot], str]]:
-        """Pops every due (key, slots, reason) batch. Caller holds the lock."""
+        """Pops every due (key, slots, reason) batch. Caller holds the lock.
+
+        Two lanes: a bucket containing at least one LIVE slot flushes on
+        the ordinary full/timeout rules. A speculative-only bucket defers
+        while any live slot is queued anywhere (live traffic owns the
+        device; the idle window is speculation's admission), flushing only
+        once the queues are live-free — or after ``speculative_max_wait``,
+        the bounded-starvation escape for live requests that coalesced
+        onto an in-flight speculative compute. Due live batches always
+        execute before due speculative ones.
+        """
         now = self._time()
-        due: List[Tuple[BucketKey, List[_Slot], str]] = []
+        live_due: List[Tuple[BucketKey, List[_Slot], str]] = []
+        spec_candidates: List[Tuple[BucketKey, List[_Slot]]] = []
         for key, slots in self._queues.items():
-            while len(slots) >= self.max_batch_size:
-                due.append((key, slots[: self.max_batch_size], "full"))
-                del slots[: self.max_batch_size]
-            if slots and (
-                self._closed
-                or now - slots[0].enqueued_at >= self.max_wait_secs
-            ):
-                due.append((key, slots[:], "drain" if self._closed else "timeout"))
+            if not slots:
+                continue
+            if self._closed:
+                live_due.append((key, slots[:], "drain"))
                 slots.clear()
-        return due
+                continue
+            if any(not s.speculative for s in slots):
+                while len(slots) >= self.max_batch_size:
+                    live_due.append((key, slots[: self.max_batch_size], "full"))
+                    del slots[: self.max_batch_size]
+                if slots and now - slots[0].enqueued_at >= self.max_wait_secs:
+                    live_due.append((key, slots[:], "timeout"))
+                    slots.clear()
+            else:
+                spec_candidates.append((key, slots))
+        live_queued = any(
+            not s.speculative
+            for slots in self._queues.values()
+            for s in slots
+        )
+        spec_due: List[Tuple[BucketKey, List[_Slot], str]] = []
+        for key, slots in spec_candidates:
+            if not slots:
+                continue
+            waited = now - slots[0].enqueued_at
+            if not live_queued and (
+                len(slots) >= self.max_batch_size or waited >= self.max_wait_secs
+            ):
+                reason = "full" if len(slots) >= self.max_batch_size else "timeout"
+            elif waited >= self.speculative_max_wait_secs:
+                reason = "spec_starved"
+            else:
+                continue
+            # A deferred bucket may have grown past the batch size: flush
+            # in max-size chunks so the compiled shape stays the bucket's.
+            while len(slots) > self.max_batch_size:
+                spec_due.append((key, slots[: self.max_batch_size], "full"))
+                del slots[: self.max_batch_size]
+            spec_due.append((key, slots[:], reason))
+            slots.clear()
+        return live_due + spec_due
 
     def _next_deadline(self) -> Optional[float]:
-        """Seconds until the oldest queued slot times out (lock held)."""
-        oldest = None
+        """Seconds until the next queued bucket becomes due (lock held)."""
+        live_queued = any(
+            not s.speculative
+            for slots in self._queues.values()
+            for s in slots
+        )
+        deadline = None
         for slots in self._queues.values():
-            if slots and (oldest is None or slots[0].enqueued_at < oldest):
-                oldest = slots[0].enqueued_at
-        if oldest is None:
+            if not slots:
+                continue
+            if any(not s.speculative for s in slots):
+                window = self.max_wait_secs
+            elif live_queued:
+                window = self.speculative_max_wait_secs
+            else:
+                window = self.max_wait_secs
+            due_at = slots[0].enqueued_at + window
+            if deadline is None or due_at < deadline:
+                deadline = due_at
+        if deadline is None:
             return None
-        return max(oldest + self.max_wait_secs - self._time(), 0.0)
+        return max(deadline - self._time(), 0.0)
 
     def _scheduler_loop(self) -> None:
         while True:

@@ -24,7 +24,9 @@ from vizier_tpu.reliability import fallback as fallback_lib
 from vizier_tpu.service import policy_factory as policy_factory_lib
 from vizier_tpu.service import proto_converters as pc
 from vizier_tpu.service import service_policy_supporter
-from vizier_tpu.service.protos import pythia_service_pb2
+from vizier_tpu.service.protos import pythia_service_pb2, study_pb2
+from vizier_tpu.service.protos import vizier_service_pb2
+from vizier_tpu.serving import speculative as speculative_lib
 
 _logger = logging.getLogger(__name__)
 
@@ -67,9 +69,23 @@ class PythiaServicer:
         # Early-stopping policies cached per study (regression rule holds a
         # trained GBM; see EarlyStop dispatch).
         self._stopping_policies = {}
+        self._bind_speculative()
 
     def connect_to_vizier(self, vizier_service) -> None:
         self._vizier = vizier_service
+        self._bind_speculative()
+
+    def _bind_speculative(self) -> None:
+        """Connects the runtime's speculative engine to THIS servicer's
+        compute path (needs a Vizier service to read frontiers from)."""
+        engine = self._serving.speculative_engine
+        if engine is None or self._vizier is None:
+            return
+        engine.bind(
+            fingerprint_fn=self._speculative_fingerprint,
+            compute_fn=self._speculative_compute,
+            accept_fn=self._speculative_accept,
+        )
 
     @property
     def serving_runtime(self):
@@ -208,7 +224,172 @@ class PythiaServicer:
             span_name="pythia.suggest_compute",
         )
 
+    # -- speculative pre-compute (vizier_tpu.serving.speculative) -----------
+
+    def notify_trial_event(self, study_name: str) -> None:
+        """A completion/measurement moved the study's frontier: drop the
+        parked batch and enqueue a pre-compute for the new frontier."""
+        engine = self._serving.speculative_engine
+        if engine is not None and engine.bound:
+            engine.notify_completion(study_name)
+
+    def _trial_frontier(self, study_name: str):
+        """``(completed_ids, active_ids, max_trial_id)`` via the connected
+        Vizier service (copy-free fast path when in-process)."""
+        frontier = getattr(self._vizier, "trial_frontier", None)
+        if frontier is not None:
+            return frontier(study_name)
+        listing = self._vizier.ListTrials(
+            vizier_service_pb2.ListTrialsRequest(parent=study_name)
+        )
+        completed, active, max_id = [], [], 0
+        for t in listing.trials:
+            max_id = max(max_id, int(t.id))
+            if t.state in (study_pb2.Trial.SUCCEEDED, study_pb2.Trial.INFEASIBLE):
+                completed.append(int(t.id))
+            elif t.state == study_pb2.Trial.ACTIVE:
+                active.append(int(t.id))
+        return completed, active, max_id
+
+    def _speculative_fingerprint(self, study_name: str):
+        """Job-side frontier read: the fingerprint the parked batch will be
+        served under, captured BEFORE the compute (conservative: anything
+        landing after this point makes the slot a serve-time mismatch)."""
+        study = self._vizier.GetStudy(
+            vizier_service_pb2.GetStudyRequest(name=study_name)
+        )
+        completed, active, max_id = self._trial_frontier(study_name)
+        fingerprint = speculative_lib.make_fingerprint(
+            study.study_spec.SerializeToString(), completed, active
+        )
+        return fingerprint, max_id
+
+    def _speculative_compute(
+        self, study_name: str, count: int, max_trial_id: int
+    ) -> Optional[pythia_service_pb2.PythiaSuggestResponse]:
+        """Runs one speculative job through the EXACT live suggest path
+        (coalescer → policy → designer cache → batch executor), so a hit
+        is the live compute run early — same designer state mutations,
+        same RNG order, same batching buckets (at low flush priority via
+        the speculative-scope thread flag the engine sets)."""
+        study = self._vizier.GetStudy(
+            vizier_service_pb2.GetStudyRequest(name=study_name)
+        )
+        if study.state != study_pb2.Study.ACTIVE:
+            return None
+        preq = pythia_service_pb2.PythiaSuggestRequest(
+            count=count,
+            algorithm=study.study_spec.algorithm,
+            study_name=study_name,
+        )
+        preq.study_descriptor.config.CopyFrom(study.study_spec)
+        preq.study_descriptor.guid = study_name
+        preq.study_descriptor.max_trial_id = max_trial_id
+        return self._suggest_coalesced(preq)
+
+    def _speculative_accept(
+        self, response: pythia_service_pb2.PythiaSuggestResponse
+    ) -> Optional[int]:
+        """Batch size when the response is servable, else None. A response
+        carrying an error, no suggestions, or the reliability fallback
+        stamp must never be parked: serving cached quasi-random picks when
+        a live compute might succeed would silently degrade the study."""
+        if response is None or response.error or not response.suggestions:
+            return None
+        for suggestion in response.suggestions:
+            for kv in suggestion.metadata:
+                if (
+                    kv.key == fallback_lib.FALLBACK_KEY
+                    and kv.string_value == fallback_lib.FALLBACK_VALUE
+                ):
+                    return None
+        return len(response.suggestions)
+
+    def _try_speculative_serve(
+        self, engine, request: pythia_service_pb2.PythiaSuggestRequest
+    ) -> Optional[pythia_service_pb2.PythiaSuggestResponse]:
+        """The microsecond path: pop the parked batch when the request's
+        frontier fingerprint (current completed/active sets + config hash)
+        matches the one it was computed for. Any failure here decays to
+        the live compute — the speculative layer must never break a
+        suggest."""
+        study_name = request.study_name
+        if not study_name:
+            return None
+        count = max(1, int(request.count))
+        try:
+            engine.note_live_suggest(study_name, count)
+            completed, active, _ = self._trial_frontier(study_name)
+            fingerprint = speculative_lib.make_fingerprint(
+                request.study_descriptor.config.SerializeToString(),
+                completed,
+                active,
+            )
+            response, outcome = engine.try_serve(study_name, count, fingerprint)
+        except Exception:
+            _logger.warning(
+                "Speculative serve check failed for %s; computing live.",
+                study_name,
+                exc_info=True,
+            )
+            return None
+        if response is None:
+            return None
+        del outcome  # "hit" — the only outcome with a response
+        return self._stamp_speculative(response, count)
+
+    @staticmethod
+    def _stamp_speculative(
+        response: pythia_service_pb2.PythiaSuggestResponse, count: int
+    ) -> pythia_service_pb2.PythiaSuggestResponse:
+        """A private copy of the parked response, reconciled to ``count``
+        (serving the batch prefix when the client asked for fewer) and
+        stamped ``ns "serving": speculative=hit`` per suggestion so served
+        speculative picks stay auditable in trial metadata."""
+        out = pythia_service_pb2.PythiaSuggestResponse()
+        out.CopyFrom(response)
+        if count < len(out.suggestions):
+            del out.suggestions[count:]
+        stamp = vz.Metadata()
+        stamp.ns(speculative_lib.SPECULATIVE_NAMESPACE)[
+            speculative_lib.SPECULATIVE_KEY
+        ] = speculative_lib.SPECULATIVE_HIT_VALUE
+        key_values = pc.metadata_to_key_values(stamp)
+        for suggestion in out.suggestions:
+            suggestion.metadata.extend(key_values)
+        return out
+
     def _suggest_compute(
+        self, request: pythia_service_pb2.PythiaSuggestRequest
+    ) -> pythia_service_pb2.PythiaSuggestResponse:
+        """Speculative serve check wrapped around the live compute.
+
+        With no engine (VIZIER_SPECULATIVE=0, the default) this is a
+        direct tail call into the live path — bit-identical to the
+        pre-speculation tree. Inside a speculative job's own compute the
+        check is skipped too (a job must compute, not self-serve)."""
+        engine = self._serving.speculative_engine
+        if (
+            engine is None
+            or not engine.bound
+            or speculative_lib.in_speculative_compute()
+        ):
+            return self._suggest_compute_live(request)
+        t0 = time.perf_counter()
+        served = self._try_speculative_serve(engine, request)
+        if served is not None:
+            engine.observe_suggest_latency("hit", time.perf_counter() - t0)
+            return served
+        response = self._suggest_compute_live(request)
+        engine.observe_suggest_latency("miss", time.perf_counter() - t0)
+        if not response.error:
+            # "Cache fill" trigger (opt-in): the live compute just
+            # refreshed the designer entry; pre-compute the batch a second
+            # client at the post-suggest frontier would receive.
+            engine.notify_fill(request.study_name)
+        return response
+
+    def _suggest_compute_live(
         self, request: pythia_service_pb2.PythiaSuggestRequest
     ) -> pythia_service_pb2.PythiaSuggestResponse:
         response = pythia_service_pb2.PythiaSuggestResponse()

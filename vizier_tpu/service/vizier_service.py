@@ -100,6 +100,44 @@ class VizierServicer:
         dump = getattr(self._pythia, "prometheus_text", None)
         return dump() if dump is not None else ""
 
+    def trial_frontier(self, study_name: str) -> Tuple[List[int], List[int], int]:
+        """``(completed_ids, active_ids, max_trial_id)`` for a study.
+
+        The designer-visible frontier identity, read as bare id/state
+        pairs (no proto copies): completed = SUCCEEDED|INFEASIBLE (what
+        the policy feeds ``designer.update``), active = ACTIVE (the
+        pending points batch designers condition on). The speculative
+        pre-compute pipeline fingerprints this to decide whether a parked
+        suggestion batch still matches reality.
+        """
+        completed: List[int] = []
+        active: List[int] = []
+        max_id = 0
+        for trial_id, state in self.datastore.trial_states(study_name):
+            trial_id = int(trial_id)
+            max_id = max(max_id, trial_id)
+            if state in (study_pb2.Trial.SUCCEEDED, study_pb2.Trial.INFEASIBLE):
+                completed.append(trial_id)
+            elif state == study_pb2.Trial.ACTIVE:
+                active.append(trial_id)
+        return completed, active, max_id
+
+    def _notify_trial_event(self, study_name: str) -> None:
+        """Tells the in-process Pythia the study's frontier moved, so it
+        can invalidate + re-speculate the next suggestion batch. Called
+        OUTSIDE the study lock (the engine enqueue takes its own queue
+        lock; nesting it under a study lock would widen the serving lock
+        graph for a trigger that needs no datastore state). Best-effort:
+        a remote Pythia stub has no trigger surface and relies on the
+        serve-time fingerprint check alone."""
+        notify = getattr(self._pythia, "notify_trial_event", None)
+        if notify is None:
+            return
+        try:
+            notify(study_name)
+        except Exception as e:  # completion must not fail on speculation
+            _logger.warning("Speculative trigger failed for %s: %s", study_name, e)
+
     def record_client_retry(self, amount: int = 1) -> None:
         """Client-side retry accounting (no-op without in-process Pythia).
 
@@ -504,12 +542,27 @@ class VizierServicer:
                 raise ValueError(f"Trial {request.trial_name} is already completed.")
             trial.measurements.add().CopyFrom(request.measurement)
             self.datastore.update_trial(trial)
-            return trial
+        self._notify_trial_event(study_name)
+        return trial
 
     def CompleteTrial(
         self, request: vizier_service_pb2.CompleteTrialRequest, context=None
     ) -> study_pb2.Trial:
         study_name = resources.TrialResource.from_name(request.name).study_resource.name
+        # The completion gets a span of its own: it is the trigger edge of
+        # the speculative pre-compute pipeline, and the precompute span
+        # links back here — "this completion set that compute in motion".
+        tracer = tracing_lib.get_tracer()
+        with tracer.span(
+            "service.complete_trial", study=study_name, trial=request.name
+        ):
+            trial = self._complete_trial(request, study_name)
+            self._notify_trial_event(study_name)
+        return trial
+
+    def _complete_trial(
+        self, request: vizier_service_pb2.CompleteTrialRequest, study_name: str
+    ) -> study_pb2.Trial:
         with self._study_locks[study_name]:
             trial = self.datastore.get_trial(request.name)
             study = self.datastore.load_study(study_name)

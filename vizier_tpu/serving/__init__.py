@@ -13,7 +13,10 @@ between requests instead:
   through the cache with incremental trial updates and warm-started ARD;
 - :class:`ServingStats` — cache hit/miss, warm/cold train, and coalescing
   counters behind a small snapshot API;
-- :class:`ServingConfig` — the knobs (all on by default; env-overridable).
+- :class:`ServingConfig` — the knobs (all on by default; env-overridable);
+- :class:`SpeculativeEngine` — opt-in background pre-compute of the next
+  suggestion batch after each completion, served from the cache entry
+  when the frontier fingerprint still matches (``VIZIER_SPECULATIVE=1``).
 
 The runtime also owns the cross-study batch executor
 (``vizier_tpu.parallel.batch_executor``): concurrent designer computations
@@ -30,6 +33,8 @@ from vizier_tpu.serving.designer_cache import CachedDesignerEntry
 from vizier_tpu.serving.designer_cache import DesignerStateCache
 from vizier_tpu.serving.policy import CachedDesignerStatePolicy
 from vizier_tpu.serving.runtime import ServingRuntime
+from vizier_tpu.serving.speculative import SpeculativeConfig
+from vizier_tpu.serving.speculative import SpeculativeEngine
 from vizier_tpu.serving.stats import ServingStats
 
 __all__ = [
@@ -40,4 +45,6 @@ __all__ = [
     "ServingConfig",
     "ServingRuntime",
     "ServingStats",
+    "SpeculativeConfig",
+    "SpeculativeEngine",
 ]

@@ -49,6 +49,12 @@ class CachedDesignerEntry:
         # state along with everything else.
         self.surrogate_mode: Any = None
         self.sparse_state: Any = None
+        # Speculative pre-compute slot (vizier_tpu.serving.speculative): a
+        # parked next-suggestion batch for one exact frontier fingerprint,
+        # swapped atomically under the engine's serve lock (never under
+        # this entry's designer lock — a slot pop must not wait behind an
+        # in-flight live compute). Dies with the entry on invalidation.
+        self.speculative: Any = None
         # Completed-trial ids already fed to the designer (incremental
         # updates only hand over the delta).
         self.incorporated_trial_ids: Set[int] = set()
@@ -175,6 +181,34 @@ class DesignerStateCache:
         tracing_lib.add_current_event(
             "designer_cache", result=result, seconds=round(seconds, 6)
         )
+
+    def peek(
+        self, study_name: str, touch: bool = True
+    ) -> Optional[CachedDesignerEntry]:
+        """The study's live entry, or None — never constructs a designer.
+
+        The speculative engine's lookup shape: parking or popping a
+        pre-computed batch must not build designer state for a study
+        nobody is serving. ``touch`` refreshes TTL/LRU (a served hit is a
+        real use); ``touch=False`` is a pure inspection read.
+        """
+        now = self._time()
+        with self._lock:
+            entry = self._entries.get(study_name)
+            if entry is None:
+                return None
+            if self._expired(entry, now):
+                del self._entries[study_name]
+                expired = True
+            else:
+                expired = False
+                if touch:
+                    entry.last_used_at = now
+                    self._entries.move_to_end(study_name)
+        if expired:
+            self._stats.increment("cache_evictions_ttl")
+            return None
+        return entry
 
     def invalidate(self, study_name: str) -> bool:
         """Drops the study's entry (study deleted / state known stale)."""

@@ -24,6 +24,8 @@ from vizier_tpu.pyvizier import base_study_config
 from vizier_tpu.pyvizier import trial as trial_
 from vizier_tpu.serving import designer_cache as cache_lib
 from vizier_tpu.serving import runtime as runtime_lib
+from vizier_tpu.serving import speculative as speculative_lib
+from vizier_tpu.surrogates import config as surrogate_config_lib
 
 _logger = logging.getLogger(__name__)
 
@@ -67,6 +69,14 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
         entry = cache.get_or_create(
             self._study_name, lambda: self._designer_factory(problem)
         )
+        # Surrogate-crossover invalidation hook: a parked speculative batch
+        # predates the crossover's warm/posterior reset, so the designer
+        # reports the flip straight into the engine the moment it happens
+        # (mid-compute), not after the policy's post-hoc stats diff.
+        if self._runtime.speculative_engine is not None:
+            surrogate_config_lib.install_crossover_listener(
+                entry.designer, self._on_surrogate_crossover
+            )
         with entry.lock:
             try:
                 return self._update_and_suggest(entry, count)
@@ -118,7 +128,16 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
             # the exact per-study call below.
             executor = getattr(self._runtime, "batch_executor", None)
             if executor is not None:
-                suggestions = list(executor.suggest(designer, count))
+                # A speculative job's compute rides the low-priority lane:
+                # it shares vmapped flush buckets with live traffic when
+                # one is already forming, but never delays a live flush.
+                suggestions = list(
+                    executor.suggest(
+                        designer,
+                        count,
+                        speculative=speculative_lib.in_speculative_compute(),
+                    )
+                )
             else:
                 suggestions = list(designer.suggest(count))
         self._account_trains(before, self._train_counts(designer))
@@ -139,6 +158,12 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
         entry.sparse_state = get_sparse() if get_sparse is not None else None
         entry.num_suggests += 1
         return suggestions
+
+    def _on_surrogate_crossover(self, old_mode: str, new_mode: str) -> None:
+        """The designer's exact↔sparse flip invalidates the parked batch."""
+        self._runtime.speculative_invalidate(
+            self._study_name, reason=f"crossover:{old_mode}->{new_mode}"
+        )
 
     @staticmethod
     def _train_counts(designer: Any) -> Optional[dict]:

@@ -24,6 +24,7 @@ from vizier_tpu.reliability import config as reliability_config_lib
 from vizier_tpu.serving import coalescer as coalescer_lib
 from vizier_tpu.serving import config as config_lib
 from vizier_tpu.serving import designer_cache as cache_lib
+from vizier_tpu.serving import speculative as speculative_lib
 from vizier_tpu.serving import stats as stats_lib
 from vizier_tpu.surrogates import config as surrogate_config_lib
 
@@ -63,6 +64,7 @@ class ServingRuntime:
         reliability: Optional[reliability_config_lib.ReliabilityConfig] = None,
         observability: Optional[obs_config_lib.ObservabilityConfig] = None,
         surrogates: Optional[surrogate_config_lib.SurrogateConfig] = None,
+        speculative: Optional[speculative_lib.SpeculativeConfig] = None,
     ):
         self.config = config or config_lib.ServingConfig.from_env()
         self.observability = (
@@ -127,6 +129,23 @@ class ServingRuntime:
                     self.metrics if self.observability.metrics_on else None
                 ),
             )
+        # Speculative pre-compute pipeline (vizier_tpu.serving.speculative):
+        # after each completion, the NEXT suggestion batch is computed in
+        # the background and served from the designer-cache entry. Requires
+        # the cache (the slot lives on its entries); None = off (the
+        # default, VIZIER_SPECULATIVE=0): the exact request path.
+        self.speculative = (
+            speculative or speculative_lib.SpeculativeConfig.from_env()
+        )
+        self.speculative_engine = None
+        if self.speculative.speculative and self.config.designer_cache:
+            self.speculative_engine = speculative_lib.SpeculativeEngine(
+                config=self.speculative,
+                cache=self.designer_cache,
+                stats=self.stats,
+                metrics=(self.metrics if self.observability.metrics_on else None),
+                executor=self.batch_executor,
+            )
         self._prewarmed_shapes: set = set()
         self._prewarm_lock = threading.Lock()
         self._prewarm_threads: List[threading.Thread] = []
@@ -180,8 +199,12 @@ class ServingRuntime:
 
     def shutdown(self) -> None:
         """Joins in-flight prewarm compiles (an XLA compile aborted by
-        interpreter teardown SIGABRTs the process) and drains the batch
+        interpreter teardown SIGABRTs the process), cancels speculative
+        jobs and joins their worker pool, and drains the batch executor —
+        in that order, so no speculative job can submit into a closing
         executor. Idempotent."""
+        if self.speculative_engine is not None:
+            self.speculative_engine.close()
         with self._prewarm_lock:
             threads, self._prewarm_threads = self._prewarm_threads, []
         for thread in threads:
@@ -199,9 +222,18 @@ class ServingRuntime:
         return self._suggest_latency
 
     def invalidate_study(self, study_name: str) -> bool:
-        """Drops the study's designer state + breaker (study deleted)."""
+        """Drops the study's designer state + breaker + speculative job
+        (study deleted)."""
         self.breakers.invalidate(study_name)
+        if self.speculative_engine is not None:
+            self.speculative_engine.invalidate(study_name, reason="delete_study")
         return self.designer_cache.invalidate(study_name)
+
+    def speculative_invalidate(self, study_name: str, reason: str = "") -> None:
+        """Drops only the study's speculative slot/job (frontier surgery,
+        surrogate crossover); the designer entry itself stays live."""
+        if self.speculative_engine is not None:
+            self.speculative_engine.invalidate(study_name, reason=reason)
 
     def snapshot(self) -> Dict[str, int]:
         """All counters plus the current cache/breaker population."""

@@ -52,6 +52,13 @@ class ServingStats:
         # Scalable surrogates (vizier_tpu.surrogates).
         "sparse_suggests",  # suggests served by the sparse-GP posterior
         "surrogate_crossovers",  # exact<->sparse auto-switch transitions
+        # Speculative pre-compute (vizier_tpu.serving.speculative).
+        "speculative_hits",  # suggests served from a parked pre-computed batch
+        "speculative_misses",  # slot empty / frontier moved / count mismatch
+        "speculative_stale",  # slots expired by max_speculation_age_s
+        "speculative_cancelled",  # jobs superseded / dropped busy / shutdown
+        "speculative_precomputes",  # speculative designer computations run
+        "speculative_errors",  # speculative failures swallowed off-path
     )
 
     def __init__(self, registry: Optional[metrics_lib.MetricsRegistry] = None):
