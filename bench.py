@@ -114,6 +114,13 @@ def _speculative_env_config() -> dict:
     return SpeculativeConfig.from_env().as_dict()
 
 
+def _registered_programs() -> list:
+    """The registered compute-IR program kinds, for provenance."""
+    from vizier_tpu.compute import registry as compute_registry
+
+    return list(compute_registry.kinds())
+
+
 def main() -> None:
     backend_tag = None
     platforms = os.environ.get("JAX_PLATFORMS", "")
@@ -362,10 +369,10 @@ def main() -> None:
         "e2e_budget_policy": designer.acquisition_budget_policy,
         # Which surrogate path produced these numbers: bench drives the
         # exact-GP device programs directly (and the DEFAULT UCB-PE
-        # designer for e2e, which has no sparse path), so the measured
-        # mode is always "exact"; the env config rides along so future
-        # artifacts that DO auto-switch are distinguishable
-        # (tools/surrogate_ab.py measures the sparse path).
+        # designer for e2e at a trial count below the sparse threshold),
+        # so the measured mode is always "exact"; the env config rides
+        # along so artifacts that DO auto-switch are distinguishable
+        # (tools/surrogate_ab.py measures both sparse paths).
         "surrogates": {
             "active_mode": "exact",
             **_surrogate_env_config(),
@@ -379,6 +386,10 @@ def main() -> None:
             "active": False,
             **_speculative_env_config(),
         },
+        # The compute-IR program set this build registers (vizier_tpu.
+        # compute.registry): artifacts from trees with more/fewer batched
+        # designer programs are distinguishable after the fact.
+        "compute_programs": _registered_programs(),
     }
     if backend_tag:
         line["backend"] = backend_tag

@@ -1,0 +1,32 @@
+"""Seeded compute-IR conformance violations (tests/analysis only).
+
+A registered program missing prewarm coverage, the device_phase literal,
+and one IR hook — each must be a distinct finding of the compute_ir pass.
+"""
+
+from vizier_tpu.compute import registry as compute_registry
+
+
+class _FixtureDesigner:
+    def suggest(self, count=None):
+        return []
+
+
+class IncompleteProgram:
+    """Registered but nonconforming: no finalize, no prewarm_factory, no
+    device_phase — the pass must flag each gap separately."""
+
+    kind = "fixture_incomplete"
+
+    def bucket_key(self, designer, count):
+        return None
+
+    def prepare(self, designer, count):
+        return {}
+
+    def device_program(self, items, pad_to=None):
+        return []
+
+
+def _register_fixture():  # never called; the pass scans the AST only
+    compute_registry.register(_FixtureDesigner, IncompleteProgram())

@@ -1,0 +1,70 @@
+"""Compute-IR conformance pass: every registered DesignerProgram carries
+prewarm coverage, the tracing/kind metadata, and chaos-test coverage."""
+
+from vizier_tpu.analysis import compute_ir
+
+_FIX = "tests/analysis/fixtures/bad_compute_program.py"
+
+
+def _result(fixtures_project, repo_root):
+    return compute_ir.run(fixtures_project, repo_root)
+
+
+class TestSeededFixtures:
+    def test_registration_site_found(self, fixtures_project, repo_root):
+        result = _result(fixtures_project, repo_root)
+        assert any(
+            r.program_class == "IncompleteProgram" for r in result.registered
+        )
+        assert any(r.kind == "fixture_incomplete" for r in result.registered)
+
+    def test_missing_hook_flagged(self, fixtures_project, repo_root):
+        keys = {f.key for f in _result(fixtures_project, repo_root).findings}
+        assert "program-missing-hook:IncompleteProgram.finalize" in keys
+        # The hooks it DOES define are not flagged.
+        assert "program-missing-hook:IncompleteProgram.prepare" not in keys
+
+    def test_missing_prewarm_coverage_flagged(
+        self, fixtures_project, repo_root
+    ):
+        keys = {f.key for f in _result(fixtures_project, repo_root).findings}
+        assert "program-missing-prewarm-coverage:IncompleteProgram" in keys
+
+    def test_missing_device_phase_flagged(self, fixtures_project, repo_root):
+        keys = {f.key for f in _result(fixtures_project, repo_root).findings}
+        assert "program-missing-device-phase:IncompleteProgram" in keys
+
+    def test_unregistered_fixture_kind_needs_chaos_coverage(
+        self, fixtures_project, repo_root
+    ):
+        # The fixture kind appears in no chaos-exercising test file (this
+        # test file does not import the chaos harness), so the coverage
+        # rule fires for it.
+        keys = {f.key for f in _result(fixtures_project, repo_root).findings}
+        assert "program-missing-chaos-coverage:fixture_incomplete" in keys
+
+
+class TestRealTree:
+    def test_no_unbaselined_findings(self, real_suite_result):
+        assert real_suite_result.passes["compute_ir"].new == []
+
+    def test_all_builtin_programs_registered(self, real_suite_result):
+        result = real_suite_result.compute_ir_result
+        kinds = {r.kind for r in result.registered}
+        assert kinds >= {
+            "gp_bandit",
+            "gp_bandit_sparse",
+            "gp_ucb_pe",
+            "gp_ucb_pe_sparse",
+        }
+
+    def test_registered_set_matches_runtime_registry(self, real_suite_result):
+        # The static scan and the live registry must agree — a program
+        # registered behind dynamic construction would silently escape
+        # every conformance rule.
+        from vizier_tpu.compute import registry as compute_registry
+
+        static_kinds = {
+            r.kind for r in real_suite_result.compute_ir_result.registered
+        }
+        assert set(compute_registry.kinds()) <= static_kinds

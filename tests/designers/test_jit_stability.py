@@ -225,6 +225,141 @@ class TestSparseJitStability:
         assert program._cache_size() == size + 1
 
 
+class TestSparseUCBPEJitStability:
+    """The sparse UCB-PE programs compile once per (n-bucket, m-bucket)
+    pair — including the augmented-capacity re-conditioning model."""
+
+    def _designer(self, seed, num_inducing=6):
+        from vizier_tpu.surrogates import SurrogateConfig
+
+        cfg = SurrogateConfig(
+            sparse_threshold_trials=1, hysteresis_trials=0,
+            num_inducing=num_inducing,
+        )
+        return gp_ucb_pe_lib.VizierGPUCBPEBandit(
+            _problem(), rng_seed=seed, surrogate=cfg, **_FAST
+        )
+
+    def test_sequential_stable_within_bucket_one_retrace_at_n_boundary(self):
+        from vizier_tpu.surrogates import sparse_bandit
+
+        fns = (sparse_bandit._train_sparse_gp, gp_ucb_pe_lib._suggest_batch)
+        designer = self._designer(seed=0)
+        designer.update(core_lib.CompletedTrials(_trials(1, 3, seed=0)))
+        designer.suggest(1)
+        assert designer.surrogate_mode == "sparse"
+        baseline = _cache_sizes(fns)
+
+        # 3 -> 7 completed trials: the n-bucket stays 8 and the all-points
+        # set (trials + 1 pick) stays <= 8 — no retrace of either program.
+        for step in range(4):
+            designer.update(
+                core_lib.CompletedTrials(_trials(4 + step, 1, seed=10 + step))
+            )
+            designer.suggest(1)
+            assert _cache_sizes(fns) == baseline, (
+                f"sparse UCB-PE retrace inside bucket at {4 + step} trials"
+            )
+
+        # Trial 8: the all-points set (8 + 1 pick) crosses into the 16
+        # bucket — the batch-loop program retraces once, the ARD must not.
+        designer.update(core_lib.CompletedTrials(_trials(8, 1, seed=99)))
+        designer.suggest(1)
+        train_base, sweep_base = baseline
+        from vizier_tpu.surrogates import sparse_bandit as sb
+
+        assert sb._train_sparse_gp._cache_size() == train_base
+        assert gp_ucb_pe_lib._suggest_batch._cache_size() == sweep_base + 1
+
+    def test_m_bucket_boundary_and_same_bucket_m_values(self):
+        from vizier_tpu.surrogates import sparse_bandit
+
+        # 10 trials put the study in the n=16 bucket: an (n, m) grid point
+        # no other test's train program touches (the sparse ARD program is
+        # deliberately SHARED with the gp_bandit sparse path, so colliding
+        # grid points would hide real retraces).
+        train = sparse_bandit._train_sparse_gp
+        base = self._designer(seed=1, num_inducing=6)
+        base.update(core_lib.CompletedTrials(_trials(1, 10, seed=1)))
+        base.suggest(1)
+        size = train._cache_size()
+
+        # m=7 pads to the SAME 8-slot m-bucket as m=6: one shared program.
+        same_bucket = self._designer(seed=2, num_inducing=7)
+        same_bucket.update(core_lib.CompletedTrials(_trials(1, 10, seed=2)))
+        same_bucket.suggest(1)
+        assert train._cache_size() == size, (
+            "m values inside one inducing bucket must share a program"
+        )
+
+        # m=12 pads to 16 slots: a new (n=16, m=16) pair, exactly one new
+        # entry.
+        new_bucket = self._designer(seed=3, num_inducing=12)
+        new_bucket.update(core_lib.CompletedTrials(_trials(1, 10, seed=3)))
+        new_bucket.suggest(1)
+        assert train._cache_size() == size + 1
+
+    def test_sparse_flush_program_stable_across_flushes_within_bucket(self):
+        def fresh(seed, n):
+            d = self._designer(seed)
+            d.update(core_lib.CompletedTrials(_trials(1, n, seed=seed)))
+            return d
+
+        def flush(seeds, n):
+            designers = [fresh(s, n) for s in seeds]
+            keys = [d.batch_bucket_key(1) for d in designers]
+            assert len(set(keys)) == 1 and keys[0].kind == "gp_ucb_pe_sparse"
+            items = [d.batch_prepare(1) for d in designers]
+            outs = designers[0].batch_execute(items, pad_to=len(items))
+            for d, i, o in zip(designers, items, outs):
+                d.batch_finalize(i, o)
+
+        program = gp_ucb_pe_lib._sparse_ucb_pe_flush_program
+        flush((40, 41), n=3)
+        size = program._cache_size()
+        flush((42, 43), n=4)  # same (n, m) bucket pair, different studies
+        assert program._cache_size() == size
+
+        flush((44, 45), n=9)  # n-bucket boundary: exactly one new entry
+        assert program._cache_size() == size + 1
+
+
+class TestIRRoutedProgramJitStability:
+    """The compute-IR port must not change compile-cache behavior: flushes
+    routed through the registered programs share one compiled body per
+    bucket, +1 exactly at a bucket boundary."""
+
+    def test_ir_routed_flushes_share_the_bucket_program(self):
+        from vizier_tpu.compute import registry as compute_registry
+
+        def fresh(seed, n):
+            d = gp_bandit_lib.VizierGPBandit(_problem(), rng_seed=seed, **_FAST)
+            d.update(core_lib.CompletedTrials(_trials(1, n, seed=seed)))
+            return d
+
+        # count=2 keeps this test's compiled programs disjoint from the
+        # count=1 flushes other tests in this file drive (count is a jit
+        # static of the same shared flush body).
+        def flush(seeds, n):
+            designers = [fresh(s, n) for s in seeds]
+            resolved = [compute_registry.resolve(d, 2) for d in designers]
+            assert all(r is not None for r in resolved)
+            program = resolved[0][0]
+            assert program.kind == "gp_bandit"
+            items = [program.prepare(d, 2) for d in designers]
+            outs = program.device_program(items, pad_to=len(items))
+            for d, i, o in zip(designers, items, outs):
+                program.finalize(d, i, o)
+
+        body = gp_bandit_lib._gp_bandit_flush_program
+        flush((60, 61), n=4)
+        size = body._cache_size()
+        flush((62, 63), n=5)  # same bucket through the IR: no retrace
+        assert body._cache_size() == size
+        flush((64, 65), n=9)  # boundary: exactly one new entry
+        assert body._cache_size() == size + 1
+
+
 class TestBatchedProgramJitStability:
     def test_batched_programs_stable_across_flushes_within_bucket(self):
         # Two batched flushes over different studies in the same bucket

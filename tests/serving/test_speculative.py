@@ -47,6 +47,8 @@ class TestConfig:
             "workers",
             "max_speculation_age_s",
             "speculate_on_fill",
+            "count_memory",
+            "debounce_ms",
         }
 
 
@@ -230,6 +232,121 @@ class TestEngineParkAndServe:
         assert harness.engine.wait_idle(10.0)
         assert harness.computes == 0
         assert _spec_stats(harness.stats)["cancelled"] == 1
+
+
+class TestCountMemory:
+    """Last-K-distinct-counts speculation (the PR 8 last-seen-only
+    residual): the job computes the LARGEST recent count, so bigger
+    requests stop falling through and smaller ones serve a prefix."""
+
+    def test_speculates_largest_recent_count(self, harness):
+        harness.fill_entry("s")
+        harness.engine.note_live_suggest("s", 1)
+        harness.engine.note_live_suggest("s", 5)
+        harness.engine.note_live_suggest("s", 2)  # 5 stays in the window
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        response, outcome = harness.engine.try_serve(
+            "s", 5, harness.current_fp()
+        )
+        assert outcome == "hit"
+        assert len(response.batch) == 5
+
+    def test_smaller_request_hits_the_larger_parked_batch(self, harness):
+        harness.fill_entry("s")
+        harness.engine.note_live_suggest("s", 1)
+        harness.engine.note_live_suggest("s", 4)
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("s")
+        assert harness.engine.wait_idle(10.0)
+        # A count-1 client consumes the count-4 batch (the servicer serves
+        # the prefix); under last-seen-only this parked a count-1 batch
+        # that the next count-4 request would have missed.
+        response, outcome = harness.engine.try_serve(
+            "s", 1, harness.current_fp()
+        )
+        assert outcome == "hit"
+        assert len(response.batch) == 4
+
+    def test_memory_evicts_oldest_distinct_count(self):
+        h = _Harness(
+            config=SpeculativeConfig(speculative=True, count_memory=2)
+        )
+        try:
+            h.fill_entry("s")
+            h.engine.note_live_suggest("s", 7)  # evicted by the next two
+            h.engine.note_live_suggest("s", 1)
+            h.engine.note_live_suggest("s", 2)
+            h.frontier = ([1], [], 1)
+            h.engine.notify_completion("s")
+            assert h.engine.wait_idle(10.0)
+            response, outcome = h.engine.try_serve("s", 2, h.current_fp())
+            assert outcome == "hit"
+            assert len(response.batch) == 2  # max of the kept {1, 2}, not 7
+        finally:
+            h.close()
+
+    def test_repeated_count_is_one_distinct_entry(self):
+        h = _Harness(
+            config=SpeculativeConfig(speculative=True, count_memory=2)
+        )
+        try:
+            h.fill_entry("s")
+            h.engine.note_live_suggest("s", 6)
+            for _ in range(5):
+                h.engine.note_live_suggest("s", 3)  # must not evict the 6
+            h.frontier = ([1], [], 1)
+            h.engine.notify_completion("s")
+            assert h.engine.wait_idle(10.0)
+            response, outcome = h.engine.try_serve("s", 6, h.current_fp())
+            assert outcome == "hit"
+            assert len(response.batch) == 6
+        finally:
+            h.close()
+
+
+class TestDebounce:
+    def test_debounce_holds_the_job_until_quiet(self):
+        h = _Harness(
+            config=SpeculativeConfig(speculative=True, debounce_ms=500.0)
+        )
+        try:
+            h.fill_entry("s")
+            h.frontier = ([1], [], 1)
+            h.engine.notify_completion("s")
+            # Still inside the debounce window: no compute started.
+            assert not h.compute_started.wait(timeout=0.15)
+            assert h.engine.pending_jobs() == 1
+            assert h.engine.wait_idle(10.0)
+            assert h.computes == 1
+        finally:
+            h.close()
+
+    def test_completion_burst_coalesces_into_one_compute(self):
+        h = _Harness(
+            config=SpeculativeConfig(speculative=True, debounce_ms=250.0)
+        )
+        try:
+            h.fill_entry("s")
+            for trial in range(1, 5):  # 4 completions inside the window
+                h.frontier = (list(range(1, trial + 1)), [], trial)
+                h.engine.notify_completion("s")
+                time.sleep(0.02)
+            assert h.engine.wait_idle(10.0)
+            # The burst superseded in place: ONE compute, at the final
+            # frontier.
+            assert h.computes == 1
+            response, outcome = h.engine.try_serve("s", 1, h.current_fp())
+            assert outcome == "hit"
+        finally:
+            h.close()
+
+    def test_zero_debounce_is_immediate(self, harness):
+        harness.fill_entry("s")
+        harness.frontier = ([1], [], 1)
+        harness.engine.notify_completion("s")
+        assert harness.compute_started.wait(timeout=5.0)
 
 
 class TestInvalidationRaces:

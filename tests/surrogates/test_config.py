@@ -104,6 +104,7 @@ class TestEnv:
             "sparse_threshold_trials",
             "hysteresis_trials",
             "num_inducing",
+            "sparse_ucb_pe",
         }
         import json
 

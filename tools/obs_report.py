@@ -19,8 +19,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List, Optional
+
+# Make the repo importable when invoked as `python tools/obs_report.py`
+# (the registry-driven phase classification needs vizier_tpu; everything
+# else stays stdlib-only and degrades gracefully without it).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def load_spans(path: str) -> List[dict]:
@@ -90,12 +98,40 @@ def phase_breakdown(spans: List[dict]) -> List[dict]:
     return out
 
 
-# Device-phase span prefixes per surrogate path. jax_timing.device_phase
-# emits spans named "jax.<phase>" (phase names in designers/gp_bandit.py
-# and the surrogates callers); the bare prefixes also match raw phase rows
-# from a metrics dump fed back through this report.
-_SPARSE_PHASES = ("jax.sparse_gp.", "sparse_gp.")
-_EXACT_PHASES = ("jax.gp_bandit.", "jax.gp_ucb_pe.", "gp_bandit.", "gp_ucb_pe.")
+# Device-phase span prefixes per surrogate path, sourced from the
+# compute-IR program registry (each registered DesignerProgram declares
+# its device_phase + surrogate_family): a new program's phases classify
+# correctly the moment it registers, no report edit. The static fallback
+# keeps this tool stdlib-runnable on span files from machines where the
+# runtime tree (jax) is not importable.
+_FALLBACK_SPARSE_PHASES = ("jax.sparse_gp.", "sparse_gp.")
+_FALLBACK_EXACT_PHASES = (
+    "jax.gp_bandit.", "jax.gp_ucb_pe.", "gp_bandit.", "gp_ucb_pe.",
+)
+# device_phase ("sparse_gp.ucb_pe_suggest_batched") -> program kind, for
+# the per-program-kind breakdown (populated from the registry; empty on
+# fallback).
+_KIND_BY_PHASE: Dict[str, str] = {}
+
+
+def _phase_families():
+    """(sparse_prefixes, exact_prefixes) from the program registry."""
+    try:
+        from vizier_tpu.compute import registry as compute_registry
+
+        sparse, exact = set(), set()
+        for program in compute_registry.programs():
+            family = sparse if program.surrogate_family == "sparse" else exact
+            prefix = program.device_phase.split(".")[0] + "."
+            family.add(prefix)
+            family.add("jax." + prefix)
+            _KIND_BY_PHASE[program.device_phase] = program.kind
+            _KIND_BY_PHASE["jax." + program.device_phase] = program.kind
+        if sparse or exact:
+            return tuple(sorted(sparse)), tuple(sorted(exact))
+    except Exception:  # no jax / no tree: stay stdlib-runnable
+        pass
+    return _FALLBACK_SPARSE_PHASES, _FALLBACK_EXACT_PHASES
 
 
 def surrogate_activity(spans: List[dict]) -> dict:
@@ -105,12 +141,13 @@ def surrogate_activity(spans: List[dict]) -> dict:
     numbers came from the exact O(n³) path, the sparse inducing-point
     path, or a mix (auto-switched studies mid-file).
     """
+    sparse_phases, exact_phases = _phase_families()
     counts = {"exact": 0, "sparse": 0}
     for span in spans:
         name = span.get("name", "")
-        if any(name.startswith(p) for p in _SPARSE_PHASES):
+        if any(name.startswith(p) for p in sparse_phases):
             counts["sparse"] += 1
-        elif any(name.startswith(p) for p in _EXACT_PHASES):
+        elif any(name.startswith(p) for p in exact_phases):
             counts["exact"] += 1
     if counts["sparse"] and counts["exact"]:
         mode = "mixed"
@@ -121,6 +158,31 @@ def surrogate_activity(spans: List[dict]) -> dict:
     else:
         mode = "none"
     return {"mode": mode, **counts}
+
+
+def program_kind_activity(spans: List[dict]) -> Dict[str, dict]:
+    """Per-program-kind flush breakdown, keyed by registered kind.
+
+    Maps batched device-phase spans back to the DesignerProgram that
+    emitted them via the registry (requires the runtime tree; empty dict
+    on the stdlib fallback), so the report answers "which program kinds
+    carried this workload, and how much device time each took".
+    """
+    _phase_families()  # populate _KIND_BY_PHASE from the registry
+    if not _KIND_BY_PHASE:
+        return {}
+    out: Dict[str, dict] = {}
+    for span in spans:
+        kind = _KIND_BY_PHASE.get(span.get("name", ""))
+        if kind is None:
+            continue
+        duration = float(span.get("duration_secs") or 0.0)
+        row = out.setdefault(kind, {"flushes": 0, "total_ms": 0.0})
+        row["flushes"] += 1
+        row["total_ms"] += duration * 1e3
+    for row in out.values():
+        row["total_ms"] = round(row["total_ms"], 2)
+    return out
 
 
 def speculative_activity(spans: List[dict]) -> dict:
@@ -217,6 +279,7 @@ def main() -> None:
     rows = phase_breakdown(spans)
     activity = surrogate_activity(spans)
     speculative = speculative_activity(spans)
+    programs = program_kind_activity(spans)
     if args.json:
         print(
             json.dumps(
@@ -224,6 +287,7 @@ def main() -> None:
                     "spans": len(spans),
                     "surrogate_activity": activity,
                     "speculative_activity": speculative,
+                    "program_kind_activity": programs,
                     "phases": rows,
                 },
                 indent=2,
@@ -236,6 +300,12 @@ def main() -> None:
             f"(exact device phases: {activity['exact']}, "
             f"sparse: {activity['sparse']})"
         )
+        if programs:
+            summary = ", ".join(
+                f"{kind}: {row['flushes']} flushes / {row['total_ms']:.0f} ms"
+                for kind, row in sorted(programs.items())
+            )
+            print(f"program kinds: {summary}")
         print(
             f"speculative: hit {speculative['hit']} / miss "
             f"{speculative['miss']} / stale {speculative['stale']} "

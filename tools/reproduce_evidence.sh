@@ -36,6 +36,14 @@ echo "== 3c. sparse-surrogate A/B at the north-star scale (~10 min) =="
 #    seeds, and the VIZIER_SPARSE=0 bit-identity check
 JAX_PLATFORMS=cpu python tools/surrogate_ab.py
 
+echo "== 3c2. sparse UCB-PE A/B — the service DEFAULT (~45 min) =="
+#    -> SPARSE_UCB_PE_AB.json: sparse UCB-PE (pending-pick conditioning
+#    through the Nystrom-augmented inducing posterior, compute-IR kind
+#    gp_ucb_pe_sparse) vs exact UCB-PE full-designer suggest p50 at
+#    1000x20-D (target >= 5x), rank-sum regret parity at 5 seeds, and
+#    the VIZIER_SPARSE_UCB_PE=0 bit-identity check
+JAX_PLATFORMS=cpu python tools/surrogate_ab.py --designer ucb_pe
+
 echo "== 3d. speculative pre-compute A/B (~4 min) =="
 #    -> SPECULATIVE_AB.json: sequential complete->suggest loop, 5 seeds;
 #    speculative-hit suggest p50 < 10 ms vs the full-GP baseline,

@@ -1,9 +1,18 @@
 """A/B: sparse inducing-point surrogate vs the exact O(n³) GP.
 
 Usage: python tools/surrogate_ab.py [--out SPARSE_AB.json]
+       [--designer gp_bandit|ucb_pe]
        [--trials 1000] [--dim 20] [--evals 75000] [--inducing 128]
        [--exact-repeats 2] [--sparse-repeats 5]
        [--parity-trials 45] [--parity-seeds 1 2 3 4 5]
+
+``--designer ucb_pe`` runs the same three measurements for the service
+DEFAULT (GP-UCB-PE): the sparse arm conditions the greedy batch on
+pending picks through the inducing-point posterior (Nyström-augmented;
+``gp_ucb_pe_sparse`` compute-IR program) instead of the exact per-pick
+O(n³) re-factorization; the latency arms drive the full designer suggest
+(train + greedy batch) at the north-star scale, and the output defaults
+to ``SPARSE_UCB_PE_AB.json``.
 
 Three measurements, one JSON report:
 
@@ -183,6 +192,255 @@ def measure_latency(args) -> dict:
     }
 
 
+def _ucb_pe_designer(problem, seed, args, sparse: bool):
+    from vizier_tpu.designers.gp_ucb_pe import VizierGPUCBPEBandit
+    from vizier_tpu.surrogates import SurrogateConfig
+
+    surrogate = None
+    if sparse:
+        surrogate = SurrogateConfig(
+            sparse_threshold_trials=1,
+            hysteresis_trials=0,
+            num_inducing=args.inducing,
+        )
+    return VizierGPUCBPEBandit(
+        problem,
+        rng_seed=seed,
+        max_acquisition_evaluations=args.evals,
+        surrogate=surrogate,
+    )
+
+
+def measure_latency_ucb_pe(args) -> dict:
+    """End-to-end UCB-PE suggest latency (train + greedy batch) at the
+    north-star scale: the full designer path, so the exact arm pays the
+    O(n³) ARD *and* the per-pick O(n³) pending re-conditioning, the
+    sparse arm their O(n·m²) inducing-point twins — same study data, same
+    backend, same process."""
+    import jax
+
+    from vizier_tpu import pyvizier as vz
+    from vizier_tpu.algorithms import core as core_lib
+
+    num_trials, dim = args.trials, args.dim
+    problem = vz.ProblemStatement()
+    for d in range(dim):
+        problem.search_space.root.add_float_param(f"x{d}", 0.0, 1.0)
+    problem.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+
+    def make_trials(start_id, n, seed):
+        rng = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            params = {
+                f"x{d}": float(rng.uniform()) for d in range(dim)
+            }
+            t = vz.Trial(parameters=params, id=start_id + i)
+            t.complete(
+                vz.Measurement(
+                    metrics={
+                        "obj": float(
+                            -sum((v - 0.5) ** 2 for v in params.values())
+                            + 0.1 * rng.normal()
+                        )
+                    }
+                )
+            )
+            out.append(t)
+        return out
+
+    base_trials = make_trials(1, num_trials, seed=0)
+
+    def run_arm(sparse: bool, repeats: int):
+        designer = _ucb_pe_designer(problem, 0, args, sparse)
+        designer.update(core_lib.CompletedTrials(base_trials))
+        times = []
+        for step in range(repeats + 1):
+            if step > 0:
+                # One fresh completion per steady-state step forces a
+                # retrain without leaving the 1024-row padding bucket.
+                designer.update(
+                    core_lib.CompletedTrials(
+                        make_trials(num_trials + step, 1, seed=1000 + step)
+                    )
+                )
+            t0 = time.perf_counter()
+            out = designer.suggest(args.ucb_batch)
+            assert len(out) == args.ucb_batch
+            elapsed = (time.perf_counter() - t0) * 1000.0
+            if step > 0:
+                times.append(elapsed)
+            _progress(
+                f"ucb_pe {'sparse' if sparse else 'exact'} step {step}: "
+                f"{elapsed:.0f} ms"
+                f"{' (compile, excluded)' if step == 0 else ''}"
+            )
+        if sparse:
+            assert designer.surrogate_counts["sparse_suggests"] > 0
+            assert designer.surrogate_mode == "sparse"
+        return times
+
+    _progress(
+        f"ucb_pe latency: sparse arm at {num_trials}x{dim}d, "
+        f"m={args.inducing}, batch {args.ucb_batch}, {args.evals} evals"
+    )
+    sparse_times = run_arm(sparse=True, repeats=args.sparse_repeats)
+    _progress(f"ucb_pe latency: exact arm ({args.exact_repeats} repeats)")
+    exact_times = run_arm(sparse=False, repeats=args.exact_repeats)
+    sparse_p50 = float(np.percentile(sparse_times, 50))
+    exact_p50 = float(np.percentile(exact_times, 50))
+    return {
+        "config": {
+            "designer": "gp_ucb_pe",
+            "num_trials": num_trials,
+            "dim": dim,
+            "max_evaluations": args.evals,
+            "batch": args.ucb_batch,
+            "num_inducing": args.inducing,
+            "exact_repeats": args.exact_repeats,
+            "sparse_repeats": args.sparse_repeats,
+        },
+        "exact_suggest_p50_ms": round(exact_p50, 1),
+        "sparse_suggest_p50_ms": round(sparse_p50, 1),
+        "exact_suggest_ms": [round(t, 1) for t in exact_times],
+        "sparse_suggest_ms": [round(t, 1) for t in sparse_times],
+        "speedup": round(exact_p50 / sparse_p50, 2),
+    }
+
+
+def measure_parity_ucb_pe(args) -> dict:
+    """Sparse-vs-exact UCB-PE regret parity: full BO loops on shifted
+    Sphere instances, rank-sum on final regrets at >= 5 seeds."""
+    from vizier_tpu.algorithms import core as core_lib
+    from vizier_tpu.benchmarks.experimenters import experimenter_factory
+    from vizier_tpu.designers.gp_ucb_pe import VizierGPUCBPEBandit
+    from vizier_tpu.surrogates import SurrogateConfig
+
+    def run_arm(seed: int, sparse: bool) -> float:
+        exp = experimenter_factory.shifted_bbob_instance(
+            "Sphere", seed, dim=args.parity_dim
+        )
+        surrogate = (
+            SurrogateConfig(
+                sparse_threshold_trials=1,
+                hysteresis_trials=0,
+                num_inducing=args.parity_inducing,
+            )
+            if sparse
+            else None
+        )
+        designer = VizierGPUCBPEBandit(
+            exp.problem_statement(),
+            rng_seed=seed,
+            max_acquisition_evaluations=args.parity_evals,
+            surrogate=surrogate,
+        )
+        best, tid = np.inf, 0
+        while tid < args.parity_trials:
+            batch = [
+                s.to_trial(tid + i + 1)
+                for i, s in enumerate(designer.suggest(args.parity_batch))
+            ]
+            tid += len(batch)
+            exp.evaluate(batch)
+            designer.update(core_lib.CompletedTrials(batch))
+            for t in batch:
+                best = min(best, t.final_measurement.metrics["bbob_eval"].value)
+        if sparse:
+            assert designer.surrogate_counts["sparse_suggests"] > 0
+        return best
+
+    sparse_finals, exact_finals = [], []
+    for seed in args.parity_seeds:
+        t0 = time.perf_counter()
+        sparse_finals.append(run_arm(seed, sparse=True))
+        exact_finals.append(run_arm(seed, sparse=False))
+        _progress(
+            f"ucb_pe parity seed {seed}: sparse={sparse_finals[-1]:.4f} "
+            f"exact={exact_finals[-1]:.4f} ({time.perf_counter() - t0:.0f}s)"
+        )
+    p = rank_sum_p(sparse_finals, exact_finals)
+    return {
+        "config": {
+            "designer": "gp_ucb_pe",
+            "fn": "Sphere(shifted)",
+            "dim": args.parity_dim,
+            "trials": args.parity_trials,
+            "batch": args.parity_batch,
+            "max_evaluations": args.parity_evals,
+            "num_inducing": args.parity_inducing,
+            "sparse_threshold_trials": 1,
+            "seeds": list(args.parity_seeds),
+        },
+        "sparse_final_regrets": [round(v, 4) for v in sparse_finals],
+        "exact_final_regrets": [round(v, 4) for v in exact_finals],
+        "rank_sum_p": round(p, 4),
+        "parity_green": p > 0.05,
+    }
+
+
+def check_off_bit_identity_ucb_pe() -> dict:
+    """VIZIER_SPARSE_UCB_PE=0 must reproduce the no-config UCB-PE path
+    bit-for-bit (even with the study above the sparse threshold)."""
+    from vizier_tpu import pyvizier as vz
+    from vizier_tpu.algorithms import core as core_lib
+    from vizier_tpu.designers.gp_ucb_pe import VizierGPUCBPEBandit
+    from vizier_tpu.surrogates import SurrogateConfig
+
+    problem = vz.ProblemStatement()
+    for d in range(4):
+        problem.search_space.root.add_float_param(f"x{d}", 0.0, 1.0)
+    problem.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    rng = np.random.default_rng(7)
+    trials = []
+    for i in range(16):
+        params = {f"x{d}": float(rng.uniform()) for d in range(4)}
+        t = vz.Trial(parameters=params, id=i + 1)
+        t.complete(
+            vz.Measurement(metrics={"obj": float(sum(params.values()))})
+        )
+        trials.append(t)
+
+    prev = os.environ.get("VIZIER_SPARSE_UCB_PE")
+    os.environ["VIZIER_SPARSE_UCB_PE"] = "0"
+    try:
+        off_cfg = SurrogateConfig.from_env()
+    finally:
+        if prev is None:
+            os.environ.pop("VIZIER_SPARSE_UCB_PE", None)
+        else:
+            os.environ["VIZIER_SPARSE_UCB_PE"] = prev
+    assert not off_cfg.sparse_ucb_pe
+    # Force the threshold below the study so only the ucb_pe gate stands
+    # between this designer and the sparse path.
+    off_cfg = SurrogateConfig(
+        sparse=off_cfg.sparse,
+        sparse_threshold_trials=1,
+        hysteresis_trials=0,
+        num_inducing=8,
+        sparse_ucb_pe=off_cfg.sparse_ucb_pe,
+    )
+
+    def run(surrogate):
+        d = VizierGPUCBPEBandit(
+            problem, rng_seed=11,
+            max_acquisition_evaluations=500, surrogate=surrogate,
+        )
+        d.update(core_lib.CompletedTrials(trials))
+        out = []
+        for _ in range(2):
+            out.append([s.parameters.as_dict() for s in d.suggest(2)])
+        return out
+
+    identical = run(None) == run(off_cfg)
+    _progress(f"ucb_pe off-switch bit-identity: {identical}")
+    return {"off_bit_identical": identical}
+
+
 def rank_sum_p(a, b) -> float:
     """Two-sided Mann-Whitney p (normal approximation), H0: same dist."""
     from scipy import stats
@@ -316,7 +574,11 @@ def check_off_bit_identity() -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="SPARSE_AB.json")
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--designer", choices=("gp_bandit", "ucb_pe"), default="gp_bandit"
+    )
+    ap.add_argument("--ucb-batch", type=int, default=5)
     ap.add_argument("--trials", type=int, default=1000)
     ap.add_argument("--dim", type=int, default=20)
     ap.add_argument("--evals", type=int, default=75_000)
@@ -333,30 +595,59 @@ def main() -> None:
     ap.add_argument("--skip-latency", action="store_true")
     ap.add_argument("--skip-parity", action="store_true")
     args = ap.parse_args()
+    if args.out is None:
+        args.out = (
+            "SPARSE_UCB_PE_AB.json"
+            if args.designer == "ucb_pe"
+            else "SPARSE_AB.json"
+        )
 
     import jax
 
     from vizier_tpu.surrogates import SurrogateConfig
 
+    ucb_pe = args.designer == "ucb_pe"
     report = {
         "backend": jax.default_backend(),
+        "designer": args.designer,
         # Which path produced what: both arms are stamped explicitly, and
         # the process-wide env default rides along for provenance.
         "surrogates_env_config": SurrogateConfig.from_env().as_dict(),
         "note": (
-            "Sparse SGPR collapsed-bound surrogate (k-center inducing "
-            "selection, same multi-restart L-BFGS ARD program) vs the "
-            "exact O(n³) GP. Latency is the device-side suggest step "
-            "(train + acquisition sweep) at the north-star scale; parity "
-            "is two-sided rank-sum on final regrets over full BO loops; "
-            "VIZIER_SPARSE=0 is checked bit-identical to the seed path."
+            (
+                "Sparse UCB-PE (SGPR collapsed-bound train + pending-pick "
+                "conditioning through the Nyström-augmented inducing "
+                "posterior; compute-IR kind gp_ucb_pe_sparse) vs the exact "
+                "UCB-PE path (O(n³) ARD + O(n³) per-pick re-conditioning). "
+                "Latency is the full designer suggest (train + greedy "
+                "batch) at the north-star scale, same run/backend; parity "
+                "is two-sided rank-sum on final regrets over full BO "
+                "loops; VIZIER_SPARSE_UCB_PE=0 is checked bit-identical "
+                "to the exact path."
+            )
+            if ucb_pe
+            else (
+                "Sparse SGPR collapsed-bound surrogate (k-center inducing "
+                "selection, same multi-restart L-BFGS ARD program) vs the "
+                "exact O(n³) GP. Latency is the device-side suggest step "
+                "(train + acquisition sweep) at the north-star scale; "
+                "parity is two-sided rank-sum on final regrets over full "
+                "BO loops; VIZIER_SPARSE=0 is checked bit-identical to "
+                "the seed path."
+            )
         ),
     }
     if not args.skip_latency:
-        report["latency"] = measure_latency(args)
+        report["latency"] = (
+            measure_latency_ucb_pe(args) if ucb_pe else measure_latency(args)
+        )
     if not args.skip_parity:
-        report["parity"] = measure_parity(args)
-    report["off_switch"] = check_off_bit_identity()
+        report["parity"] = (
+            measure_parity_ucb_pe(args) if ucb_pe else measure_parity(args)
+        )
+    report["off_switch"] = (
+        check_off_bit_identity_ucb_pe() if ucb_pe else check_off_bit_identity()
+    )
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))

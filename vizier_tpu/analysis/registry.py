@@ -135,6 +135,14 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             _SRV_DOC,
             "Also pre-compute after each live suggest (for a second "
             "client at the post-suggest frontier).", "0"),
+    _switch("VIZIER_SPECULATIVE_COUNT_MEMORY", "int", "SpeculativeConfig",
+            _SRV_DOC,
+            "Distinct recent request counts remembered per study; jobs "
+            "speculate the largest so bigger requests stop missing.", "4"),
+    _switch("VIZIER_SPECULATIVE_DEBOUNCE_MS", "float", "SpeculativeConfig",
+            _SRV_DOC,
+            "Trigger debounce: a completion burst coalesces into one "
+            "pre-compute after this quiet window (0 = immediate).", "0"),
     # -- surrogates (SurrogateConfig) --------------------------------------
     _switch("VIZIER_SPARSE", "flag", "SurrogateConfig", _PERF_DOC,
             "Sparse-GP surrogate auto-switch (off = exact GP always).", "1"),
@@ -145,6 +153,9 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
     _switch("VIZIER_SPARSE_INDUCING", "int", "SurrogateConfig", _PERF_DOC,
             "Inducing-point budget m (padded to the trial bucket grid).",
             "128"),
+    _switch("VIZIER_SPARSE_UCB_PE", "flag", "SurrogateConfig", _PERF_DOC,
+            "Extend the sparse auto-switch to the UCB-PE DEFAULT "
+            "(0 = UCB-PE studies stay exact at every size).", "1"),
     # -- designers ---------------------------------------------------------
     _switch("VIZIER_DISABLE_MESH", "flag", "GPBanditDesigner", _SWITCH_DOC,
             "Opt out of the multi-device auto-mesh (set = disabled).", "0"),

@@ -21,11 +21,18 @@ from typing import Dict, List, Optional, Sequence
 
 from vizier_tpu.analysis import baseline as baseline_lib
 from vizier_tpu.analysis import common
+from vizier_tpu.analysis import compute_ir
 from vizier_tpu.analysis import env_registry
 from vizier_tpu.analysis import jax_discipline
 from vizier_tpu.analysis import lock_order
 
-ALL_PASSES = ("lock_order", "jax_discipline", "env_registry", "debug_locks")
+ALL_PASSES = (
+    "lock_order",
+    "jax_discipline",
+    "env_registry",
+    "compute_ir",
+    "debug_locks",
+)
 
 DEFAULT_PATHS = ("vizier_tpu", "bench.py", "tools")
 DEFAULT_BASELINE = "vizier_tpu/analysis/baseline.toml"
@@ -78,6 +85,7 @@ class SuiteResult:
     lock_result: Optional[lock_order.LockOrderResult] = None
     jax_result: Optional[jax_discipline.JaxDisciplineResult] = None
     env_result: Optional[env_registry.EnvRegistryResult] = None
+    compute_ir_result: Optional[compute_ir.ComputeIrResult] = None
     # (confirmed_edge_count, unmapped_site_count) from the runtime check.
     debug_locks_stats: Optional[tuple] = None
     parse_errors: List = dataclasses.field(default_factory=list)
@@ -125,6 +133,9 @@ def run_suite(
     if "env_registry" in selected:
         result.env_result = env_registry.run(project, repo_root)
         all_findings.extend(result.env_result.findings)
+    if "compute_ir" in selected:
+        result.compute_ir_result = compute_ir.run(project, repo_root)
+        all_findings.extend(result.compute_ir_result.findings)
     if "debug_locks" in selected:
         lock_result = result.lock_result or lock_order.run(
             project, critical_locks=config.critical_locks
@@ -238,6 +249,11 @@ def format_report(result: SuiteResult, verbose: bool = False) -> str:
             )
         elif name == "env_registry" and result.env_result is not None:
             extra = f" ({len(result.env_result.references)} VIZIER_* names seen)"
+        elif name == "compute_ir" and result.compute_ir_result is not None:
+            kinds = sorted(
+                r.kind or "?" for r in result.compute_ir_result.registered
+            )
+            extra = f" ({len(kinds)} registered programs: {', '.join(kinds)})"
         elif name == "debug_locks" and result.debug_locks_stats is not None:
             confirmed, unmapped = result.debug_locks_stats
             extra = (

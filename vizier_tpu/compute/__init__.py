@@ -1,0 +1,13 @@
+"""Batched designer-compute IR: the one seam every designer implements.
+
+``ir.DesignerProgram`` names the four-hook contract (bucket_key / prepare
+/ device_program / finalize); ``registry`` holds the process-wide program
+table the batch executor, prewarm walker, chaos harness, device-phase
+tracing, and speculative lane all consume. See
+docs/guides/performance.md "Batched compute IR".
+"""
+
+from vizier_tpu.compute.ir import BucketKey, DesignerProgram
+from vizier_tpu.compute import registry
+
+__all__ = ["BucketKey", "DesignerProgram", "registry"]

@@ -28,6 +28,8 @@ import numpy as np
 
 from vizier_tpu import types
 from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.compute import ir as compute_ir
+from vizier_tpu.compute import registry as compute_registry
 from vizier_tpu.converters import core as converters
 from vizier_tpu.converters import padding as padding_lib
 from vizier_tpu.designers import quasi_random
@@ -613,7 +615,13 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
                 result, count, kind=f"{self.acquisition}+sparse"
             )
 
-    # -- cross-study batch protocol (vizier_tpu.parallel.batch_executor) ----
+    # -- cross-study batch protocol (vizier_tpu.compute IR) -----------------
+    #
+    # The real implementations live in the registered DesignerProgram
+    # classes at the bottom of this module (GPBanditProgram /
+    # GPBanditSparseProgram); these thin methods keep the legacy duck-typed
+    # surface working for callers that talk to the designer directly
+    # (tests, chaos wrappers, subclass overrides).
 
     def _batch_restarts(self) -> int:
         """The jit-static restart budget the next train would use (mirrors
@@ -622,158 +630,48 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
             self._warm_restart_budget() or self.ard_restarts, self.ensemble_size
         )
 
+    def _active_batch_program(self):
+        """The compute-IR program the current surrogate mode routes to."""
+        from vizier_tpu.compute import registry as compute_registry
+
+        kind = (
+            "gp_bandit_sparse"
+            if self._surrogate_mode == surrogate_config_lib.MODE_SPARSE
+            else "gp_bandit"
+        )
+        return compute_registry.get(kind)
+
     def batch_bucket_key(self, count: Optional[int] = None):
         """Shape-bucket identity for cross-study batching, or None.
 
         None marks the paths the batched programs do not cover (seeding,
         multi-objective, transfer priors, joint qEI, mesh-sharded): those
-        run the ordinary sequential suggest. The key carries the hashable
-        jit statics, so equal keys ⇒ one compiled program serves the batch.
+        run the ordinary sequential suggest.
         """
-        count = count or 1
-        if (
-            self._mesh is not None
-            or len(self._trials) < self.num_seed_trials
-            or self._num_objectives() > 1
-            or getattr(self, "_priors", None)
-            or (self.acquisition == "qei" and count > 1)
-        ):
-            return None
-        from vizier_tpu.parallel import batch_executor
+        from vizier_tpu.compute import registry as compute_registry
 
-        if self._refresh_surrogate_mode() == surrogate_config_lib.MODE_SPARSE:
-            # Sparse studies batch among themselves: the sparse model (with
-            # its padded inducing-slot count — the m-bucket) rides in the
-            # statics, so equal keys ⇒ one compiled _sparse_flush_program
-            # per (n-bucket, m-bucket) pair.
-            return batch_executor.BucketKey(
-                kind="gp_bandit_sparse",
-                pad_trials=self._converter.padding.pad_trials(len(self._trials)),
-                cont_width=self._cont_width,
-                cat_width=self._cat_width,
-                metric_count=1,
-                count=count,
-                statics=(
-                    self._sparse_model(),
-                    self._ard,
-                    self._vec_opt,
-                    self._batch_restarts(),
-                    self.ensemble_size,
-                    self._make_acquisition(),
-                    self.use_trust_region,
-                ),
-            )
-        return batch_executor.BucketKey(
-            kind="gp_bandit",
-            pad_trials=self._converter.padding.pad_trials(len(self._trials)),
-            cont_width=self._cont_width,
-            cat_width=self._cat_width,
-            metric_count=1,
-            count=count,
-            statics=(
-                self._model,
-                self._ard,
-                self._vec_opt,
-                self._batch_restarts(),
-                self.ensemble_size,
-                self._make_acquisition(),
-                self.use_trust_region,
-            ),
-        )
+        resolved = compute_registry.resolve(self, count)
+        return resolved[1] if resolved is not None else None
 
     def batch_prepare(self, count: Optional[int] = None) -> dict:
-        """Host-side half of a batched suggest: encode + warp + RNG draws.
-
-        Consumes this designer's RNG stream in exactly the order the
-        sequential ``suggest`` would (train key, then acquisition key), so
-        batched and sequential runs of the same study are key-for-key
-        identical.
-        """
-        count = count or 1
-        # Host-only: the ModelData leaves stay numpy; the GPData conversion
-        # happens inside the batched program (_to_gp_data_batched), so
-        # prepare issues zero device dispatches.
-        return dict(
-            designer=self,
-            count=count,
-            md=self._warped_model_data(),
-            rng_train=self._next_rng(),
-            rng_acq=self._next_rng(),
-            warm=self._warm_params,
-            restarts=self._batch_restarts(),
-            # The bucket key (computed just before prepare) already refreshed
-            # the auto-switch; equal keys guarantee a whole bucket agrees.
-            sparse=self._surrogate_mode == surrogate_config_lib.MODE_SPARSE,
-        )
+        """Host-side half of a batched suggest (see the program classes)."""
+        return self._active_batch_program().prepare(self, count or 1)
 
     @classmethod
     def batch_execute(cls, items: Sequence[dict], pad_to: Optional[int] = None):
-        """Device half: ONE vmapped train + ONE vmapped sweep for the whole
-        bucket (slot 0's jit statics stand in for everyone's — the bucket
-        key guarantees they are equal)."""
-        from vizier_tpu.parallel import batch_executor
+        """Device half: dispatched to the bucket's registered program
+        (slot 0's item says which — the bucket key guarantees agreement)."""
+        from vizier_tpu.compute import registry as compute_registry
 
-        d0: "VizierGPBandit" = items[0]["designer"]
-        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
-            [it[name] for it in items], pad_to
-        )
-        sparse = bool(items[0].get("sparse"))
-        if sparse:
-            # The sparse twin of the fused flush below — same stages, SGPR
-            # posterior, its own device-phase bucket so
-            # vizier_jax_phase_seconds separates sparse from exact time.
-            with jax_timing.device_phase("sparse_gp.suggest_batched") as phase:
-                states, warm_next, result = sparse_bandit._sparse_flush_program(
-                    d0._sparse_model(), d0._ard, d0._vec_opt,
-                    d0._make_acquisition(),
-                    stack("md"), stack("rng_train"), stack("rng_acq"),
-                    stack("warm"),
-                    items[0]["restarts"], d0.ensemble_size,
-                    items[0]["count"], d0.use_trust_region,
-                )
-                phase.block(result)
-        else:
-            with jax_timing.device_phase("gp_bandit.suggest_batched") as phase:
-                states, warm_next, result = _gp_bandit_flush_program(
-                    d0._model, d0._ard, d0._vec_opt, d0._make_acquisition(),
-                    stack("md"), stack("rng_train"), stack("rng_acq"),
-                    stack("warm"),
-                    items[0]["restarts"], d0.ensemble_size,
-                    items[0]["count"], d0.use_trust_region,
-                )
-                phase.block(result)
-        # ONE device->host fetch for the whole batch; per-slot demux is then
-        # free numpy views (per-slot device slices would be ~20 dispatches
-        # per slot and dominated the executor's wall time).
-        states, warm_next, result = jax.device_get((states, warm_next, result))
-        return [
-            dict(
-                states=batch_executor.slice_pytree(states, i),
-                warm_next=batch_executor.slice_pytree(warm_next, i),
-                result=batch_executor.slice_pytree(result, i),
-                sparse=sparse,
-            )
-            for i in range(len(items))
-        ]
+        kind = "gp_bandit_sparse" if items[0].get("sparse") else "gp_bandit"
+        return compute_registry.get(kind).device_program(items, pad_to=pad_to)
 
     def batch_finalize(self, item: dict, output: dict) -> List[trial_.TrialSuggestion]:
-        """Host-side demux: per-study warm-param writeback + decode — the
-        same state transitions the sequential suggest performs."""
-        states = output["states"]
-        self._record_train()
-        if self._warm_update_allowed():
-            # The unconstrain already ran (vmapped) inside the flush program.
-            self._warm_params = output["warm_next"]
-            self._warm_is_trained = True
-        if output.get("sparse"):
-            self._last_predictive = sparse_gp.SparseEnsemblePredictive(states)
-            self._last_sparse_state = states
-            self._surrogate_counts["sparse_suggests"] += 1
-            kind = f"{self.acquisition}+sparse"
-        else:
-            self._last_predictive = gp_lib.EnsemblePredictive(states)
-            kind = self.acquisition
-        return self._decode_result(output["result"], item["count"], kind=kind)
+        """Host-side demux (see the program classes)."""
+        from vizier_tpu.compute import registry as compute_registry
+
+        kind = "gp_bandit_sparse" if output.get("sparse") else "gp_bandit"
+        return compute_registry.get(kind).finalize(self, item, output)
 
     def _maximize(
         self,
@@ -1272,3 +1170,242 @@ def _maximize_q_batch(
             prior_features=prior,
         )
     return vec_opt(score_fn, rng, count=1, prior_features=prior)
+
+
+# -- compute-IR programs (vizier_tpu.compute) --------------------------------
+#
+# The batched designer-compute contract for the GP-bandit family: one
+# program per compiled-flush family (exact | sparse), registered so the
+# batch executor, prewarm walker, chaos wrappers, device-phase tracing and
+# the speculative lane consume them generically. The hook bodies ARE the
+# pre-IR ``batch_*`` designer methods, moved verbatim — slot i of a batch
+# stays bit-identical to study i run alone, and the thin designer methods
+# above delegate here for legacy callers.
+
+
+def _gp_bandit_unbatchable(designer: "VizierGPBandit", count: int) -> bool:
+    """Paths the batched flush programs do not cover (seeding, multi-
+    objective, transfer priors, joint qEI, mesh-sharded): those run the
+    ordinary sequential suggest."""
+    return bool(
+        designer._mesh is not None
+        or len(designer._trials) < designer.num_seed_trials
+        or designer._num_objectives() > 1
+        or getattr(designer, "_priors", None)
+        or (designer.acquisition == "qei" and count > 1)
+    )
+
+
+def _gp_bandit_prepare(designer: "VizierGPBandit", count: int, sparse: bool) -> dict:
+    """Host-side half of a batched suggest: encode + warp + RNG draws.
+
+    Consumes the designer's RNG stream in exactly the order the sequential
+    ``suggest`` would (train key, then acquisition key), so batched and
+    sequential runs of the same study are key-for-key identical. Host-only:
+    the ModelData leaves stay numpy; the GPData conversion happens inside
+    the batched program, so prepare issues zero device dispatches.
+    """
+    return dict(
+        designer=designer,
+        count=count,
+        md=designer._warped_model_data(),
+        rng_train=designer._next_rng(),
+        rng_acq=designer._next_rng(),
+        warm=designer._warm_params,
+        restarts=designer._batch_restarts(),
+        # The bucket key (computed just before prepare) already refreshed
+        # the auto-switch; equal keys guarantee a whole bucket agrees.
+        sparse=sparse,
+    )
+
+
+def _gp_bandit_demux(items, pad_to, states, warm_next, result, sparse: bool):
+    """ONE device->host fetch for the whole batch; per-slot demux is then
+    free numpy views (per-slot device slices would be ~20 dispatches per
+    slot and dominated the executor's wall time)."""
+    from vizier_tpu.parallel import batch_executor
+
+    states, warm_next, result = jax.device_get((states, warm_next, result))
+    return [
+        dict(
+            states=batch_executor.slice_pytree(states, i),
+            warm_next=batch_executor.slice_pytree(warm_next, i),
+            result=batch_executor.slice_pytree(result, i),
+            sparse=sparse,
+        )
+        for i in range(len(items))
+    ]
+
+
+class GPBanditProgram(compute_ir.DesignerProgram):
+    """Exact-GP single-objective flush: encode→multi-restart ARD→UCB/EI
+    sweep, one fused vmapped dispatch per bucket."""
+
+    kind = "gp_bandit"
+    device_phase = "gp_bandit.suggest_batched"
+    surrogate_family = "exact"
+    algorithms = ("GAUSSIAN_PROCESS_BANDIT",)
+
+    def bucket_key(self, designer, count):
+        if _gp_bandit_unbatchable(designer, count):
+            return None
+        if (
+            designer._refresh_surrogate_mode()
+            == surrogate_config_lib.MODE_SPARSE
+        ):
+            return None  # the sparse program owns this study
+        return compute_ir.BucketKey(
+            kind=self.kind,
+            pad_trials=designer._converter.padding.pad_trials(
+                len(designer._trials)
+            ),
+            cont_width=designer._cont_width,
+            cat_width=designer._cat_width,
+            metric_count=1,
+            count=count,
+            statics=(
+                designer._model,
+                designer._ard,
+                designer._vec_opt,
+                designer._batch_restarts(),
+                designer.ensemble_size,
+                designer._make_acquisition(),
+                designer.use_trust_region,
+            ),
+        )
+
+    def prepare(self, designer, count):
+        return _gp_bandit_prepare(designer, count, sparse=False)
+
+    def device_program(self, items, pad_to=None):
+        """ONE vmapped train + ONE vmapped sweep for the whole bucket
+        (slot 0's jit statics stand in for everyone's — the bucket key
+        guarantees they are equal)."""
+        from vizier_tpu.parallel import batch_executor
+
+        d0: "VizierGPBandit" = items[0]["designer"]
+        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
+            [it[name] for it in items], pad_to
+        )
+        with jax_timing.device_phase(self.device_phase) as phase:
+            states, warm_next, result = _gp_bandit_flush_program(
+                d0._model, d0._ard, d0._vec_opt, d0._make_acquisition(),
+                stack("md"), stack("rng_train"), stack("rng_acq"),
+                stack("warm"),
+                items[0]["restarts"], d0.ensemble_size,
+                items[0]["count"], d0.use_trust_region,
+            )
+            phase.block(result)
+        return _gp_bandit_demux(
+            items, pad_to, states, warm_next, result, sparse=False
+        )
+
+    def finalize(self, designer, item, output):
+        """Host-side demux: per-study warm-param writeback + decode — the
+        same state transitions the sequential suggest performs."""
+        states = output["states"]
+        designer._record_train()
+        if designer._warm_update_allowed():
+            # The unconstrain already ran (vmapped) inside the flush program.
+            designer._warm_params = output["warm_next"]
+            designer._warm_is_trained = True
+        designer._last_predictive = gp_lib.EnsemblePredictive(states)
+        return designer._decode_result(
+            output["result"], item["count"], kind=designer.acquisition
+        )
+
+    def prewarm_factory(self, problem, **kwargs):
+        return VizierGPBandit(problem, **kwargs)
+
+
+class GPBanditSparseProgram(compute_ir.DesignerProgram):
+    """Sparse (SGPR) flush twin: same stages over the collapsed-bound
+    posterior, one compiled program per (n-bucket, m-bucket) pair, its own
+    device-phase bucket so ``vizier_jax_phase_seconds`` separates sparse
+    from exact time."""
+
+    kind = "gp_bandit_sparse"
+    device_phase = "sparse_gp.suggest_batched"
+    surrogate_family = "sparse"
+    algorithms = ("GAUSSIAN_PROCESS_BANDIT",)
+
+    def bucket_key(self, designer, count):
+        if _gp_bandit_unbatchable(designer, count):
+            return None
+        if (
+            designer._refresh_surrogate_mode()
+            != surrogate_config_lib.MODE_SPARSE
+        ):
+            return None
+        # Sparse studies batch among themselves: the sparse model (with
+        # its padded inducing-slot count — the m-bucket) rides in the
+        # statics, so equal keys ⇒ one compiled _sparse_flush_program per
+        # (n-bucket, m-bucket) pair.
+        return compute_ir.BucketKey(
+            kind=self.kind,
+            pad_trials=designer._converter.padding.pad_trials(
+                len(designer._trials)
+            ),
+            cont_width=designer._cont_width,
+            cat_width=designer._cat_width,
+            metric_count=1,
+            count=count,
+            statics=(
+                designer._sparse_model(),
+                designer._ard,
+                designer._vec_opt,
+                designer._batch_restarts(),
+                designer.ensemble_size,
+                designer._make_acquisition(),
+                designer.use_trust_region,
+            ),
+        )
+
+    def prepare(self, designer, count):
+        return _gp_bandit_prepare(designer, count, sparse=True)
+
+    def device_program(self, items, pad_to=None):
+        from vizier_tpu.parallel import batch_executor
+
+        d0: "VizierGPBandit" = items[0]["designer"]
+        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
+            [it[name] for it in items], pad_to
+        )
+        with jax_timing.device_phase(self.device_phase) as phase:
+            states, warm_next, result = sparse_bandit._sparse_flush_program(
+                d0._sparse_model(), d0._ard, d0._vec_opt,
+                d0._make_acquisition(),
+                stack("md"), stack("rng_train"), stack("rng_acq"),
+                stack("warm"),
+                items[0]["restarts"], d0.ensemble_size,
+                items[0]["count"], d0.use_trust_region,
+            )
+            phase.block(result)
+        return _gp_bandit_demux(
+            items, pad_to, states, warm_next, result, sparse=True
+        )
+
+    def finalize(self, designer, item, output):
+        states = output["states"]
+        designer._record_train()
+        if designer._warm_update_allowed():
+            designer._warm_params = output["warm_next"]
+            designer._warm_is_trained = True
+        designer._last_predictive = sparse_gp.SparseEnsemblePredictive(states)
+        designer._last_sparse_state = states
+        designer._surrogate_counts["sparse_suggests"] += 1
+        return designer._decode_result(
+            output["result"],
+            item["count"],
+            kind=f"{designer.acquisition}+sparse",
+        )
+
+    def prewarm_factory(self, problem, **kwargs):
+        # The walker's synthetic studies engage this program exactly when
+        # the factory's surrogate config flips them sparse (threshold vs
+        # the walked trial bucket) — the same auto-switch live studies use.
+        return VizierGPBandit(problem, **kwargs)
+
+
+compute_registry.register(VizierGPBandit, GPBanditProgram())
+compute_registry.register(VizierGPBandit, GPBanditSparseProgram())

@@ -44,7 +44,12 @@ import numpy as np
 
 from vizier_tpu import types
 from vizier_tpu.algorithms import core as core_lib
+from vizier_tpu.compute import ir as compute_ir
+from vizier_tpu.compute import registry as compute_registry
 from vizier_tpu.designers import gp_bandit
+from vizier_tpu.surrogates import config as surrogate_config_lib
+from vizier_tpu.surrogates import sparse_bandit
+from vizier_tpu.surrogates import sparse_gp
 from vizier_tpu.designers.gp import acquisitions
 from vizier_tpu.models import gp as gp_lib
 from vizier_tpu.models import kernels
@@ -223,6 +228,62 @@ def _append_row_mt(
     )
 
 
+# A pick whose Nyström residual k** − ‖L⁻¹k(Z,x)‖² exceeds this fraction of
+# the prior variance is "not near an inducing row": the base inducing set
+# carries (almost) no information at x, so conditioning through it would
+# barely deflate the local stddev and the PE score would re-pick the same
+# point for the rest of the batch. Such picks join the inducing set.
+_NYSTROM_RESIDUAL_FRACTION = 0.1
+
+
+def _append_row_sparse(
+    sdata: "sparse_gp.SparseGPData",
+    x: kernels.MixedFeatures,
+    ref_state: "sparse_gp.SparseGPState",
+) -> "sparse_gp.SparseGPData":
+    """Sparse pending-pick conditioning: append + conditional Nyström augment.
+
+    The pick always joins the all-points data rows (so ``A`` gains a
+    column and the inducing posterior's stddev deflates near it, exactly
+    like the exact path's pending rows). When the pick is NOT near an
+    inducing row — measured by its Nyström residual under ``ref_state``,
+    the trained completed-posterior's member-0 factorization — it is also
+    written into the next spare (masked-off) inducing slot reserved by
+    :func:`sparse_gp.with_pending_capacity`, restoring the variance
+    deflation the inducing bottleneck would otherwise swallow. Traceable:
+    fixed shapes, pure ``at[].set`` writes.
+    """
+    data = _append_row(sdata.data, x)
+    # Residual vs the BASE inducing set (amp² − ‖L⁻¹k(Z,x)‖² at member 0).
+    kz = ref_state.model.base._kernel(
+        ref_state.params, x, ref_state.sdata.z_features(), ref_state.sdata.data
+    )  # [1, m]
+    kz = jnp.where(ref_state.sdata.inducing_mask[None, :], kz, 0.0)
+    t1 = ref_state.linv @ kz[0]
+    amp2 = ref_state.params["amplitude"] * ref_state.params["amplitude"]
+    residual = amp2 - jnp.sum(t1 * t1)
+    augment = residual > _NYSTROM_RESIDUAL_FRACTION * amp2
+    # Masks stay a true-prefix (k-center fills a prefix; augments extend
+    # it), so the next free slot is the current true count.
+    idx = jnp.sum(sdata.inducing_mask.astype(jnp.int32))
+    idx = jnp.minimum(idx, sdata.inducing_mask.shape[0] - 1)
+    write = augment & ~sdata.inducing_mask[idx]
+    z_cont = sdata.z_continuous.at[idx].set(
+        jnp.where(write, x.continuous[0], sdata.z_continuous[idx])
+    )
+    z_cat = sdata.z_categorical.at[idx].set(
+        jnp.where(write, x.categorical[0], sdata.z_categorical[idx])
+    )
+    mask = sdata.inducing_mask.at[idx].set(sdata.inducing_mask[idx] | write)
+    return sparse_gp.SparseGPData(
+        data=data,
+        z_continuous=z_cont,
+        z_categorical=z_cat,
+        inducing_mask=mask,
+        inducing_indices=sdata.inducing_indices,
+    )
+
+
 def _hv_scalarized(
     values: Array,  # [M, Q] per-metric acquisition values
     weights: Array,  # [K, M] positive scalarization directions
@@ -284,10 +345,26 @@ def _suggest_batch(
     prior_acquisition=None,  # Callable[[MixedFeatures], [Q]-array] user prior
 ) -> Tuple[vectorized_lib.VectorizedOptimizerResult, dict]:
     """The greedy batch: per pick, UCB-or-PE with pending-point conditioning."""
-    # Static dispatch: the multitask (SEPARABLE) path swaps the posterior
-    # ops; every acquisition formula below is shared between the two.
+    # Static dispatch: the multitask (SEPARABLE) and sparse (SGPR) paths
+    # swap the posterior ops; every acquisition formula below is shared.
     is_mt = isinstance(model, mtgp.MultiTaskGaussianProcess)
-    if is_mt:
+    is_sparse = isinstance(model, sparse_gp.SparseGaussianProcess)
+    if is_sparse:
+        # Pending-pick conditioning through the inducing-point posterior:
+        # ``all_data`` is a SparseGPData (completed+active rows + the
+        # trained Z with spare augment slots); re-conditioning rebuilds the
+        # O(n·m²) SGPR factorization on the grown pending set instead of
+        # the exact path's O(n³) per-pick Cholesky. ``model`` is the
+        # augmented-capacity SparseGaussianProcess (m + count slots).
+        mixture = _mixture_predict  # SparseGPState duck-types .predict
+        base_data = lambda d: d.data  # noqa: E731
+        member0 = jax.tree_util.tree_map(lambda a: a[0, 0], states_completed)
+        append = lambda d, x: _append_row_sparse(d, x, member0)  # noqa: E731
+        recondition = lambda p, d: jax.vmap(  # noqa: E731
+            jax.vmap(lambda q: model.precompute_constrained(q, d))
+        )(p)
+        mt_snr = None
+    elif is_mt:
         mixture = _mt_mixture_predict
         base_data = lambda d: d.features_data  # noqa: E731
         append = _append_row_mt
@@ -666,6 +743,83 @@ def _ucb_pe_flush_program(
     return states, warm_next, data, segments
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "model", "aug_model", "optimizer", "vec_opt", "vec_opt_rest",
+        "num_restarts", "ensemble_size", "count", "config",
+        "use_trust_region", "two_phase",
+    ),
+)
+def _sparse_ucb_pe_flush_program(
+    model,  # SparseGaussianProcess over the trained m-bucket
+    aug_model,  # SparseGaussianProcess with m + count augment slots
+    optimizer,
+    vec_opt,
+    vec_opt_rest,
+    md,  # stacked host ModelData (completed trials), leading study axis
+    all_md,  # stacked host ModelData (completed+active, spare pick rows)
+    rng_train: Array,  # [B]
+    rng_acq: Array,  # [B]
+    rng_rest: Array,  # [B] (ignored unless two_phase)
+    warm,  # per-study warm ARD seeds, leading axis [B]
+    first_has_new: Array,  # [B] bool
+    has_completed: Array,  # [B] bool
+    num_restarts: int,
+    ensemble_size: int,
+    count: int,
+    config: UCBPEConfig,
+    use_trust_region: bool,
+    two_phase: bool,
+):
+    """The sparse twin of :func:`_ucb_pe_flush_program`: ONE device program
+    per bucket flush — encode → k-center inducing selection → collapsed-
+    bound ARD → the greedy UCB-PE batch with pending-pick conditioning
+    through the inducing posterior (Nyström-augmented) → warm seed. A
+    slot matches its study run alone through the sequential sparse path.
+    """
+    data = jax.vmap(lambda m: gp_lib.GPData.from_model_data(m))(md)
+    all_gp = jax.vmap(lambda m: gp_lib.GPData.from_model_data(m))(all_md)
+    states = jax.vmap(
+        lambda d, k, w: sparse_bandit._train_sparse_gp(
+            model, optimizer, d, k, num_restarts, ensemble_size, w
+        )
+    )(data, rng_train, warm)
+    warm_next = sparse_bandit._warm_next_batched(model, states)
+    # [B, E] -> [B, M=1, E]: the UCB-PE programs are per-metric batched.
+    states_me = jax.tree_util.tree_map(lambda a: a[:, None], states)
+    # Per-slot all-points data over the slot's trained inducing set (every
+    # ensemble member shares it), with count spare Nyström slots.
+    all_sdata = jax.vmap(
+        lambda s, ag: sparse_gp.with_pending_capacity(
+            jax.tree_util.tree_map(lambda a: a[0], s.sdata), ag, count
+        )
+    )(states, all_gp)
+    if two_phase:
+        first, aux1 = _sweep_batched(
+            aug_model, vec_opt, states_me, all_sdata, data, rng_acq,
+            first_has_new, has_completed, 1, config, use_trust_region,
+        )
+        x = kernels.MixedFeatures(
+            first.features.continuous[:, :1], first.features.categorical[:, :1]
+        )
+        member0 = jax.tree_util.tree_map(lambda a: a[:, 0, 0], states_me)
+        all_sdata = jax.vmap(_append_row_sparse)(all_sdata, x, member0)
+        rest, aux2 = _sweep_batched(
+            aug_model, vec_opt_rest, states_me, all_sdata, data, rng_rest,
+            jnp.zeros_like(first_has_new), has_completed, count - 1,
+            config, use_trust_region,
+        )
+        segments = ((first, aux1), (rest, aux2))
+    else:
+        batch, aux = _sweep_batched(
+            aug_model, vec_opt_rest, states_me, all_sdata, data, rng_acq,
+            first_has_new, has_completed, count, config, use_trust_region,
+        )
+        segments = ((batch, aux),)
+    return states, warm_next, data, segments
+
+
 def _train_mt_gp(
     model: mtgp.MultiTaskGaussianProcess,
     optimizer,
@@ -827,6 +981,67 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             if not m.is_safety_metric
         ]
 
+    # -- scalable surrogate for the DEFAULT (vizier_tpu.surrogates) ---------
+
+    def _sparse_ucb_pe_eligible(self) -> bool:
+        """Whether the sparse surrogate may serve this designer's suggests.
+
+        The single-objective independent-GP greedy path only: multitask,
+        multi-objective, set-acquisition, transfer priors, custom
+        acquisition priors, and mesh-sharded designers stay exact — the
+        same carve-outs the base class documents for its sparse path.
+        """
+        cfg = self.surrogate
+        return bool(
+            cfg is not None
+            and cfg.sparse
+            and getattr(cfg, "sparse_ucb_pe", True)
+            and self._mesh is None
+            and len(self._objective_indices()) == 1
+            and not self.config.optimize_set_acquisition_for_exploration
+            and self.prior_acquisition is None
+            and not getattr(self, "_priors", None)
+        )
+
+    def _refresh_ucb_pe_surrogate_mode(self) -> str:
+        """The auto-switch, applied only where the sparse UCB-PE programs
+        cover; ineligible designers never leave exact (bit-identical)."""
+        if not self._sparse_ucb_pe_eligible():
+            return self._surrogate_mode
+        return self._refresh_surrogate_mode()
+
+    def _refresh_surrogate_mode(self) -> str:
+        before = self._surrogate_counts["crossovers"]
+        mode = super()._refresh_surrogate_mode()
+        if self._surrogate_counts["crossovers"] != before:
+            # The base crossover dropped ITS warm/posterior state; the
+            # UCB-PE designer's cross-surrogate state — per-metric warm
+            # seeds and the cached fit — is equally stale. Fresh random
+            # placeholders keep the train program's pytree stable.
+            coll = self._model.param_collection()
+            n = max(len(self._warm_params_me), 1)
+            keys = jax.random.split(
+                jax.random.PRNGKey(
+                    self.rng_seed + 2 + self._surrogate_counts["crossovers"]
+                ),
+                n,
+            )
+            self._warm_params_me = [
+                coll.random_init_unconstrained(k)
+                for k in keys[: len(self._warm_params_me)]
+            ]
+            self._cached_states = None
+        return mode
+
+    def _sparse_all_model(self, count: int) -> sparse_gp.SparseGaussianProcess:
+        """The re-conditioning model over the augmented inducing capacity:
+        the trained posterior's m slots plus one spare Nyström slot per
+        batch pick (a frozen value object — stable jit static)."""
+        base = self._sparse_model()
+        return sparse_gp.SparseGaussianProcess(
+            base=self._model, num_inducing=base.num_inducing + count
+        )
+
     def _train_states_me(self) -> Tuple[gp_lib.GPState, List[gp_lib.GPData]]:
         """Per-metric GP training: GPState with leading [M, E] + the datas.
 
@@ -850,6 +1065,40 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
                 types.ModelData(features, self._padded_labels(warped, n_pad))
             )
             datas.append(data)
+        if (
+            len(datas) == 1
+            and self._refresh_ucb_pe_surrogate_mode()
+            == surrogate_config_lib.MODE_SPARSE
+        ):
+            # Sparse DEFAULT: the SGPR collapsed bound replaces the exact
+            # O(n³) ARD — same multi-restart L-BFGS program shape, same
+            # warm-seed-as-extra-restart-row semantics, k-center inducing
+            # selection inside the jitted program.
+            model = self._sparse_model()
+            restarts = max(
+                self._warm_restart_budget() or self.ard_restarts, ensemble
+            )
+            states = sparse_bandit._train_sparse_gp(
+                model,
+                self._ard,
+                datas[0],
+                self._next_rng(),
+                restarts,
+                ensemble,
+                self._warm_params_me[0],
+            )
+            self._record_train()
+            if self._warm_update_allowed():
+                coll = self._model.param_collection()
+                self._warm_params_me = [
+                    coll.unconstrain(
+                        jax.tree_util.tree_map(lambda a: a[0], states.params)
+                    )
+                ]
+                self._warm_is_trained = True
+            states_me = jax.tree_util.tree_map(lambda a: a[None], states)
+            self._cached_states = (states_me, datas)
+            return self._cached_states
         if self._use_multitask(len(datas)):
             # One joint GP: learned task covariance over a B ⊗ Kx Gram.
             mt_model = self._mt_model(len(datas))
@@ -923,7 +1172,13 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         self._warm_params_me = list(params)
         self._warm_is_trained = True
 
-    # -- cross-study batch protocol (vizier_tpu.parallel.batch_executor) ----
+    # -- cross-study batch protocol (vizier_tpu.compute IR) -----------------
+    #
+    # The real implementations live in the registered DesignerProgram
+    # classes at the bottom of this module (UCBPEProgram /
+    # UCBPESparseProgram); the thin methods inherited from VizierGPBandit
+    # keep the duck-typed surface working, routed here via
+    # ``_active_batch_program``.
 
     def _batch_ensemble(self) -> int:
         return max(self.ensemble_size, 1)
@@ -936,155 +1191,29 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
             self._batch_ensemble(),
         )
 
-    def batch_bucket_key(self, count: Optional[int] = None):
-        """Shape-bucket identity for cross-study batching, or None.
+    def _active_batch_program(self):
+        from vizier_tpu.compute import registry as compute_registry
 
-        Batchable: the single-objective independent-GP greedy path with no
-        cached fit (a cached fit means the sequential suggest would skip
-        training — re-training it in a batch would deviate). Multitask,
-        set-acquisition, priors, custom acquisition priors, mesh sharding,
-        and the seeding stage run sequentially.
-        """
-        count = count or 1
-        if (
-            self._mesh is not None
-            or len(self._trials) + len(self._active_trials) < self.num_seed_trials
-            or getattr(self, "_priors", None)
-            or len(self._objective_indices()) != 1
-            or self.config.optimize_set_acquisition_for_exploration
-            or self.prior_acquisition is not None
-            or self._cached_states is not None
-        ):
-            return None
-        from vizier_tpu.parallel import batch_executor
-
-        pad = self._converter.padding
-        n_all = len(self._trials) + len(self._active_trials)
-        return batch_executor.BucketKey(
-            kind="gp_ucb_pe",
-            pad_trials=pad.pad_trials(len(self._trials)),
-            cont_width=self._cont_width,
-            cat_width=self._cat_width,
-            metric_count=1,
-            count=count,
-            statics=(
-                # all-points rows get their own padded size (spare rows for
-                # the batch picks), so it is part of the shape identity.
-                pad.pad_trials(n_all + count),
-                self._model,
-                self._ard,
-                self._vec_opt,
-                self._pick_vec_opt(count),
-                self._batch_restarts(),
-                self._batch_ensemble(),
-                self.config,
-                self.use_trust_region,
-                self.acquisition_budget_policy,
-            ),
+        kind = (
+            "gp_ucb_pe_sparse"
+            if self._surrogate_mode == surrogate_config_lib.MODE_SPARSE
+            else "gp_ucb_pe"
         )
-
-    def batch_prepare(self, count: Optional[int] = None) -> dict:
-        """Host-side half of a batched suggest (single-objective path).
-
-        Encodes + warps this study's data and draws RNG keys in exactly the
-        sequential order: one train key, then one acquisition key per
-        ``_suggest_batch`` call the budget policy would make.
-        """
-        count = count or 1
-        conv = self._converter
-        raw = conv.metrics.encode(self._trials)
-        features, n_pad = self._padded_features(self._trials)
-        j = self._objective_indices()[0]
-        warper = output_warpers.create_default_warper()
-        warped = warper(raw[:, j]) if raw.shape[0] else raw[:, j]
-        self._metric_warpers = [warper]
-        self._warpers_fitted = raw.shape[0] > 0
-        # Host-only (numpy ModelData): GPData conversion, label stacking,
-        # reference point, and prior features all happen inside the batched
-        # device programs — prepare's only device work is the RNG splits.
-        md = types.ModelData(features, self._padded_labels(warped, n_pad))
-        rng_train = self._next_rng()
-        two_phase = self.acquisition_budget_policy == "first_pick_full" and count > 1
-        return dict(
-            designer=self,
-            count=count,
-            md=md,
-            all_md=self._all_points_model_data(count),
-            first_has_new=np.asarray(self._has_new_completed_trials()),
-            has_completed=np.asarray(bool(self._trials)),
-            warm=self._warm_params_me[0],
-            restarts=self._batch_restarts(),
-            rng_train=rng_train,
-            rng_acq=self._next_rng(),
-            rng_acq_rest=self._next_rng() if two_phase else None,
-        )
+        return compute_registry.get(kind)
 
     @classmethod
     def batch_execute(cls, items: Sequence[dict], pad_to: Optional[int] = None):
-        """Device half: vmapped ARD train + vmapped UCB-PE batch loop(s) for
-        the whole bucket (two sweep programs under ``first_pick_full`` with
-        count > 1, exactly like the sequential flow)."""
-        from vizier_tpu.parallel import batch_executor
+        """Device half: dispatched to the bucket's registered program."""
+        from vizier_tpu.compute import registry as compute_registry
 
-        d0: "VizierGPUCBPEBandit" = items[0]["designer"]
-        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
-            [it[name] for it in items], pad_to
-        )
-        count = items[0]["count"]
-        two_phase = (
-            d0.acquisition_budget_policy == "first_pick_full" and count > 1
-        )
-        rng_a = stack("rng_acq")
-        with jax_timing.device_phase("gp_ucb_pe.suggest_batched") as phase:
-            states, warm_next, data, segments = _ucb_pe_flush_program(
-                d0._model, d0._ard, d0._vec_opt, d0._pick_vec_opt(count),
-                stack("md"), stack("all_md"),
-                stack("rng_train"), rng_a,
-                stack("rng_acq_rest") if two_phase else rng_a,
-                stack("warm"), stack("first_has_new"), stack("has_completed"),
-                items[0]["restarts"], d0._batch_ensemble(), count,
-                d0.config, d0.use_trust_region, two_phase,
-            )
-            phase.block(segments)
-        rows = [1, count - 1] if two_phase else [count]
-        # ONE device->host fetch for everything the demux needs; per-slot
-        # slices below are then free numpy views.
-        states, warm_next, data, segments = jax.device_get(
-            (states, warm_next, data, segments)
-        )
-        return [
-            dict(
-                states=batch_executor.slice_pytree(states, i),
-                warm_next=batch_executor.slice_pytree(warm_next, i),
-                data=batch_executor.slice_pytree(data, i),
-                segments=[
-                    (
-                        batch_executor.slice_pytree(result, i),
-                        batch_executor.slice_pytree(aux, i),
-                        n,
-                    )
-                    for (result, aux), n in zip(segments, rows)
-                ],
-            )
-            for i in range(len(items))
-        ]
+        kind = "gp_ucb_pe_sparse" if items[0].get("sparse") else "gp_ucb_pe"
+        return compute_registry.get(kind).device_program(items, pad_to=pad_to)
 
     def batch_finalize(self, item: dict, output: dict) -> List[trial_.TrialSuggestion]:
-        """Host-side demux: warm writeback, fit caching for predict/sample,
-        and per-segment decode — the sequential suggest's state transitions."""
-        states = output["states"]  # [E] leaves (this study's ensemble)
-        self._record_train()
-        if self._warm_update_allowed():
-            # The unconstrain already ran (vmapped) inside the flush program.
-            self._warm_params_me = [output["warm_next"]]
-            self._warm_is_trained = True
-        states_me = jax.tree_util.tree_map(lambda a: a[None], states)  # [1, E]
-        self._cached_states = (states_me, [output["data"]])
-        self._last_predictive = gp_lib.EnsemblePredictive(states)
-        out: List[trial_.TrialSuggestion] = []
-        for result, aux, rows in output["segments"]:
-            out.extend(self._decode_ucb_pe(result, aux, rows))
-        return out
+        from vizier_tpu.compute import registry as compute_registry
+
+        kind = "gp_ucb_pe_sparse" if output.get("sparse") else "gp_ucb_pe"
+        return compute_registry.get(kind).finalize(self, item, output)
 
     def _use_multitask(self, num_metrics: int) -> bool:
         return (
@@ -1127,16 +1256,31 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         if getattr(self, "_priors", None):
             return self._suggest_with_priors(count)
 
+        # The surrogate auto-switch decides the device-phase family up
+        # front (idempotent; ineligible designers always report exact).
+        sparse_mode = (
+            self._refresh_ucb_pe_surrogate_mode()
+            == surrogate_config_lib.MODE_SPARSE
+        )
         with profiler.timeit("train_gp"):
             # Device-attributed ARD timing (compile vs. steady-state): see
             # gp_bandit.suggest for the rationale; no-op + no device sync
             # when observability is off.
-            with jax_timing.device_phase("gp_ucb_pe.train_gp") as phase:
+            with jax_timing.device_phase(
+                "sparse_gp.ucb_pe_train_gp" if sparse_mode else "gp_ucb_pe.train_gp"
+            ) as phase:
                 states_me, datas = self._train_states_me()
                 phase.block(states_me)
         is_mt = isinstance(states_me, mtgp.MultiTaskGPState)
+        is_sparse = isinstance(states_me, sparse_gp.SparseGPState)
         if is_mt:
             self._last_predictive = _MetricZeroMTPredictive(states_me)
+        elif is_sparse:
+            member_states = jax.tree_util.tree_map(lambda a: a[0], states_me)
+            self._last_predictive = sparse_gp.SparseEnsemblePredictive(
+                member_states
+            )
+            self._last_sparse_state = member_states
         else:
             self._last_predictive = gp_lib.EnsemblePredictive(
                 jax.tree_util.tree_map(lambda a: a[0], states_me)
@@ -1175,6 +1319,15 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
                 ),
                 task_mask=jnp.tile(all_data.row_mask[None, :], (num_metrics, 1)),
             )
+        elif is_sparse:
+            # All-points twin of the trained posterior's inducing set, with
+            # one spare Nyström slot per pick; the augmented-capacity model
+            # re-conditions per pick in O(n·m²) instead of O(n³).
+            model = self._sparse_all_model(count)
+            sdata0 = jax.tree_util.tree_map(
+                lambda a: a[0, 0], states_me.sdata
+            )
+            all_data = sparse_gp.with_pending_capacity(sdata0, all_data, count)
         else:
             model = self._model
         prior_feats = self._prior_features(datas[0])
@@ -1182,7 +1335,9 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         # Device-attributed sweep timing; the block_until_ready calls on the
         # batch scores below already pin device time inside this phase.
         with profiler.timeit("acquisition_optimizer"), jax_timing.device_phase(
-            "gp_ucb_pe.acquisition"
+            "sparse_gp.ucb_pe_acquisition"
+            if is_sparse
+            else "gp_ucb_pe.acquisition"
         ):
             if self.acquisition_budget_policy == "first_pick_full" and count > 1:
                 # Full budget on the exploitation-critical first pick; one
@@ -1198,9 +1353,18 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
                     first.features.continuous[:1],
                     first.features.categorical[:1],
                 )
-                all_data = (_append_row_mt if is_mt else _append_row)(
-                    all_data, x
-                )
+                if is_sparse:
+                    all_data = _append_row_sparse(
+                        all_data,
+                        x,
+                        jax.tree_util.tree_map(
+                            lambda a: a[0, 0], states_me
+                        ),
+                    )
+                else:
+                    all_data = (_append_row_mt if is_mt else _append_row)(
+                        all_data, x
+                    )
                 # _pick_vec_opt(count) is the ONE budget-dispatch point: under
                 # first_pick_full it returns the (count-1)-way split sweep.
                 rest, aux2 = _suggest_batch(
@@ -1233,6 +1397,8 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
                 )
                 jax.block_until_ready(batch.scores)
                 results = [(batch, aux, count)]
+        if is_sparse:
+            self._surrogate_counts["sparse_suggests"] += 1
         with profiler.timeit("best_candidates_to_trials"):
             out: List[trial_.TrialSuggestion] = []
             for result, aux, rows in results:
@@ -1395,3 +1561,302 @@ def default_factory(
     problem: base_study_config.ProblemStatement, seed: Optional[int] = None, **kwargs
 ) -> VizierGPUCBPEBandit:
     return VizierGPUCBPEBandit(problem, rng_seed=seed or 0, **kwargs)
+
+
+# -- compute-IR programs (vizier_tpu.compute) --------------------------------
+#
+# The batched designer-compute contract for the service DEFAULT: one
+# program per compiled-flush family (exact | sparse UCB-PE). Hook bodies
+# are the pre-IR ``batch_*`` methods moved verbatim (exact) and the sparse
+# twin that exists because the seam does — SGPR train + pending-pick
+# conditioning through the inducing-point posterior.
+
+
+def _ucb_pe_unbatchable(designer: "VizierGPUCBPEBandit", count: int) -> bool:
+    """Paths the batched UCB-PE flush programs do not cover.
+
+    Batchable: the single-objective independent-GP greedy path with no
+    cached fit (a cached fit means the sequential suggest would skip
+    training — re-training it in a batch would deviate). Multitask,
+    set-acquisition, priors, custom acquisition priors, mesh sharding, and
+    the seeding stage run sequentially.
+    """
+    return bool(
+        designer._mesh is not None
+        or len(designer._trials) + len(designer._active_trials)
+        < designer.num_seed_trials
+        or getattr(designer, "_priors", None)
+        or len(designer._objective_indices()) != 1
+        or designer.config.optimize_set_acquisition_for_exploration
+        or designer.prior_acquisition is not None
+        or designer._cached_states is not None
+    )
+
+
+def _ucb_pe_prepare(
+    designer: "VizierGPUCBPEBandit", count: int, sparse: bool
+) -> dict:
+    """Host-side half of a batched UCB-PE suggest (single-objective path).
+
+    Encodes + warps this study's data and draws RNG keys in exactly the
+    sequential order: one train key, then one acquisition key per
+    ``_suggest_batch`` call the budget policy would make. Host-only (numpy
+    ModelData): GPData conversion, label stacking, reference point, and
+    prior features all happen inside the batched device programs —
+    prepare's only device work is the RNG splits.
+    """
+    conv = designer._converter
+    raw = conv.metrics.encode(designer._trials)
+    features, n_pad = designer._padded_features(designer._trials)
+    j = designer._objective_indices()[0]
+    warper = output_warpers.create_default_warper()
+    warped = warper(raw[:, j]) if raw.shape[0] else raw[:, j]
+    designer._metric_warpers = [warper]
+    designer._warpers_fitted = raw.shape[0] > 0
+    md = types.ModelData(features, designer._padded_labels(warped, n_pad))
+    rng_train = designer._next_rng()
+    two_phase = (
+        designer.acquisition_budget_policy == "first_pick_full" and count > 1
+    )
+    return dict(
+        designer=designer,
+        count=count,
+        md=md,
+        all_md=designer._all_points_model_data(count),
+        first_has_new=np.asarray(designer._has_new_completed_trials()),
+        has_completed=np.asarray(bool(designer._trials)),
+        warm=designer._warm_params_me[0],
+        restarts=designer._batch_restarts(),
+        rng_train=rng_train,
+        rng_acq=designer._next_rng(),
+        rng_acq_rest=designer._next_rng() if two_phase else None,
+        sparse=sparse,
+    )
+
+
+def _ucb_pe_demux(items, states, warm_next, data, segments, rows, sparse: bool):
+    """ONE device->host fetch for everything the demux needs; per-slot
+    slices below are then free numpy views."""
+    from vizier_tpu.parallel import batch_executor
+
+    states, warm_next, data, segments = jax.device_get(
+        (states, warm_next, data, segments)
+    )
+    return [
+        dict(
+            states=batch_executor.slice_pytree(states, i),
+            warm_next=batch_executor.slice_pytree(warm_next, i),
+            data=batch_executor.slice_pytree(data, i),
+            segments=[
+                (
+                    batch_executor.slice_pytree(result, i),
+                    batch_executor.slice_pytree(aux, i),
+                    n,
+                )
+                for (result, aux), n in zip(segments, rows)
+            ],
+            sparse=sparse,
+        )
+        for i in range(len(items))
+    ]
+
+
+class UCBPEProgram(compute_ir.DesignerProgram):
+    """Exact UCB-PE flush: vmapped ARD train + vmapped greedy batch loop(s)
+    (two sweep programs under ``first_pick_full`` with count > 1, exactly
+    like the sequential flow)."""
+
+    kind = "gp_ucb_pe"
+    device_phase = "gp_ucb_pe.suggest_batched"
+    surrogate_family = "exact"
+    algorithms = ("DEFAULT", "GP_UCB_PE", "ALGORITHM_UNSPECIFIED")
+
+    def bucket_key(self, designer, count):
+        if _ucb_pe_unbatchable(designer, count):
+            return None
+        if (
+            designer._refresh_ucb_pe_surrogate_mode()
+            == surrogate_config_lib.MODE_SPARSE
+        ):
+            return None  # the sparse UCB-PE program owns this study
+        pad = designer._converter.padding
+        n_all = len(designer._trials) + len(designer._active_trials)
+        return compute_ir.BucketKey(
+            kind=self.kind,
+            pad_trials=pad.pad_trials(len(designer._trials)),
+            cont_width=designer._cont_width,
+            cat_width=designer._cat_width,
+            metric_count=1,
+            count=count,
+            statics=(
+                # all-points rows get their own padded size (spare rows for
+                # the batch picks), so it is part of the shape identity.
+                pad.pad_trials(n_all + count),
+                designer._model,
+                designer._ard,
+                designer._vec_opt,
+                designer._pick_vec_opt(count),
+                designer._batch_restarts(),
+                designer._batch_ensemble(),
+                designer.config,
+                designer.use_trust_region,
+                designer.acquisition_budget_policy,
+            ),
+        )
+
+    def prepare(self, designer, count):
+        return _ucb_pe_prepare(designer, count, sparse=False)
+
+    def device_program(self, items, pad_to=None):
+        from vizier_tpu.parallel import batch_executor
+
+        d0: "VizierGPUCBPEBandit" = items[0]["designer"]
+        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
+            [it[name] for it in items], pad_to
+        )
+        count = items[0]["count"]
+        two_phase = (
+            d0.acquisition_budget_policy == "first_pick_full" and count > 1
+        )
+        rng_a = stack("rng_acq")
+        with jax_timing.device_phase(self.device_phase) as phase:
+            states, warm_next, data, segments = _ucb_pe_flush_program(
+                d0._model, d0._ard, d0._vec_opt, d0._pick_vec_opt(count),
+                stack("md"), stack("all_md"),
+                stack("rng_train"), rng_a,
+                stack("rng_acq_rest") if two_phase else rng_a,
+                stack("warm"), stack("first_has_new"), stack("has_completed"),
+                items[0]["restarts"], d0._batch_ensemble(), count,
+                d0.config, d0.use_trust_region, two_phase,
+            )
+            phase.block(segments)
+        rows = [1, count - 1] if two_phase else [count]
+        return _ucb_pe_demux(
+            items, states, warm_next, data, segments, rows, sparse=False
+        )
+
+    def finalize(self, designer, item, output):
+        """Host-side demux: warm writeback, fit caching for predict/sample,
+        and per-segment decode — the sequential suggest's state
+        transitions."""
+        states = output["states"]  # [E] leaves (this study's ensemble)
+        designer._record_train()
+        if designer._warm_update_allowed():
+            # The unconstrain already ran (vmapped) inside the flush program.
+            designer._warm_params_me = [output["warm_next"]]
+            designer._warm_is_trained = True
+        states_me = jax.tree_util.tree_map(lambda a: a[None], states)  # [1, E]
+        designer._cached_states = (states_me, [output["data"]])
+        designer._last_predictive = gp_lib.EnsemblePredictive(states)
+        out: List[trial_.TrialSuggestion] = []
+        for result, aux, rows in output["segments"]:
+            out.extend(designer._decode_ucb_pe(result, aux, rows))
+        return out
+
+    def prewarm_factory(self, problem, **kwargs):
+        return VizierGPUCBPEBandit(problem, **kwargs)
+
+
+class UCBPESparseProgram(compute_ir.DesignerProgram):
+    """Sparse UCB-PE flush: SGPR collapsed-bound train + the greedy batch
+    with pending-pick conditioning through the inducing-point posterior.
+
+    Exists because the IR seam does: the program reuses the exact UCB-PE
+    prepare/demux shapes and the shared ``_sweep_batched`` body, swapping
+    only the train and the per-pick re-conditioning — 1000+-trial studies
+    on the service DEFAULT scale like the sparse GP-bandit path."""
+
+    kind = "gp_ucb_pe_sparse"
+    device_phase = "sparse_gp.ucb_pe_suggest_batched"
+    surrogate_family = "sparse"
+    algorithms = ("DEFAULT", "GP_UCB_PE", "ALGORITHM_UNSPECIFIED")
+
+    def bucket_key(self, designer, count):
+        if _ucb_pe_unbatchable(designer, count):
+            return None
+        if (
+            designer._refresh_ucb_pe_surrogate_mode()
+            != surrogate_config_lib.MODE_SPARSE
+        ):
+            return None
+        pad = designer._converter.padding
+        n_all = len(designer._trials) + len(designer._active_trials)
+        return compute_ir.BucketKey(
+            kind=self.kind,
+            pad_trials=pad.pad_trials(len(designer._trials)),
+            cont_width=designer._cont_width,
+            cat_width=designer._cat_width,
+            metric_count=1,
+            count=count,
+            statics=(
+                pad.pad_trials(n_all + count),
+                # Both sparse models ride the statics: the m-bucket (train)
+                # AND the augmented-capacity model (re-conditioning), so
+                # equal keys ⇒ one compiled program per (n, m, count).
+                designer._sparse_model(),
+                designer._sparse_all_model(count),
+                designer._ard,
+                designer._vec_opt,
+                designer._pick_vec_opt(count),
+                designer._batch_restarts(),
+                designer._batch_ensemble(),
+                designer.config,
+                designer.use_trust_region,
+                designer.acquisition_budget_policy,
+            ),
+        )
+
+    def prepare(self, designer, count):
+        return _ucb_pe_prepare(designer, count, sparse=True)
+
+    def device_program(self, items, pad_to=None):
+        from vizier_tpu.parallel import batch_executor
+
+        d0: "VizierGPUCBPEBandit" = items[0]["designer"]
+        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
+            [it[name] for it in items], pad_to
+        )
+        count = items[0]["count"]
+        two_phase = (
+            d0.acquisition_budget_policy == "first_pick_full" and count > 1
+        )
+        rng_a = stack("rng_acq")
+        with jax_timing.device_phase(self.device_phase) as phase:
+            states, warm_next, data, segments = _sparse_ucb_pe_flush_program(
+                d0._sparse_model(), d0._sparse_all_model(count),
+                d0._ard, d0._vec_opt, d0._pick_vec_opt(count),
+                stack("md"), stack("all_md"),
+                stack("rng_train"), rng_a,
+                stack("rng_acq_rest") if two_phase else rng_a,
+                stack("warm"), stack("first_has_new"), stack("has_completed"),
+                items[0]["restarts"], d0._batch_ensemble(), count,
+                d0.config, d0.use_trust_region, two_phase,
+            )
+            phase.block(segments)
+        rows = [1, count - 1] if two_phase else [count]
+        return _ucb_pe_demux(
+            items, states, warm_next, data, segments, rows, sparse=True
+        )
+
+    def finalize(self, designer, item, output):
+        states = output["states"]  # sparse [E] leaves
+        designer._record_train()
+        if designer._warm_update_allowed():
+            designer._warm_params_me = [output["warm_next"]]
+            designer._warm_is_trained = True
+        states_me = jax.tree_util.tree_map(lambda a: a[None], states)
+        designer._cached_states = (states_me, [output["data"]])
+        designer._last_predictive = sparse_gp.SparseEnsemblePredictive(states)
+        designer._last_sparse_state = states
+        designer._surrogate_counts["sparse_suggests"] += 1
+        out: List[trial_.TrialSuggestion] = []
+        for result, aux, rows in output["segments"]:
+            out.extend(designer._decode_ucb_pe(result, aux, rows))
+        return out
+
+    def prewarm_factory(self, problem, **kwargs):
+        return VizierGPUCBPEBandit(problem, **kwargs)
+
+
+compute_registry.register(VizierGPUCBPEBandit, UCBPEProgram())
+compute_registry.register(VizierGPUCBPEBandit, UCBPESparseProgram())

@@ -38,53 +38,33 @@ and due live batches always execute first. ``queue_depth()`` /
 ``live_pending()`` expose per-lane occupancy so the speculative admission
 gate can refuse to enqueue under live saturation.
 
-Batchable designers expose four duck-typed hooks (``gp_bandit`` and
-``gp_ucb_pe`` implement them; anything else runs sequentially):
-
-- ``batch_bucket_key(count)`` → :class:`BucketKey` or None (unbatchable);
-- ``batch_prepare(count)`` → host-side encode + RNG draws, one item dict;
-- ``batch_execute(items, pad_to)`` → the vmapped device programs, one
-  output dict per item;
-- ``batch_finalize(item, output)`` → host-side decode + state writeback.
+Batchable designers implement ONE :class:`~vizier_tpu.compute.ir.
+DesignerProgram` (bucket_key / prepare / device_program / finalize),
+registered in :mod:`vizier_tpu.compute.registry`; the executor resolves a
+designer's program there and consumes it generically — the same registry
+feeds the prewarm walker, chaos slot-isolation wrappers, the
+``vizier_jax_phase_seconds`` device phases, and the speculative lane.
+Designers carrying only the legacy duck-typed ``batch_*`` methods (test
+stubs, out-of-tree extensions) resolve to an adapter; anything else runs
+sequentially.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import math
 import threading
 import time
-from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from vizier_tpu.compute import ir as compute_ir
+from vizier_tpu.compute import registry as compute_registry
 from vizier_tpu.observability import metrics as metrics_lib
 from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.reliability import errors as errors_lib
 
-
-@dataclasses.dataclass(frozen=True)
-class BucketKey:
-    """Identity of one shape bucket: equal keys ⇒ batchable together.
-
-    ``statics`` carries the hashable jit-static objects (model, optimizers,
-    acquisition config, restart budget, …) so two studies share a bucket
-    exactly when they would share every compiled program — shape AND
-    configuration.
-    """
-
-    kind: str  # designer family, e.g. "gp_bandit" | "gp_ucb_pe"
-    pad_trials: int
-    cont_width: int
-    cat_width: int
-    metric_count: int
-    count: int  # suggestions per study (a jit-static of the sweep)
-    statics: Tuple[Hashable, ...] = ()
-
-    def label(self) -> str:
-        """Low-cardinality metrics/tracing label (one per shape bucket)."""
-        return (
-            f"{self.kind}/t{self.pad_trials}/f{self.cont_width}"
-            f"x{self.cat_width}/m{self.metric_count}/q{self.count}"
-        )
+# Canonical home is the compute IR (vizier_tpu.compute.ir.BucketKey);
+# re-exported here for the executor's existing import surface.
+BucketKey = compute_ir.BucketKey
 
 
 class BatchSlotError(errors_lib.TransientError):
@@ -104,15 +84,16 @@ class _Slot:
     """
 
     __slots__ = (
-        "designer", "count", "enqueued_at", "event", "error",
+        "designer", "program", "count", "enqueued_at", "event", "error",
         "item", "output", "action", "span", "speculative",
     )
 
     def __init__(
-        self, designer: Any, count: int, now: float, span,
+        self, designer: Any, program: Any, count: int, now: float, span,
         speculative: bool = False,
     ) -> None:
         self.designer = designer
+        self.program = program  # the resolved compute-IR DesignerProgram
         self.count = count
         self.enqueued_at = now
         self.event = threading.Event()
@@ -246,20 +227,20 @@ class BatchExecutor:
     ) -> List[Any]:
         """Routes one study's suggest through the batching engine.
 
-        Unbatchable paths (designer without the protocol, seeding stage,
-        multi-objective, priors, …) run inline on the caller's thread —
-        identical to batching off. ``speculative`` marks the slot for the
-        low-priority lane: it never makes a bucket flush while live slots
-        are queued (see :meth:`_take_due`).
+        Unbatchable paths (no resolvable compute-IR program, seeding
+        stage, multi-objective, priors, …) run inline on the caller's
+        thread — identical to batching off. ``speculative`` marks the slot
+        for the low-priority lane: it never makes a bucket flush while
+        live slots are queued (see :meth:`_take_due`).
         """
         count = count or 1
-        key_fn = getattr(designer, "batch_bucket_key", None)
-        key = key_fn(count) if key_fn is not None else None
-        if key is None or self._closed:
+        resolved = compute_registry.resolve(designer, count)
+        if resolved is None or self._closed:
             return designer.suggest(count)
+        program, key = resolved
         tracer = tracing_lib.get_tracer()
         slot = _Slot(
-            designer, count, self._time(), tracer.current_span(),
+            designer, program, count, self._time(), tracer.current_span(),
             speculative=speculative,
         )
         # Joining a non-empty bucket ⇒ this slot will (very likely) ride a
@@ -274,7 +255,7 @@ class BatchExecutor:
             will_batch = bool(self._queues.get(key))
         if will_batch:
             try:
-                slot.item = designer.batch_prepare(count)
+                slot.item = program.prepare(designer, count)
             except BaseException:
                 self._increment("batch_slot_errors")
                 raise
@@ -296,7 +277,7 @@ class BatchExecutor:
         if slot.action == "batched":
             try:
                 suggestions = list(
-                    slot.designer.batch_finalize(slot.item, slot.output)
+                    slot.program.finalize(slot.designer, slot.item, slot.output)
                 )
                 check_finite_suggestions(suggestions)
             except BaseException:
@@ -501,7 +482,7 @@ class BatchExecutor:
         for slot in slots:
             if slot.item is None:
                 try:
-                    slot.item = slot.designer.batch_prepare(slot.count)
+                    slot.item = slot.program.prepare(slot.designer, slot.count)
                 except BaseException as e:
                     slot.error = e
                     self._increment("batch_slot_errors")
@@ -515,7 +496,12 @@ class BatchExecutor:
         # keeps the compiled shape identical either way.
         pad_to = self.max_batch_size if self.pad_partial else None
         try:
-            outputs = live[0].designer.batch_execute(
+            # Slot 0's resolved program runs the bucket's device body (the
+            # bucket key guarantees every slot resolves the same kind; a
+            # chaos-wrapped slot 0 therefore poisons the shared program,
+            # exercising the whole-batch fallback — the IR-level twin of
+            # the old designer.batch_execute dispatch).
+            outputs = live[0].program.device_program(
                 [slot.item for slot in live], pad_to=pad_to
             )
         except BaseException:
@@ -593,21 +579,36 @@ class BatchExecutor:
                         if size == 1:
                             designers[0].suggest(count)
                         else:
-                            # Same calling convention as suggest() above: the
-                            # bucket key refreshes per-designer mode state
-                            # (e.g. the exact↔sparse surrogate auto-switch)
-                            # that batch_prepare snapshots into its item.
-                            for d in designers:
-                                d.batch_bucket_key(count)
-                            items = [d.batch_prepare(count) for d in designers]
-                            pad_to = (
-                                self.max_batch_size if self.pad_partial else None
-                            )
-                            outputs = designers[0].batch_execute(
-                                items, pad_to=pad_to
-                            )
-                            for d, item, out in zip(designers, items, outputs):
-                                d.batch_finalize(item, out)
+                            # Same calling convention as suggest() above:
+                            # registry resolution refreshes per-designer
+                            # mode state (e.g. the exact↔sparse surrogate
+                            # auto-switch) that prepare snapshots into its
+                            # item, and hands back the program whose
+                            # device body this bucket compiles.
+                            resolved = [
+                                compute_registry.resolve(d, count)
+                                for d in designers
+                            ]
+                            if any(r is None for r in resolved):
+                                designers[0].suggest(count)
+                            else:
+                                program = resolved[0][0]
+                                items = [
+                                    program.prepare(d, count)
+                                    for d in designers
+                                ]
+                                pad_to = (
+                                    self.max_batch_size
+                                    if self.pad_partial
+                                    else None
+                                )
+                                outputs = program.device_program(
+                                    items, pad_to=pad_to
+                                )
+                                for d, item, out in zip(
+                                    designers, items, outputs
+                                ):
+                                    program.finalize(d, item, out)
                     except Exception as e:  # prewarm must never block serving
                         status = f"error:{type(e).__name__}"
                     report.append(

@@ -110,24 +110,41 @@ class PythiaServicer:
 
         Walks the padding-bucket grid at batch sizes {1, max}: a server
         prewarmed for its expected study shapes pays no XLA compile on the
-        first real request. Returns the per-bucket compile report (empty
-        when batching is off or the algorithm has no batched path).
+        first real request. The designer factory comes from the compute-IR
+        program registry (``vizier_tpu.compute.registry``): every
+        registered program claiming ``algorithm`` contributes its
+        ``prewarm_factory``, so a new DesignerProgram joins the prewarm
+        walk by registering — no servicer edit. Returns the per-bucket
+        compile report (empty when batching is off or no registered
+        program covers the algorithm).
         """
-        from vizier_tpu.designers import gp_bandit, gp_ucb_pe
+        from vizier_tpu.compute import registry as compute_registry
 
         problem = study_config.to_problem()
         kwargs_fn = getattr(self._policy_factory, "_gp_designer_kwargs", None)
         kwargs = kwargs_fn() if kwargs_fn is not None else {}
-        algorithm = (algorithm or "DEFAULT").upper()
-        if algorithm in ("DEFAULT", "GP_UCB_PE", "ALGORITHM_UNSPECIFIED"):
-            factory = lambda p: gp_ucb_pe.VizierGPUCBPEBandit(p, **kwargs)
-        elif algorithm == "GAUSSIAN_PROCESS_BANDIT":
-            factory = lambda p: gp_bandit.VizierGPBandit(p, **kwargs)
-        else:
-            return []
-        return self._serving.prewarm_batching(
-            problem, factory, counts=counts, max_trials=max_trials
-        )
+        programs = compute_registry.programs_for_algorithm(algorithm or "DEFAULT")
+        report = []
+        seen_factories = set()
+        for program in programs:
+            # Same-designer programs (e.g. exact + sparse families) share
+            # one walk: the factory's auto-switch decides which program
+            # each synthetic bucket compiles, exactly like live studies.
+            factory_key = type(program.prewarm_factory(problem, **kwargs))
+            if factory_key in seen_factories:
+                continue
+            seen_factories.add(factory_key)
+            report.extend(
+                self._serving.prewarm_batching(
+                    problem,
+                    lambda p, _program=program: _program.prewarm_factory(
+                        p, **kwargs
+                    ),
+                    counts=counts,
+                    max_trials=max_trials,
+                )
+            )
+        return report
 
     def shutdown(self) -> None:
         """Drains the serving runtime's batch executor (idempotent)."""

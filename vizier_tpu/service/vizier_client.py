@@ -8,6 +8,7 @@ reference's in-process/stub Union trick, ``types.py:24-33``).
 
 from __future__ import annotations
 
+import atexit
 import dataclasses
 import time
 from typing import Any, Dict, List, Optional, Union
@@ -60,6 +61,13 @@ def _get_local_servicer():
         pythia = pythia_service.PythiaServicer(servicer)
         servicer.set_pythia(pythia)
         _local_servicer = servicer
+        # The serving runtime's background threads (speculative workers,
+        # prewarm compiles, the batch-executor scheduler) must be joined
+        # before interpreter teardown — an XLA compile aborted mid-flight
+        # SIGABRTs the process. Explicit servers shut down through their
+        # own lifecycle; the implicit in-process service gets an atexit
+        # hook (shutdown is idempotent).
+        atexit.register(pythia.shutdown)
     return _local_servicer
 
 

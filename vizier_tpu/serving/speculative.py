@@ -128,6 +128,19 @@ class SpeculativeConfig:
     # Count speculated for a study before its first live suggest reveals
     # the client's real batch size.
     default_count: int = 1
+    # Distinct recent request counts remembered per study. A job
+    # speculates the LARGEST of them: smaller requests serve a prefix of
+    # the parked batch (the serve path already reconciles down), so a
+    # client alternating suggest(1)/suggest(5) hits on both — under the
+    # old last-seen-only policy every larger-count request was a
+    # guaranteed miss (ROADMAP PR 8 residual).
+    count_memory: int = 4
+    # Trigger debounce for high-completion-rate studies: a completion
+    # burst (parallel workers reporting back-to-back) coalesces into ONE
+    # pre-compute once the study has been quiet this long, instead of
+    # starting-and-superseding a job per completion. 0 = immediate (the
+    # PR 8 behavior).
+    debounce_ms: float = 0.0
 
     def __post_init__(self):
         if self.workers < 1:
@@ -141,6 +154,14 @@ class SpeculativeConfig:
             raise ValueError(
                 f"default_count must be >= 1, got {self.default_count}."
             )
+        if self.count_memory < 1:
+            raise ValueError(
+                f"count_memory must be >= 1, got {self.count_memory}."
+            )
+        if self.debounce_ms < 0:
+            raise ValueError(
+                f"debounce_ms must be >= 0, got {self.debounce_ms}."
+            )
 
     @classmethod
     def from_env(cls) -> "SpeculativeConfig":
@@ -152,6 +173,12 @@ class SpeculativeConfig:
                 "VIZIER_SPECULATIVE_MAX_AGE_S", 300.0
             ),
             speculate_on_fill=_registry.env_set("VIZIER_SPECULATIVE_ON_FILL"),
+            count_memory=_registry.env_int(
+                "VIZIER_SPECULATIVE_COUNT_MEMORY", 4
+            ),
+            debounce_ms=_registry.env_float(
+                "VIZIER_SPECULATIVE_DEBOUNCE_MS", 0.0
+            ),
         )
 
     @classmethod
@@ -166,6 +193,8 @@ class SpeculativeConfig:
             "workers": self.workers,
             "max_speculation_age_s": self.max_speculation_age_s,
             "speculate_on_fill": self.speculate_on_fill,
+            "count_memory": self.count_memory,
+            "debounce_ms": self.debounce_ms,
         }
 
 
@@ -217,7 +246,7 @@ class SpeculativeSlot:
 class _Job:
     """One queued speculative pre-compute for a study."""
 
-    __slots__ = ("study_name", "epoch", "trigger_ctx", "reason")
+    __slots__ = ("study_name", "epoch", "trigger_ctx", "reason", "not_before")
 
     def __init__(
         self,
@@ -225,11 +254,16 @@ class _Job:
         epoch: int,
         trigger_ctx: Optional[tracing_lib.SpanContext],
         reason: str,
+        not_before: float = 0.0,
     ):
         self.study_name = study_name
         self.epoch = epoch
         self.trigger_ctx = trigger_ctx
         self.reason = reason
+        # Engine-clock debounce deadline: a worker leaves the job queued
+        # until this time, so a completion burst supersedes in place and
+        # costs one compute instead of one per completion.
+        self.not_before = not_before
 
 
 class SpeculativeEngine:
@@ -279,7 +313,9 @@ class SpeculativeEngine:
             collections.OrderedDict()
         )
         self._epochs: Dict[str, int] = {}
-        self._counts: Dict[str, int] = {}
+        # study -> OrderedDict of its last count_memory DISTINCT request
+        # counts (insertion order = recency; values unused).
+        self._counts: Dict[str, "collections.OrderedDict"] = {}
         self._inflight: set = set()
         self._closed = False
         self._threads: List[threading.Thread] = []
@@ -333,11 +369,22 @@ class SpeculativeEngine:
         return self._enqueue(study_name, reason="fill")
 
     def note_live_suggest(self, study_name: str, count: int) -> None:
-        """Records the client's real batch size for future speculations."""
+        """Records the client's batch size in the study's recent-count set.
+
+        The last ``count_memory`` DISTINCT counts are kept; jobs speculate
+        the largest of them (smaller requests serve a batch prefix), so a
+        workload mixing batch sizes no longer misses on the bigger ones.
+        """
         if count < 1:
             return
         with self._cond:
-            self._counts[study_name] = count
+            counts = self._counts.setdefault(
+                study_name, collections.OrderedDict()
+            )
+            counts[count] = None
+            counts.move_to_end(count)
+            while len(counts) > self.config.count_memory:
+                counts.popitem(last=False)
 
     def invalidate(self, study_name: str, reason: str = "") -> None:
         """Drops the parked slot and supersedes any queued/in-flight job
@@ -371,7 +418,13 @@ class SpeculativeEngine:
             epoch = self._epochs.get(study_name, 0) + 1
             self._epochs[study_name] = epoch
             superseded = study_name in self._jobs
-            self._jobs[study_name] = _Job(study_name, epoch, trigger_ctx, reason)
+            self._jobs[study_name] = _Job(
+                study_name,
+                epoch,
+                trigger_ctx,
+                reason,
+                not_before=self._time() + self.config.debounce_ms / 1000.0,
+            )
             self._jobs.move_to_end(study_name)
             self._ensure_workers()
             self._cond.notify_all()
@@ -442,14 +495,32 @@ class SpeculativeEngine:
             self._threads.append(thread)
             thread.start()
 
+    def _pop_due_job_locked(self):
+        """(job, wait): the first debounce-expired job (popped), or the
+        seconds until the earliest becomes due (None = queue empty).
+        Caller holds ``_cond``."""
+        if not self._jobs:
+            return None, None
+        now = self._time()
+        earliest: Optional[float] = None
+        for name, job in self._jobs.items():
+            if job.not_before <= now:
+                return self._jobs.pop(name), None
+            wait = job.not_before - now
+            earliest = wait if earliest is None else min(earliest, wait)
+        return None, earliest
+
     def _worker_loop(self) -> None:
         while True:
             with self._cond:
-                while not self._jobs and not self._closed:
-                    self._cond.wait()
-                if self._closed:
-                    return
-                study_name, job = self._jobs.popitem(last=False)
+                while True:
+                    if self._closed:
+                        return
+                    job, wait = self._pop_due_job_locked()
+                    if job is not None:
+                        break
+                    self._cond.wait(timeout=wait)
+                study_name = job.study_name
                 self._inflight.add(study_name)
             try:
                 self._run_job(job)
@@ -514,7 +585,11 @@ class SpeculativeEngine:
             if span is not None and job.trigger_ctx is not None:
                 span.add_link(job.trigger_ctx, name="trigger")
             with self._cond:
-                count = self._counts.get(study, self.config.default_count)
+                recent = self._counts.get(study)
+                # The largest recent count covers every smaller request as
+                # a served prefix; only a count above every recent one
+                # still falls through to live compute.
+                count = max(recent) if recent else self.config.default_count
             outcome = self._compute_and_park(job, count)
             if span is not None:
                 span.set_attribute("outcome", outcome)

@@ -88,6 +88,13 @@ class SurrogateConfig:
     # as trial counts (``padding.trial_bucket_grid``) so every (n-bucket,
     # m-bucket) pair is one compiled program.
     num_inducing: int = 128
+    # Extend the auto-switch to the GP-UCB-PE designer (the service
+    # DEFAULT): above the threshold its greedy batch conditions on pending
+    # picks through the inducing-point posterior (Nyström-augmented)
+    # instead of the exact GP's O(n³) per-pick re-factorization. False
+    # pins UCB-PE studies exact regardless of size (the pre-PR-9
+    # behavior); single-objective independent-GP studies only either way.
+    sparse_ucb_pe: bool = True
 
     def __post_init__(self):
         if self.sparse_threshold_trials < 1:
@@ -114,6 +121,7 @@ class SurrogateConfig:
             ),
             hysteresis_trials=_registry.env_int("VIZIER_SPARSE_HYSTERESIS", 64),
             num_inducing=_registry.env_int("VIZIER_SPARSE_INDUCING", 128),
+            sparse_ucb_pe=_registry.env_on("VIZIER_SPARSE_UCB_PE"),
         )
 
     @classmethod
@@ -142,4 +150,5 @@ class SurrogateConfig:
             "sparse_threshold_trials": self.sparse_threshold_trials,
             "hysteresis_trials": self.hysteresis_trials,
             "num_inducing": self.num_inducing,
+            "sparse_ucb_pe": self.sparse_ucb_pe,
         }

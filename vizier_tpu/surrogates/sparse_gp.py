@@ -119,6 +119,52 @@ def select_inducing_kcenter(data: gp_lib.GPData, m: int) -> SparseGPData:
     )
 
 
+def with_pending_capacity(
+    sdata: SparseGPData, data: gp_lib.GPData, extra: int
+) -> SparseGPData:
+    """An all-points twin of a trained posterior's inducing set.
+
+    Carries the SAME inducing rows Z over a different data block (the
+    completed+active rows with spare slots for a batch's picks), plus
+    ``extra`` masked-off spare inducing slots that per-pick conditioning
+    may Nyström-fill (``gp_ucb_pe._append_row_sparse``) when a pick lands
+    where Z has no support. Traceable fixed shapes: one compiled program
+    per (n-bucket, m-bucket, extra) triple.
+    """
+    z_cont = jnp.concatenate(
+        [
+            sdata.z_continuous,
+            jnp.zeros(
+                (extra, sdata.z_continuous.shape[-1]), sdata.z_continuous.dtype
+            ),
+        ],
+        axis=0,
+    )
+    z_cat = jnp.concatenate(
+        [
+            sdata.z_categorical,
+            jnp.zeros(
+                (extra, sdata.z_categorical.shape[-1]),
+                sdata.z_categorical.dtype,
+            ),
+        ],
+        axis=0,
+    )
+    mask = jnp.concatenate(
+        [sdata.inducing_mask, jnp.zeros((extra,), bool)], axis=0
+    )
+    indices = jnp.concatenate(
+        [sdata.inducing_indices, jnp.zeros((extra,), jnp.int32)], axis=0
+    )
+    return SparseGPData(
+        data=data,
+        z_continuous=z_cont,
+        z_categorical=z_cat,
+        inducing_mask=mask,
+        inducing_indices=indices,
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class SparseGaussianProcess:
     """Static sparse-model config + pure functions over (params, data).
@@ -220,7 +266,20 @@ class SparseGaussianProcess:
 
     def precompute(self, unconstrained: Params, sdata: SparseGPData) -> "SparseGPState":
         """Factorize once; posterior queries are then matmul-only O(m²)."""
-        p = self.param_collection().constrain(unconstrained)
+        return self.precompute_constrained(
+            self.param_collection().constrain(unconstrained), sdata
+        )
+
+    def precompute_constrained(self, p: Params, sdata: SparseGPData) -> "SparseGPState":
+        """Factorization from already-constrained params.
+
+        The UCB-PE pending-pick re-conditioning path: per pick, the greedy
+        batch loop overrides the constrained noise floor and rebuilds the
+        posterior over the grown pending set — O(n·m²) per pick, the
+        inducing-point replacement for the exact path's O(n³) per-pick
+        Cholesky (duck-type parity with
+        ``VizierGaussianProcess.precompute_constrained``).
+        """
         chol, chol_b, _, c, _ = self._factorize(p, sdata)
         eye = jnp.eye(chol.shape[0], dtype=chol.dtype)
         linv = jax.scipy.linalg.solve_triangular(chol, eye, lower=True)

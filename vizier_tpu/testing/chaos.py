@@ -130,12 +130,26 @@ class ChaosDesigner(core_lib.Designer):
             raise failing.FailedSuggestError(str(e)) from None
         return list(self._inner.suggest(count))
 
-    # -- cross-study batch protocol (vizier_tpu.parallel.batch_executor) ----
-    # Chaos-wrapped designers stay batchable: the executor's fail-isolation
-    # contract (one faulting slot degrades only its own study) is exercised
-    # by striking in the per-slot host-side hooks. A strike in
-    # ``batch_execute`` poisons the shared device program, driving the
-    # whole-batch sequential-fallback path instead.
+    # -- cross-study batch protocol (vizier_tpu.compute IR) -----------------
+    # Chaos-wrapped designers stay batchable: ``compute_program`` resolves
+    # the inner designer's registered DesignerProgram and wraps it in
+    # :class:`ChaosProgram`, so fault injection rides the IR generically —
+    # every registered program family (exact, sparse, UCB-PE, future
+    # designers) inherits slot-isolation chaos without per-designer method
+    # copies. A strike in the per-slot host-side hooks (prepare/finalize)
+    # degrades only that study; a strike in ``device_program`` poisons the
+    # shared device body, driving the whole-batch sequential fallback.
+
+    def compute_program(self, count: Optional[int] = None):
+        from vizier_tpu.compute import registry as compute_registry
+
+        resolved = compute_registry.resolve(self._inner, count)
+        if resolved is None:
+            return None
+        program, key = resolved
+        return ChaosProgram(program, self), key
+
+    # Legacy duck-typed surface (direct callers and tests).
 
     def batch_bucket_key(self, count: Optional[int] = None):
         key_fn = getattr(self._inner, "batch_bucket_key", None)
@@ -158,6 +172,46 @@ class ChaosDesigner(core_lib.Designer):
         except InjectedFaultError as e:
             raise failing.FailedSuggestError(str(e)) from None
         return self._inner.batch_finalize(item, output)
+
+
+class ChaosProgram:
+    """Fault-injecting wrapper over any compute-IR ``DesignerProgram``.
+
+    The generic chaos slot-isolation hook the compute-IR conformance pass
+    requires: wrapping happens at program resolution
+    (``ChaosDesigner.compute_program``), so every registered program —
+    exact, sparse, UCB-PE, future designers — is chaos-testable through
+    one seam. The host-side hooks route through the bound chaos designer's
+    striking ``batch_*`` methods (so per-test instance patches keep
+    working): a per-slot strike raises designer-shaped
+    ``FailedSuggestError`` and degrades only that study; a
+    ``device_program`` strike poisons the shared device body, driving the
+    executor's whole-batch sequential fallback.
+    """
+
+    def __init__(self, inner, chaos_designer: ChaosDesigner):
+        self._inner = inner
+        self._designer = chaos_designer
+        self.kind = inner.kind
+        self.device_phase = inner.device_phase
+        self.surrogate_family = inner.surrogate_family
+
+    def bucket_key(self, designer, count):
+        return self._inner.bucket_key(
+            getattr(designer, "_inner", designer), count
+        )
+
+    def prepare(self, designer, count):
+        return designer.batch_prepare(count)
+
+    def device_program(self, items, pad_to: Optional[int] = None):
+        return self._designer.batch_execute(items, pad_to=pad_to)
+
+    def finalize(self, designer, item, output):
+        return designer.batch_finalize(item, output)
+
+    def prewarm_factory(self, problem, **kwargs):
+        return self._inner.prewarm_factory(problem, **kwargs)
 
 
 def chaos_designer_factory(
