@@ -121,6 +121,15 @@ def _registered_programs() -> list:
     return list(compute_registry.kinds())
 
 
+def _mesh_env_config() -> dict:
+    """The process-wide VIZIER_MESH* config, for artifact provenance."""
+    import dataclasses
+
+    from vizier_tpu.parallel.mesh import MeshConfig
+
+    return dataclasses.asdict(MeshConfig.from_env())
+
+
 def main() -> None:
     backend_tag = None
     platforms = os.environ.get("JAX_PLATFORMS", "")
@@ -385,6 +394,16 @@ def main() -> None:
         "speculative": {
             "active": False,
             **_speculative_env_config(),
+        },
+        # Mesh execution plane (parallel.mesh / VIZIER_MESH*): bench
+        # drives designers directly (no batch executor), so no flush here
+        # is mesh-dispatched — the env config plus the visible device
+        # count ride along so artifacts from mesh-enabled processes are
+        # distinguishable (tools/batching_ab.py --devices measures it).
+        "mesh": {
+            "active": False,
+            "visible_devices": jax.device_count(),
+            **_mesh_env_config(),
         },
         # The compute-IR program set this build registers (vizier_tpu.
         # compute.registry): artifacts from trees with more/fewer batched

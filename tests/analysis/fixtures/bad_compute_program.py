@@ -14,7 +14,8 @@ class _FixtureDesigner:
 
 class IncompleteProgram:
     """Registered but nonconforming: no finalize, no prewarm_factory, no
-    device_phase — the pass must flag each gap separately."""
+    device_phase, no shardable_batch_axis — the pass must flag each gap
+    separately."""
 
     kind = "fixture_incomplete"
 

@@ -34,6 +34,13 @@ class TestSeededFixtures:
         keys = {f.key for f in _result(fixtures_project, repo_root).findings}
         assert "program-missing-device-phase:IncompleteProgram" in keys
 
+    def test_missing_shard_axis_flagged(self, fixtures_project, repo_root):
+        # No literal shardable_batch_axis declaration: the mesh execution
+        # plane requires every registered program to state whether its
+        # device_program may shard over a placement.
+        keys = {f.key for f in _result(fixtures_project, repo_root).findings}
+        assert "program-missing-shard-axis:IncompleteProgram" in keys
+
     def test_unregistered_fixture_kind_needs_chaos_coverage(
         self, fixtures_project, repo_root
     ):

@@ -384,3 +384,63 @@ class TestBatchedProgramJitStability:
 
         flush((4, 5), n=9)  # bucket boundary: exactly one new entry
         assert program._cache_size() == size + 1
+
+
+class TestMeshJitStability:
+    """Mesh-mode compile contract: one compiled flush program per (bucket,
+    placement, shard-granularity grid step).
+
+    The mesh executor pads a placement's flushes to
+    ``DevicePlacement.pad_to`` (power-of-two multiples of its device
+    count) instead of the flat pad-to-max — so the compiled-shape set per
+    (bucket, placement) is exactly the small ``pad_grid``, stable at fixed
+    occupancy, +1 when the occupancy crosses a grid step, and +1 when the
+    SAME bucket compiles on a different placement (sticky assignment makes
+    that a prewarm-only event in production)."""
+
+    def test_one_program_per_bucket_placement_grid_step(self):
+        import jax
+
+        from vizier_tpu.compute import registry as compute_registry
+        from vizier_tpu.parallel.mesh import DevicePlacement
+
+        def fresh(seed, n):
+            d = gp_bandit_lib.VizierGPBandit(_problem(), rng_seed=seed, **_FAST)
+            d.update(core_lib.CompletedTrials(_trials(1, n, seed=seed)))
+            return d
+
+        # count=3 keeps this test's compiled programs disjoint from the
+        # count=1/2 flushes other tests in this file drive.
+        def flush(seeds, placement):
+            designers = [fresh(s, 4) for s in seeds]
+            resolved = [compute_registry.resolve(d, 3) for d in designers]
+            assert all(r is not None for r in resolved)
+            program = resolved[0][0]
+            assert program.shardable_batch_axis == "study"
+            items = [program.prepare(d, 3) for d in designers]
+            pad_to = placement.pad_to(len(items), 8)
+            outs = program.device_program(
+                items, pad_to=pad_to, placement=placement
+            )
+            for d, i, o in zip(designers, items, outs):
+                program.finalize(d, i, o)
+
+        body = gp_bandit_lib._gp_bandit_flush_program
+        devices = jax.devices()
+        p0 = DevicePlacement(0, devices[:1])
+        p1 = DevicePlacement(1, devices[1:2])
+
+        flush((70, 71), p0)  # occupancy 2 -> padded 2 on placement 0
+        size = body._cache_size()
+        flush((72, 73), p0)  # same (bucket, placement, grid step): stable
+        assert body._cache_size() == size
+        flush((74, 75, 76), p0)  # occupancy 3 -> grid step 4: one new entry
+        assert body._cache_size() == size + 1
+        flush((77, 78, 79, 80), p0)  # occupancy 4 -> same grid step: stable
+        assert body._cache_size() == size + 1
+        # The same bucket on a DIFFERENT placement compiles its own
+        # program (sticky assignment keeps this out of the serving path).
+        flush((81, 82), p1)
+        assert body._cache_size() == size + 2
+        flush((83, 84), p1)  # and stays stable there too
+        assert body._cache_size() == size + 2

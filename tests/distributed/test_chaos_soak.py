@@ -1,12 +1,15 @@
 """The long chaos soak: failover A/B + runtime lock-order cross-check.
 
-Runs ``tools/chaos_ab.py --distributed --instrument-locks`` end to end —
-the seeded fault schedule against the sharded tier, the owning replica
-killed mid-study, every ``threading`` lock instrumented — and asserts the
-full verdict: all trials complete via router failover AND every observed
-lock-acquisition edge (now including the router/WAL locks) was predicted
-by the static lock_order graph. ``slow``-marked so tier-1 stays fast; the
-soak runs in CI and via ``tools/reproduce_evidence.sh``.
+Runs ``tools/chaos_ab.py --distributed --mesh-devices --instrument-locks``
+end to end — the seeded fault schedule against the sharded tier, the
+owning replica killed mid-study, the mesh-sharded batch executor struck on
+one placement, every ``threading`` lock instrumented — and asserts the
+full verdict: all trials complete via router failover, the mesh strike
+stays isolated to its placement's flush, AND every observed
+lock-acquisition edge (router/WAL locks plus the per-placement mesh
+dispatch workers) was predicted by the static lock_order graph.
+``slow``-marked so tier-1 stays fast; the soak runs in CI and via
+``tools/reproduce_evidence.sh``.
 """
 
 import json
@@ -30,6 +33,7 @@ def test_chaos_soak_failover_with_lock_crosscheck(tmp_path):
             str(REPO_ROOT / "tools" / "chaos_ab.py"),
             "--trials", "50",
             "--distributed", "4",
+            "--mesh-devices", "8",
             "--instrument-locks",
             "--out", str(out),
         ],
@@ -53,7 +57,13 @@ def test_chaos_soak_failover_with_lock_crosscheck(tmp_path):
     dist = report["arms"]["distributed_failover"]
     assert dist["killed_replica"] is not None
     assert dist["owner_after_failover"] != dist["killed_replica"]
-    # Lock-order cross-check: observed runtime edges ⊆ static graph.
+    # Mesh arm: every suggest accounted (served or isolated designer
+    # error), the struck placement's executor still lives afterwards.
+    assert verdict["mesh_all_accounted"]
+    assert verdict["mesh_post_soak_liveness"]
+    assert report["arms"]["mesh_executor"]["mesh_flushes"] >= 1
+    # Lock-order cross-check: observed runtime edges ⊆ static graph —
+    # the instrumented run includes the vizier-mesh-worker-* threads.
     assert verdict["lock_order_confirmed"]
     assert report["lock_check"]["missing_from_static_graph"] == []
     assert report["lock_check"]["acquisitions"] > 0

@@ -144,3 +144,67 @@ class TestSpeculativeActivity:
         )
         assert act["hit"] == act["miss"] == act["precomputes"] == 0
         assert act["hit_rate"] == 0.0
+
+
+class TestDeviceActivity:
+    def _flush_span(self, device=None, occupancy=2, duration=0.01):
+        attrs = {"bucket": "gp_ucb_pe/t16/f4x0/m1/q1", "occupancy": occupancy}
+        if device is not None:
+            attrs["device"] = device
+        return {
+            "name": "batch_executor.flush",
+            "duration_secs": duration,
+            "attributes": attrs,
+        }
+
+    def test_per_device_breakdown(self):
+        spans = [
+            self._flush_span("mesh0", occupancy=2, duration=0.010),
+            self._flush_span("mesh0", occupancy=4, duration=0.030),
+            self._flush_span("mesh1", occupancy=1, duration=0.020),
+            {"name": "pythia.suggest", "duration_secs": 0.5},
+        ]
+        out = obs_report.device_activity(spans)
+        assert set(out) == {"mesh0", "mesh1"}
+        assert out["mesh0"]["flushes"] == 2
+        assert out["mesh0"]["busy_ms"] == 40.0
+        assert out["mesh0"]["mean_occupancy"] == 3.0
+        assert out["mesh1"]["flushes"] == 1
+
+    def test_single_device_run_is_empty(self):
+        # VIZIER_MESH=0 stamps no device attribute -> no breakdown rows.
+        spans = [self._flush_span(device=None) for _ in range(3)]
+        assert obs_report.device_activity(spans) == {}
+
+    def test_live_mesh_flush_spans_carry_device(self, tmp_path):
+        # End-to-end: a real mesh-executor flush emits a device-attributed
+        # span the report rolls up.
+        from vizier_tpu.parallel.batch_executor import BatchExecutor
+        from vizier_tpu.parallel.mesh import MeshConfig
+        from tests.parallel.test_batch_executor import (
+            StubDesigner,
+            _run_concurrent,
+        )
+
+        tracer = tracing_lib.Tracer()
+        previous = tracing_lib.set_tracer(tracer)
+        try:
+            ex = BatchExecutor(
+                max_batch_size=4,
+                max_wait_ms=5.0,
+                mesh=MeshConfig(enabled=True, shard_devices=1),
+            )
+            try:
+                results, errors = _run_concurrent(
+                    ex, [StubDesigner(i) for i in range(3)]
+                )
+                assert all(e is None for e in errors)
+            finally:
+                ex.close()
+            path = tmp_path / "mesh_spans.jsonl"
+            tracer.dump_jsonl(str(path))
+        finally:
+            tracing_lib.set_tracer(previous)
+        out = obs_report.device_activity(obs_report.load_spans(str(path)))
+        assert out, "no device-attributed flush spans recorded"
+        assert all(device.startswith("mesh") for device in out)

@@ -20,7 +20,27 @@ Evidence lands in ``BATCHING_AB.json``: per-suggest latency p50/p95/p99,
 suggestions/sec, mean batch occupancy, and the speedup ratio. Acceptance:
 >= 2x throughput at 8 concurrent same-bucket studies.
 
+**Mesh arm** (``--devices N``): the multi-BUCKET shape the single-device
+executor is worst at. ``--buckets B`` study groups with distinct shape
+buckets (distinct acquisition budgets -> distinct jit statics), each group
+``--studies-per-bucket`` studies, all driven concurrently. Both arms run
+the identical workload through a BatchExecutor; they differ only in the
+execution plane:
+
+- **single_device** — the seed executor: one scheduler thread executes
+  every flush on one device, each partial flush padded to
+  ``max_batch_size``;
+- **mesh** — ``parallel.mesh``: N devices carved into placements
+  (``--shard-devices`` per submesh), buckets sticky-assigned across them,
+  per-placement workers dispatching concurrently, flushes padded at shard
+  granularity.
+
+Evidence lands in ``MESH_AB.json``. Acceptance: >= 2x aggregate flush
+throughput at 8 simulated devices with >= 8 concurrent buckets, plus the
+``VIZIER_MESH=0`` bit-identity check against the seed executor.
+
 Usage:  python tools/batching_ab.py [--studies 8] [--rounds 6] [--out BATCHING_AB.json]
+        python tools/batching_ab.py --devices 8 [--buckets 8] [--studies-per-bucket 2]
 """
 
 from __future__ import annotations
@@ -36,6 +56,27 @@ import time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("VIZIER_DISABLE_MESH", "1")
 
+
+def _peek_int_flag(name: str, default: int) -> int:
+    """Reads an int flag from argv BEFORE heavyweight imports (the mesh arm
+    must set --xla_force_host_platform_device_count before jax's backend
+    initializes)."""
+    for i, arg in enumerate(sys.argv):
+        if arg == name and i + 1 < len(sys.argv):
+            return int(sys.argv[i + 1])
+        if arg.startswith(name + "="):
+            return int(arg.split("=", 1)[1])
+    return default
+
+
+_DEVICES = _peek_int_flag("--devices", 0)
+if _DEVICES:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={_DEVICES}"
+        ).strip()
+
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from vizier_tpu import pyvizier as vz  # noqa: E402
@@ -43,6 +84,7 @@ from vizier_tpu.algorithms import core as core_lib  # noqa: E402
 from vizier_tpu.designers import gp_ucb_pe  # noqa: E402
 from vizier_tpu.optimizers import lbfgs as lbfgs_lib  # noqa: E402
 from vizier_tpu.parallel.batch_executor import BatchExecutor  # noqa: E402
+from vizier_tpu.parallel.mesh import MeshConfig  # noqa: E402
 from vizier_tpu.serving.stats import ServingStats  # noqa: E402
 
 
@@ -191,6 +233,259 @@ def _run_arm(
     }
 
 
+def _make_pool(problem, buckets, studies_per_bucket, designer_kwargs_for):
+    """One study pool: ``buckets`` groups with distinct shape buckets."""
+    pool = []
+    for b in range(buckets):
+        for c in range(studies_per_bucket):
+            pool.append(
+                _Study(
+                    problem,
+                    seed=b * 100 + c + 1,
+                    designer_kwargs=designer_kwargs_for(b),
+                )
+            )
+    return pool
+
+
+def _distinct_buckets(problem, buckets, designer_kwargs_for, start_trials) -> int:
+    """Pre-checks that the per-group acquisition budgets really produce
+    pairwise-distinct shape buckets (distinct jit statics)."""
+    from vizier_tpu.compute import registry as compute_registry
+
+    keys = set()
+    for b in range(buckets):
+        st = _Study(problem, seed=b + 1, designer_kwargs=designer_kwargs_for(b))
+        st.feed(start_trials)
+        resolved = compute_registry.resolve(st.designer, 1)
+        assert resolved is not None, f"bucket group {b} is unbatchable"
+        keys.add(resolved[1])
+    return len(keys)
+
+
+def _run_mesh_arm(
+    *,
+    mesh,  # MeshConfig | None (None = the single-device seed executor)
+    buckets: int,
+    studies_per_bucket: int,
+    rounds: int,
+    warmup_rounds: int,
+    start_trials: int,
+    problem,
+    designer_kwargs_for,
+    max_wait_ms: float,
+    max_batch_size: int,
+) -> dict:
+    pool = _make_pool(problem, buckets, studies_per_bucket, designer_kwargs_for)
+    for st in pool:
+        st.feed(start_trials)
+    stats = ServingStats()
+    executor = BatchExecutor(
+        max_batch_size=max_batch_size,
+        max_wait_ms=max_wait_ms,
+        stats=stats,
+        metrics=stats.registry,
+        mesh=mesh,
+    )
+
+    latencies: list = []
+    lat_lock = threading.Lock()
+    warm_snapshot = {}
+
+    def one_suggest(st: _Study, record: bool):
+        t0 = time.perf_counter()
+        out = executor.suggest(st.designer, 1)
+        dt = time.perf_counter() - t0
+        if record:
+            with lat_lock:
+                latencies.append(dt)
+        return out
+
+    barrier = threading.Barrier(len(pool) + 1)
+
+    def client(st: _Study):
+        for _ in range(warmup_rounds):
+            st.complete_suggestion(one_suggest(st, record=False)[0])
+        barrier.wait()  # compiles paid; measurement starts together
+        for _ in range(rounds):
+            st.complete_suggestion(one_suggest(st, record=True)[0])
+
+    threads = [threading.Thread(target=client, args=(st,)) for st in pool]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    warm_snapshot = stats.snapshot()
+    t_start = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    placement_flushes = executor.placement_flush_counts()
+    bucket_placements = executor.bucket_placements()
+    executor.close()
+
+    latencies.sort()
+    snap = stats.snapshot()
+    measured = {
+        k: snap.get(k, 0) - warm_snapshot.get(k, 0)
+        for k in ("batch_flushes", "batched_suggests", "mesh_flushes")
+    }
+    total = len(pool) * rounds
+    occupancy = (
+        measured["batched_suggests"] / measured["batch_flushes"]
+        if measured["batch_flushes"]
+        else 1.0
+    )
+    return {
+        "mesh": bool(mesh is not None and mesh.enabled),
+        "suggest_p50_ms": round(_percentile(latencies, 50) * 1e3, 1),
+        "suggest_p95_ms": round(_percentile(latencies, 95) * 1e3, 1),
+        "suggest_p99_ms": round(_percentile(latencies, 99) * 1e3, 1),
+        "throughput_suggestions_per_sec": round(total / wall, 3),
+        "flush_throughput_per_sec": round(
+            measured["batch_flushes"] / wall, 3
+        )
+        if measured["batch_flushes"]
+        else 0.0,
+        "wall_secs": round(wall, 2),
+        "suggestions": total,
+        "measured_flushes": measured["batch_flushes"],
+        "mean_batch_occupancy": round(occupancy, 2),
+        "placement_flushes": placement_flushes,
+        "bucket_placements": bucket_placements,
+        "batch_stats": {k: v for k, v in snap.items() if k.startswith(("batch", "mesh"))},
+    }
+
+
+def _mesh_off_bit_identity(problem, designer_kwargs) -> bool:
+    """``VIZIER_MESH=0`` (MeshConfig.from_env with the switch unset) must
+    route through the byte-identical seed executor: same concurrent
+    workload, slot-for-slot equal suggestions."""
+
+    def run(mesh):
+        pool = [
+            _Study(problem, seed=s + 1, designer_kwargs=designer_kwargs)
+            for s in range(3)
+        ]
+        for st in pool:
+            st.feed(9)
+        executor = BatchExecutor(max_batch_size=8, max_wait_ms=30.0, mesh=mesh)
+        outs = [None] * len(pool)
+
+        def one(i):
+            outs[i] = executor.suggest(pool[i].designer, 1)
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(len(pool))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        executor.close()
+        return [s.parameters.as_dict() for out in outs for s in out]
+
+    return run(None) == run(MeshConfig.from_env())
+
+
+def run_mesh_ab(args) -> None:
+    problem = _problem(args.dim)
+    from vizier_tpu.converters import padding as padding_lib
+
+    schedule = padding_lib.DEFAULT_PADDING
+    end_trials = args.start_trials + args.warmup_rounds + args.rounds
+    if schedule.pad_trials(args.start_trials) != schedule.pad_trials(end_trials):
+        raise SystemExit(
+            f"start_trials={args.start_trials} grows to {end_trials} across "
+            "a padding-bucket boundary; shrink --rounds or move "
+            "--start-trials."
+        )
+
+    def designer_kwargs_for(bucket_index: int) -> dict:
+        # Distinct acquisition budgets -> distinct vec_opt jit statics ->
+        # pairwise-distinct shape buckets with near-identical per-slot cost
+        # (the budget delta is < 1%).
+        return dict(
+            max_acquisition_evaluations=args.max_evals + 8 * bucket_index,
+            ard_restarts=args.ard_restarts,
+            ard_optimizer=lbfgs_lib.AdamOptimizer(maxiter=args.ard_maxiter),
+        )
+
+    distinct = _distinct_buckets(
+        problem, args.buckets, designer_kwargs_for, args.start_trials
+    )
+    assert distinct == args.buckets, (distinct, args.buckets)
+    mesh_config = MeshConfig(
+        enabled=True,
+        num_devices=args.devices,
+        shard_devices=args.shard_devices,
+    )
+    config = dict(
+        devices=args.devices,
+        shard_devices=args.shard_devices,
+        buckets=args.buckets,
+        studies_per_bucket=args.studies_per_bucket,
+        rounds=args.rounds,
+        warmup_rounds=args.warmup_rounds,
+        start_trials=args.start_trials,
+        dim=args.dim,
+        designer="VizierGPUCBPEBandit",
+        max_acquisition_evaluations=args.max_evals,
+        ard_maxiter=args.ard_maxiter,
+        ard_restarts=args.ard_restarts,
+        max_wait_ms=args.max_wait_ms,
+        max_batch_size=8,
+        backend=os.environ.get("JAX_PLATFORMS", ""),
+        xla_flags=os.environ.get("XLA_FLAGS", ""),
+    )
+
+    arms = {}
+    for name, mesh in (("single_device", None), ("mesh", mesh_config)):
+        print(f"[batching_ab] running mesh arm: {name}", flush=True)
+        arms[name] = _run_mesh_arm(
+            mesh=mesh,
+            buckets=args.buckets,
+            studies_per_bucket=args.studies_per_bucket,
+            rounds=args.rounds,
+            warmup_rounds=args.warmup_rounds,
+            start_trials=args.start_trials,
+            problem=problem,
+            designer_kwargs_for=designer_kwargs_for,
+            max_wait_ms=args.max_wait_ms,
+            max_batch_size=8,
+        )
+        print(f"[batching_ab] {name}: {json.dumps(arms[name])}", flush=True)
+
+    print("[batching_ab] checking VIZIER_MESH=0 bit-identity", flush=True)
+    bit_identical = _mesh_off_bit_identity(problem, designer_kwargs_for(0))
+
+    on, off = arms["mesh"], arms["single_device"]
+    flush_speedup = on["flush_throughput_per_sec"] / max(
+        off["flush_throughput_per_sec"], 1e-9
+    )
+    speedup = on["throughput_suggestions_per_sec"] / max(
+        off["throughput_suggestions_per_sec"], 1e-9
+    )
+    report = {
+        "config": config,
+        "single_device": off,
+        "mesh": on,
+        "verdict": {
+            "flush_throughput_speedup": round(flush_speedup, 2),
+            "throughput_speedup": round(speedup, 2),
+            "meets_2x_at_8_devices": bool(
+                flush_speedup >= 2.0
+                and args.devices >= 8
+                and args.buckets >= 8
+            ),
+            "concurrent_buckets": args.buckets,
+            "mesh_off_bit_identical": bool(bit_identical),
+        },
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    pathlib.Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report["verdict"], indent=2))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--studies", type=int, default=8)
@@ -205,8 +500,29 @@ def main() -> None:
     parser.add_argument("--ard-maxiter", type=int, default=30)
     parser.add_argument("--ard-restarts", type=int, default=4)
     parser.add_argument("--max-wait-ms", type=float, default=50.0)
-    parser.add_argument("--out", default="BATCHING_AB.json")
+    # Mesh arm (writes MESH_AB.json instead of the classic A/B).
+    parser.add_argument(
+        "--devices",
+        type=int,
+        default=0,
+        help="mesh A/B over N (simulated) devices; 0 = classic batching A/B",
+    )
+    parser.add_argument("--buckets", type=int, default=8)
+    parser.add_argument("--studies-per-bucket", type=int, default=2)
+    parser.add_argument(
+        "--shard-devices",
+        type=int,
+        default=1,
+        help="devices per placement submesh in the mesh arm",
+    )
+    parser.add_argument("--out", default=None)
     args = parser.parse_args()
+
+    if args.devices:
+        args.out = args.out or "MESH_AB.json"
+        run_mesh_ab(args)
+        return
+    args.out = args.out or "BATCHING_AB.json"
 
     problem = _problem(args.dim)
     # Guard the one-bucket invariant: a bucket boundary inside the measured

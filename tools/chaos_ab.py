@@ -18,6 +18,17 @@ injected fault that reaches the client.
 
 Usage:  python tools/chaos_ab.py [--trials 50] [--seed 11] [--fault-prob 0.1]
         [--distributed N] [--kill-at K] [--instrument-locks]
+        [--mesh-devices N]
+
+``--mesh-devices N`` adds a mesh-executor chaos arm: chaos-wrapped GP
+designers across multiple shape buckets drive a mesh-sharded
+``BatchExecutor`` (``parallel.mesh``, N simulated devices, per-placement
+dispatch workers) under the same seeded fault schedule. A device-program
+strike poisons ONE placement's flush; the arm asserts the strike degrades
+only that flush's slots (sequential fallback / isolated designer errors)
+while other placements keep serving — and, with ``--instrument-locks``,
+that the per-placement worker threads' runtime lock order is a subset of
+the static graph.
 
 ``--distributed N`` adds a third arm: the same seeded fault schedule
 against an N-replica sharded tier (``vizier_tpu.distributed``) with
@@ -48,6 +59,28 @@ import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("VIZIER_DISABLE_MESH", "1")
+
+
+def _peek_int_flag(name: str, default: int) -> int:
+    """Reads an int flag from argv BEFORE the jax-importing modules below
+    (the mesh arm must set --xla_force_host_platform_device_count before
+    jax's backend initializes)."""
+    for i, arg in enumerate(sys.argv):
+        if arg == name and i + 1 < len(sys.argv):
+            return int(sys.argv[i + 1])
+        if arg.startswith(name + "="):
+            return int(arg.split("=", 1)[1])
+    return default
+
+
+_MESH_DEVICES = _peek_int_flag("--mesh-devices", 0)
+if _MESH_DEVICES:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags
+            + f" --xla_force_host_platform_device_count={_MESH_DEVICES}"
+        ).strip()
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
@@ -253,6 +286,141 @@ def run_distributed_arm(
     }
 
 
+def run_mesh_executor_arm(
+    *,
+    devices: int,
+    seed: int,
+    fault_prob: float,
+    rounds: int = 4,
+    buckets: int = 2,
+    studies_per_bucket: int = 2,
+) -> dict:
+    """Chaos soak on the mesh-sharded batch executor itself.
+
+    Chaos-wrapped UCB-PE designers across ``buckets`` distinct shape
+    buckets (sticky-assigned to different placements) run concurrent
+    suggest rounds through a mesh executor while the seeded monkey strikes
+    the batch hooks. A ``device_program`` strike poisons one placement's
+    flush — the executor must degrade only that flush (per-slot sequential
+    fallback; a re-struck fallback surfaces as that slot's own designer
+    error) while other placements' flushes keep completing. After the
+    soak, a fault-free designer must still be served (no dead workers, no
+    poisoned queues).
+    """
+    import threading
+
+    import numpy as np
+
+    from vizier_tpu.algorithms import core as core_lib
+    from vizier_tpu.designers import gp_ucb_pe
+    from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+    from vizier_tpu.testing import failing
+    from vizier_tpu.parallel.batch_executor import BatchExecutor
+    from vizier_tpu.parallel.mesh import MeshConfig
+    from vizier_tpu.serving.stats import ServingStats
+
+    def problem(dim=2):
+        p = vz.ProblemStatement()
+        for d in range(dim):
+            p.search_space.root.add_float_param(f"x{d}", 0.0, 1.0)
+        p.metric_information.append(
+            vz.MetricInformation(
+                name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE
+            )
+        )
+        return p
+
+    def designer(bucket_index: int, study_seed: int):
+        d = gp_ucb_pe.VizierGPUCBPEBandit(
+            problem(),
+            rng_seed=study_seed,
+            # Distinct acquisition budgets -> distinct jit statics ->
+            # distinct buckets (mirrors tools/batching_ab.py --devices).
+            max_acquisition_evaluations=200 + 8 * bucket_index,
+            ard_restarts=2,
+            ard_optimizer=lbfgs_lib.AdamOptimizer(maxiter=10),
+            warm_start_min_trials=0,
+        )
+        rng = np.random.default_rng(study_seed)
+        trials = []
+        for i in range(5):
+            t = vz.Trial(
+                parameters={
+                    "x0": float(rng.uniform()),
+                    "x1": float(rng.uniform()),
+                },
+                id=i + 1,
+            )
+            t.complete(vz.Measurement(metrics={"obj": float(rng.uniform())}))
+            trials.append(t)
+        d.update(core_lib.CompletedTrials(trials))
+        return d
+
+    monkey = chaos.ChaosMonkey(seed=seed, failure_prob=fault_prob)
+    stats = ServingStats()
+    executor = BatchExecutor(
+        max_batch_size=8,
+        max_wait_ms=30.0,
+        stats=stats,
+        metrics=stats.registry,
+        mesh=MeshConfig(enabled=True, num_devices=devices),
+    )
+    pool = [
+        chaos.ChaosDesigner(designer(b, b * 100 + c + 1), monkey)
+        for b in range(buckets)
+        for c in range(studies_per_bucket)
+    ]
+
+    completed = injected = 0
+    count_lock = threading.Lock()
+
+    def client(d):
+        nonlocal completed, injected
+        for _ in range(rounds):
+            try:
+                out = executor.suggest(d, 1)
+                assert out, "empty suggestion batch"
+                with count_lock:
+                    completed += 1
+            except failing.FailedSuggestError:
+                with count_lock:
+                    injected += 1
+
+    threads = [threading.Thread(target=client, args=(d,)) for d in pool]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+
+    # Post-soak liveness: a fault-free designer must still be served by the
+    # same (possibly previously poisoned) placements.
+    clean = executor.suggest(designer(0, 999), 1)
+    placement_flushes = executor.placement_flush_counts()
+    executor.close()
+
+    snap = stats.snapshot()
+    attempts = len(pool) * rounds
+    return {
+        "devices": devices,
+        "buckets": buckets,
+        "studies_per_bucket": studies_per_bucket,
+        "rounds": rounds,
+        "attempts": attempts,
+        "completed": completed,
+        "isolated_designer_errors": injected,
+        "all_accounted": completed + injected == attempts,
+        "post_soak_liveness": bool(clean),
+        "batch_fallbacks": snap.get("batch_fallbacks", 0),
+        "batch_slot_errors": snap.get("batch_slot_errors", 0),
+        "mesh_flushes": snap.get("mesh_flushes", 0),
+        "placement_flushes": placement_flushes,
+        "elapsed_secs": round(elapsed, 3),
+        "injected": monkey.counts(),
+    }
+
+
 def _cross_check_locks(observatory, out: dict) -> bool:
     """Diffs the soak's observed lock order against the static graph."""
     from vizier_tpu.analysis import debug_locks, suite
@@ -289,6 +457,15 @@ def main() -> None:
         type=int,
         default=-1,
         help="trial index at which the owning replica dies (-1 = halfway)",
+    )
+    parser.add_argument(
+        "--mesh-devices",
+        type=int,
+        default=0,
+        metavar="N",
+        help="add the mesh-executor chaos arm on N simulated devices "
+        "(0 = skip); composes with --instrument-locks so the per-placement "
+        "dispatch workers enter the runtime lock-order cross-check",
     )
     parser.add_argument(
         "--instrument-locks",
@@ -328,6 +505,7 @@ def main() -> None:
             "algorithm": "RANDOM_SEARCH (chaos-wrapped designer)",
             "observability": ObservabilityConfig.from_env().as_dict(),
             "instrument_locks": bool(args.instrument_locks),
+            "mesh_devices": args.mesh_devices,
         },
         "arms": {},
     }
@@ -363,6 +541,16 @@ def main() -> None:
                 num_replicas=args.distributed,
                 kill_at=kill_at,
             )
+        if args.mesh_devices:
+            print(
+                f"[chaos_ab] running arm: mesh_executor "
+                f"({args.mesh_devices} devices)"
+            )
+            report["arms"]["mesh_executor"] = run_mesh_executor_arm(
+                devices=args.mesh_devices,
+                seed=args.seed,
+                fault_prob=args.fault_prob,
+            )
 
     on, off = report["arms"]["reliability_on"], report["arms"]["reliability_off"]
     report["verdict"] = {
@@ -383,6 +571,16 @@ def main() -> None:
             }
         )
         ok = ok and dist["completed_trials"] == args.trials and dist["failovers"] >= 1
+    if args.mesh_devices:
+        mesh_arm = report["arms"]["mesh_executor"]
+        report["verdict"].update(
+            {
+                "mesh_all_accounted": mesh_arm["all_accounted"],
+                "mesh_post_soak_liveness": mesh_arm["post_soak_liveness"],
+                "mesh_isolated_errors": mesh_arm["isolated_designer_errors"],
+            }
+        )
+        ok = ok and mesh_arm["all_accounted"] and mesh_arm["post_soak_liveness"]
     if args.instrument_locks:
         locks_ok = _cross_check_locks(observatory, report)
         report["verdict"]["lock_order_confirmed"] = locks_ok

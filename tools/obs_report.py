@@ -185,6 +185,38 @@ def program_kind_activity(spans: List[dict]) -> Dict[str, dict]:
     return out
 
 
+def device_activity(spans: List[dict]) -> Dict[str, dict]:
+    """Per-device (mesh placement) flush breakdown.
+
+    Mesh-mode flush spans (``batch_executor.flush``) carry a ``device``
+    attribute naming the placement that executed them; this rolls those up
+    into flush count, busy time, and mean occupancy per placement — the
+    "is the mesh actually balanced" view. Empty when the span file came
+    from a single-device run (VIZIER_MESH=0 stamps no device attribute).
+    """
+    out: Dict[str, dict] = {}
+    occ: Dict[str, List[float]] = {}
+    for span in spans:
+        if span.get("name") != "batch_executor.flush":
+            continue
+        attrs = span.get("attributes") or {}
+        device = attrs.get("device")
+        if device is None:
+            continue
+        row = out.setdefault(device, {"flushes": 0, "busy_ms": 0.0})
+        row["flushes"] += 1
+        row["busy_ms"] += float(span.get("duration_secs") or 0.0) * 1e3
+        occupancy = attrs.get("occupancy")
+        if isinstance(occupancy, (int, float)):
+            occ.setdefault(device, []).append(float(occupancy))
+    for device, row in out.items():
+        row["busy_ms"] = round(row["busy_ms"], 2)
+        samples = occ.get(device)
+        if samples:
+            row["mean_occupancy"] = round(sum(samples) / len(samples), 2)
+    return out
+
+
 def speculative_activity(spans: List[dict]) -> dict:
     """Hit/miss/stale serving outcomes plus pre-compute counts.
 
@@ -280,6 +312,7 @@ def main() -> None:
     activity = surrogate_activity(spans)
     speculative = speculative_activity(spans)
     programs = program_kind_activity(spans)
+    devices = device_activity(spans)
     if args.json:
         print(
             json.dumps(
@@ -288,6 +321,7 @@ def main() -> None:
                     "surrogate_activity": activity,
                     "speculative_activity": speculative,
                     "program_kind_activity": programs,
+                    "device_activity": devices,
                     "phases": rows,
                 },
                 indent=2,

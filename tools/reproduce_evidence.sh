@@ -26,9 +26,19 @@ JAX_PLATFORMS=cpu python tools/service_throughput.py --replicas 4 --out /tmp/st.
 
 echo "== 3b. failover chaos: kill one replica mid-study (~1 min) =="
 #    -> CHAOS_AB.json gains the distributed_failover arm (50/50 trials
-#    complete via router failover + WAL handoff) and the runtime
-#    lock-order cross-check (router/WAL locks vs the static graph)
-JAX_PLATFORMS=cpu python tools/chaos_ab.py --distributed 4 --instrument-locks
+#    complete via router failover + WAL handoff), the mesh_executor arm
+#    (device-program failure isolated to ONE placement of an 8-device
+#    mesh), and the runtime lock-order cross-check — now including the
+#    per-placement mesh dispatch workers — vs the static graph
+JAX_PLATFORMS=cpu python tools/chaos_ab.py --distributed 4 --mesh-devices 8 \
+  --instrument-locks
+
+echo "== 3b2. mesh-sharded batch execution A/B (~4 min) =="
+#    -> MESH_AB.json: 8 distinct concurrent shape buckets through the
+#    single-device executor vs an 8-placement mesh executor on 8
+#    simulated devices (target >= 2x aggregate flush throughput), plus
+#    the VIZIER_MESH=0 bit-identity check against the seed executor
+JAX_PLATFORMS=cpu python tools/batching_ab.py --devices 8
 
 echo "== 3c. sparse-surrogate A/B at the north-star scale (~10 min) =="
 #    -> SPARSE_AB.json: sparse SGPR vs exact O(n^3) device-side suggest

@@ -21,6 +21,14 @@ on:
   ``kind`` / ``device_phase`` class attributes are absent or not string
   literals, so registry lookup / ``vizier_jax_phase_seconds`` tracing
   cannot name the program;
+- ``program-missing-shard-axis`` — no literal ``shardable_batch_axis``
+  declaration: the mesh execution plane (``parallel.mesh``) needs every
+  program to state explicitly whether its ``device_program`` may be
+  sharded over a device placement (``"study"`` for the stacked
+  leading-axis programs, ``""`` for an unshardable one) — an inherited
+  silent default would let a program that never audited its batch axis
+  ride the single-device path forever, or worse, a copied program claim
+  shardability it never implements;
 - ``missing-chaos-program-hook`` — ``vizier_tpu/testing/chaos.py`` no
   longer defines the generic ``ChaosProgram`` wrapper (the IR-level chaos
   slot-isolation seam) with the per-slot and device hooks;
@@ -293,6 +301,26 @@ def run(project: common.Project, repo_root: str) -> ComputeIrResult:
                         f"DesignerProgram {reg.program_class} does not "
                         "declare a literal `device_phase` — its flushes "
                         "would be invisible to vizier_jax_phase_seconds"
+                    ),
+                    path=info.path,
+                    line=info.node.lineno,
+                )
+            )
+        if _inherited_attr_literal(
+            project, reg.program_class, "shardable_batch_axis"
+        ) is None:
+            findings.append(
+                common.Finding(
+                    pass_name=PASS_NAME,
+                    rule="program-missing-shard-axis",
+                    key=f"program-missing-shard-axis:{reg.program_class}",
+                    message=(
+                        f"DesignerProgram {reg.program_class} does not "
+                        "declare a literal `shardable_batch_axis` — the "
+                        "mesh execution plane needs an explicit statement "
+                        "of whether device_program may shard over a "
+                        'placement ("study") or must stay single-device '
+                        '("")'
                     ),
                     path=info.path,
                     line=info.node.lineno,

@@ -156,6 +156,23 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
     _switch("VIZIER_SPARSE_UCB_PE", "flag", "SurrogateConfig", _PERF_DOC,
             "Extend the sparse auto-switch to the UCB-PE DEFAULT "
             "(0 = UCB-PE studies stay exact at every size).", "1"),
+    # -- mesh execution plane (parallel.mesh.MeshConfig) -------------------
+    _switch("VIZIER_MESH", "flag", "MeshConfig", _PERF_DOC,
+            "Mesh-sharded batch execution: carve devices into placements "
+            "and dispatch buckets concurrently (opt-in; unset/0 = the "
+            "bit-identical single-device executor).", "0"),
+    _switch("VIZIER_MESH_DEVICES", "int", "MeshConfig", _PERF_DOC,
+            "Devices the mesh plane may use (0 = all).", "0"),
+    _switch("VIZIER_MESH_SHARD_DEVICES", "int", "MeshConfig", _PERF_DOC,
+            "Devices per placement submesh; >1 shards each flush's study "
+            "axis over the placement.", "1"),
+    _switch("VIZIER_MESH_COORDINATOR", "str", "MeshConfig", _PERF_DOC,
+            "jax.distributed coordinator address for a multi-host mesh "
+            "('' = single host)."),
+    _switch("VIZIER_MESH_PROCESSES", "int", "MeshConfig", _PERF_DOC,
+            "Process count for the multi-host mesh (0 = auto).", "0"),
+    _switch("VIZIER_MESH_PROCESS_ID", "int", "MeshConfig", _PERF_DOC,
+            "This process's id in the multi-host mesh (-1 = auto).", "-1"),
     # -- designers ---------------------------------------------------------
     _switch("VIZIER_DISABLE_MESH", "flag", "GPBanditDesigner", _SWITCH_DOC,
             "Opt out of the multi-device auto-mesh (set = disabled).", "0"),

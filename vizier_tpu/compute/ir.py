@@ -83,6 +83,16 @@ class DesignerProgram(abc.ABC):
     #: Which surrogate family the device body trains ("exact" | "sparse");
     #: tools/obs_report.py builds its phase classification from this.
     surrogate_family: str = "exact"
+    #: Name of the batch axis ``device_program`` may shard over a device
+    #: placement ("" = unshardable: the executor never passes a
+    #: ``placement`` and the flush runs on the default device). Every
+    #: in-tree program stacks items along a leading per-study axis and
+    #: declares ``"study"``; the mesh executor then commits the stacked
+    #: pytree onto the placement's submesh (``DevicePlacement.shard``)
+    #: before the fused dispatch. Declared as IR metadata — not inferred —
+    #: so the ``compute_ir`` analysis pass can audit that every registered
+    #: program made the call explicitly.
+    shardable_batch_axis: str = ""
     #: Service algorithm names whose prewarm walks should compile this
     #: program's buckets (PythiaServicer.prewarm consults the registry).
     algorithms: Tuple[str, ...] = ()
@@ -101,12 +111,22 @@ class DesignerProgram(abc.ABC):
 
     @abc.abstractmethod
     def device_program(
-        self, items: Sequence[dict], pad_to: Optional[int] = None
+        self,
+        items: Sequence[dict],
+        pad_to: Optional[int] = None,
+        placement: Any = None,
     ) -> List[dict]:
         """The jitted, vmapped train+acquire body for a whole bucket:
         stacks the items along a leading study axis, runs ONE fused XLA
         dispatch, fetches once, and returns one host-side output dict per
-        item (free numpy views after the single ``device_get``)."""
+        item (free numpy views after the single ``device_get``).
+
+        ``placement`` (a ``parallel.mesh.DevicePlacement``) is only passed
+        when the program declares a ``shardable_batch_axis``: the program
+        must then commit the stacked pytree onto the placement's submesh
+        (``placement.shard``) so the fused dispatch spans its devices. The
+        executor guarantees ``pad_to`` is a multiple of the placement's
+        device count."""
 
     @abc.abstractmethod
     def finalize(self, designer: Any, item: dict, output: dict) -> List[Any]:

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -658,13 +658,20 @@ class VizierGPBandit(core_lib.Designer, core_lib.Predictor):
         return self._active_batch_program().prepare(self, count or 1)
 
     @classmethod
-    def batch_execute(cls, items: Sequence[dict], pad_to: Optional[int] = None):
+    def batch_execute(
+        cls,
+        items: Sequence[dict],
+        pad_to: Optional[int] = None,
+        placement: Optional[Any] = None,
+    ):
         """Device half: dispatched to the bucket's registered program
         (slot 0's item says which — the bucket key guarantees agreement)."""
         from vizier_tpu.compute import registry as compute_registry
 
         kind = "gp_bandit_sparse" if items[0].get("sparse") else "gp_bandit"
-        return compute_registry.get(kind).device_program(items, pad_to=pad_to)
+        return compute_registry.get(kind).device_program(
+            items, pad_to=pad_to, placement=placement
+        )
 
     def batch_finalize(self, item: dict, output: dict) -> List[trial_.TrialSuggestion]:
         """Host-side demux (see the program classes)."""
@@ -1244,6 +1251,7 @@ class GPBanditProgram(compute_ir.DesignerProgram):
     kind = "gp_bandit"
     device_phase = "gp_bandit.suggest_batched"
     surrogate_family = "exact"
+    shardable_batch_axis = "study"
     algorithms = ("GAUSSIAN_PROCESS_BANDIT",)
 
     def bucket_key(self, designer, count):
@@ -1277,15 +1285,18 @@ class GPBanditProgram(compute_ir.DesignerProgram):
     def prepare(self, designer, count):
         return _gp_bandit_prepare(designer, count, sparse=False)
 
-    def device_program(self, items, pad_to=None):
+    def device_program(self, items, pad_to=None, placement=None):
         """ONE vmapped train + ONE vmapped sweep for the whole bucket
         (slot 0's jit statics stand in for everyone's — the bucket key
-        guarantees they are equal)."""
+        guarantees they are equal). With a mesh ``placement`` the stacked
+        study axis is committed onto its submesh, so the fused dispatch
+        spans the placement's devices."""
         from vizier_tpu.parallel import batch_executor
 
         d0: "VizierGPBandit" = items[0]["designer"]
-        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
-            [it[name] for it in items], pad_to
+        stack = lambda name: batch_executor.place_batch(  # noqa: E731
+            batch_executor.stack_pytrees([it[name] for it in items], pad_to),
+            placement,
         )
         with jax_timing.device_phase(self.device_phase) as phase:
             states, warm_next, result = _gp_bandit_flush_program(
@@ -1327,6 +1338,7 @@ class GPBanditSparseProgram(compute_ir.DesignerProgram):
     kind = "gp_bandit_sparse"
     device_phase = "sparse_gp.suggest_batched"
     surrogate_family = "sparse"
+    shardable_batch_axis = "study"
     algorithms = ("GAUSSIAN_PROCESS_BANDIT",)
 
     def bucket_key(self, designer, count):
@@ -1364,12 +1376,13 @@ class GPBanditSparseProgram(compute_ir.DesignerProgram):
     def prepare(self, designer, count):
         return _gp_bandit_prepare(designer, count, sparse=True)
 
-    def device_program(self, items, pad_to=None):
+    def device_program(self, items, pad_to=None, placement=None):
         from vizier_tpu.parallel import batch_executor
 
         d0: "VizierGPBandit" = items[0]["designer"]
-        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
-            [it[name] for it in items], pad_to
+        stack = lambda name: batch_executor.place_batch(  # noqa: E731
+            batch_executor.stack_pytrees([it[name] for it in items], pad_to),
+            placement,
         )
         with jax_timing.device_phase(self.device_phase) as phase:
             states, warm_next, result = sparse_bandit._sparse_flush_program(

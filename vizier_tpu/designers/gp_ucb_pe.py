@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1202,12 +1202,19 @@ class VizierGPUCBPEBandit(gp_bandit.VizierGPBandit):
         return compute_registry.get(kind)
 
     @classmethod
-    def batch_execute(cls, items: Sequence[dict], pad_to: Optional[int] = None):
+    def batch_execute(
+        cls,
+        items: Sequence[dict],
+        pad_to: Optional[int] = None,
+        placement: Optional[Any] = None,
+    ):
         """Device half: dispatched to the bucket's registered program."""
         from vizier_tpu.compute import registry as compute_registry
 
         kind = "gp_ucb_pe_sparse" if items[0].get("sparse") else "gp_ucb_pe"
-        return compute_registry.get(kind).device_program(items, pad_to=pad_to)
+        return compute_registry.get(kind).device_program(
+            items, pad_to=pad_to, placement=placement
+        )
 
     def batch_finalize(self, item: dict, output: dict) -> List[trial_.TrialSuggestion]:
         from vizier_tpu.compute import registry as compute_registry
@@ -1669,6 +1676,7 @@ class UCBPEProgram(compute_ir.DesignerProgram):
     kind = "gp_ucb_pe"
     device_phase = "gp_ucb_pe.suggest_batched"
     surrogate_family = "exact"
+    shardable_batch_axis = "study"
     algorithms = ("DEFAULT", "GP_UCB_PE", "ALGORITHM_UNSPECIFIED")
 
     def bucket_key(self, designer, count):
@@ -1707,12 +1715,13 @@ class UCBPEProgram(compute_ir.DesignerProgram):
     def prepare(self, designer, count):
         return _ucb_pe_prepare(designer, count, sparse=False)
 
-    def device_program(self, items, pad_to=None):
+    def device_program(self, items, pad_to=None, placement=None):
         from vizier_tpu.parallel import batch_executor
 
         d0: "VizierGPUCBPEBandit" = items[0]["designer"]
-        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
-            [it[name] for it in items], pad_to
+        stack = lambda name: batch_executor.place_batch(  # noqa: E731
+            batch_executor.stack_pytrees([it[name] for it in items], pad_to),
+            placement,
         )
         count = items[0]["count"]
         two_phase = (
@@ -1769,6 +1778,7 @@ class UCBPESparseProgram(compute_ir.DesignerProgram):
     kind = "gp_ucb_pe_sparse"
     device_phase = "sparse_gp.ucb_pe_suggest_batched"
     surrogate_family = "sparse"
+    shardable_batch_axis = "study"
     algorithms = ("DEFAULT", "GP_UCB_PE", "ALGORITHM_UNSPECIFIED")
 
     def bucket_key(self, designer, count):
@@ -1809,12 +1819,13 @@ class UCBPESparseProgram(compute_ir.DesignerProgram):
     def prepare(self, designer, count):
         return _ucb_pe_prepare(designer, count, sparse=True)
 
-    def device_program(self, items, pad_to=None):
+    def device_program(self, items, pad_to=None, placement=None):
         from vizier_tpu.parallel import batch_executor
 
         d0: "VizierGPUCBPEBandit" = items[0]["designer"]
-        stack = lambda name: batch_executor.stack_pytrees(  # noqa: E731
-            [it[name] for it in items], pad_to
+        stack = lambda name: batch_executor.place_batch(  # noqa: E731
+            batch_executor.stack_pytrees([it[name] for it in items], pad_to),
+            placement,
         )
         count = items[0]["count"]
         two_phase = (

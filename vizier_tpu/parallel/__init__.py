@@ -37,6 +37,14 @@ from vizier_tpu.parallel.batch_executor import BatchExecutor
 from vizier_tpu.parallel.batch_executor import BatchSlotError
 from vizier_tpu.parallel.batch_executor import BucketKey
 
+# Mesh execution plane for the batch executor (VIZIER_MESH*): device
+# placements, shard-granularity padding, and the multi-host coordinator
+# seam.
+from vizier_tpu.parallel.mesh import DevicePlacement
+from vizier_tpu.parallel.mesh import MeshConfig
+from vizier_tpu.parallel.mesh import build_placements
+from vizier_tpu.parallel.mesh import multihost_mesh
+
 Array = jax.Array
 
 DEVICE_AXIS = "devices"

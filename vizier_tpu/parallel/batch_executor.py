@@ -28,6 +28,20 @@ only to that study's waiter, which hands it to the existing reliability
 path (retry / circuit breaker / quasi-random fallback) — batchmates are
 never poisoned.
 
+Mesh execution plane (``parallel.mesh``, opt-in ``VIZIER_MESH=1``): the
+process's devices are carved into placements (1-D submeshes); each bucket
+is sticky-assigned to one placement and DIFFERENT buckets execute
+concurrently on per-placement worker threads instead of serializing
+through the scheduler (which keeps sole ownership of flush *forming* —
+windows, lanes, ordering). A flush dispatched to a multi-device placement
+is sharded over its study axis (``DevicePlacement.shard``) so one fused
+program spans the placement's devices, and every placement pads flushes
+at shard granularity (``DevicePlacement.pad_to``: the next power-of-two
+multiple of its device count) instead of the single-device executor's
+flat pad-to-``max_batch_size`` — a low-occupancy flush no longer computes
+``max_batch_size`` padded slots. ``VIZIER_MESH=0`` (default) never builds
+placements: single scheduler thread, one device, bit-identical seed path.
+
 Priority lanes: slots submitted with ``speculative=True`` (the serving
 tier's background pre-compute, ``vizier_tpu.serving.speculative``) ride a
 live flush that is forming anyway, but a bucket holding ONLY speculative
@@ -51,10 +65,11 @@ sequentially.
 
 from __future__ import annotations
 
+import collections
 import math
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from vizier_tpu.compute import ir as compute_ir
 from vizier_tpu.compute import registry as compute_registry
@@ -131,6 +146,20 @@ def stack_pytrees(trees: Sequence[Any], pad_to: Optional[int] = None) -> Any:
     return jax.tree_util.tree_map(stack, *trees)
 
 
+def place_batch(tree: Any, placement: Optional[Any] = None) -> Any:
+    """Commits a stacked (leading-study-axis) flush pytree onto a mesh
+    placement's submesh; a no-op when ``placement`` is None (the
+    single-device path keeps its lazy host->device copy at jit entry).
+
+    The shardable programs' ``device_program`` bodies route every stacked
+    input through this, so intra-flush sharding is one call site per
+    program instead of per-leaf plumbing.
+    """
+    if placement is None:
+        return tree
+    return placement.shard(tree)
+
+
 def slice_pytree(tree: Any, index: int) -> Any:
     """Slot ``index`` of a leading-study-axis pytree.
 
@@ -182,6 +211,7 @@ class BatchExecutor:
         metrics: Optional[metrics_lib.MetricsRegistry] = None,
         time_fn: Callable[[], float] = time.monotonic,
         speculative_max_wait_ms: float = 250.0,
+        mesh: Optional[Any] = None,  # parallel.mesh.MeshConfig
     ):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
@@ -200,6 +230,30 @@ class BatchExecutor:
         self._queues: Dict[BucketKey, List[_Slot]] = {}
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        # -- mesh execution plane (parallel.mesh, VIZIER_MESH=1) -----------
+        # Placements are built eagerly when the config enables the mesh
+        # (this is the only path that enumerates devices); disabled = None
+        # and every mesh branch below is dead — the seed executor.
+        self._placements: Optional[List[Any]] = None
+        self._workers: List[threading.Thread] = []
+        self._dispatch_cond = threading.Condition()
+        self._dispatch_queues: Dict[int, Deque[Tuple[BucketKey, List[_Slot], str]]] = {}
+        self._dispatch_closed = False
+        # BucketKey -> placement index, sticky from the first flush (the
+        # prewarm walker assigns through the same map, so a prewarmed
+        # bucket compiles on the placement that later serves it). Guarded
+        # by _dispatch_cond.
+        self._bucket_placement: Dict[BucketKey, int] = {}
+        # Per-placement flush counts; each entry is written only by its
+        # own worker thread (no lock — reads may be momentarily stale).
+        self._placement_flushes: Dict[str, int] = {}
+        if mesh is not None and getattr(mesh, "enabled", False):
+            from vizier_tpu.parallel import mesh as mesh_lib
+
+            self._placements = mesh_lib.build_placements(mesh)
+            for placement in self._placements:
+                self._dispatch_queues[placement.index] = collections.deque()
+                self._placement_flushes[placement.label()] = 0
         self._occupancy = self._flushes = self._queue_wait = None
         if metrics is not None:
             self._occupancy = metrics.histogram(
@@ -296,17 +350,72 @@ class BatchExecutor:
         return list(slot.designer.suggest(slot.count))  # "sequential"
 
     def close(self) -> None:
-        """Drains every queue (reason "drain") and stops the scheduler."""
+        """Drains every queue (reason "drain") and stops the scheduler
+        (plus, in mesh mode, the per-placement workers — the scheduler
+        routes the drain batches to them before signalling shutdown)."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         thread = self._thread
         if thread is not None:
             thread.join(timeout=30.0)
+        if self._placements is not None:
+            # Covers the never-started case; the scheduler already set
+            # this on exit after routing its drain batches.
+            with self._dispatch_cond:
+                self._dispatch_closed = True
+                self._dispatch_cond.notify_all()
+            for worker in self._workers:
+                worker.join(timeout=30.0)
 
     def pending_counts(self) -> Dict[str, int]:
         with self._cond:
             return {k.label(): len(v) for k, v in self._queues.items() if v}
+
+    # -- mesh introspection -------------------------------------------------
+
+    @property
+    def mesh_enabled(self) -> bool:
+        return self._placements is not None
+
+    def placements(self) -> List[Any]:
+        """The device placements (empty when the mesh plane is off)."""
+        return list(self._placements or [])
+
+    def placement_flush_counts(self) -> Dict[str, int]:
+        """Flushes executed per placement label (mesh mode only)."""
+        return dict(self._placement_flushes)
+
+    def bucket_placements(self) -> Dict[str, List[str]]:
+        """Sticky bucket -> placement assignment, label -> placement labels.
+
+        Keyed by bucket *label*, which omits the jit statics — buckets that
+        differ only in statics share a label, so the value is the list of
+        placements assigned across that label's keys.
+        """
+        if self._placements is None:
+            return {}
+        by_index = {p.index: p.label() for p in self._placements}
+        out: Dict[str, List[str]] = {}
+        with self._dispatch_cond:
+            for key, idx in self._bucket_placement.items():
+                out.setdefault(key.label(), []).append(by_index[idx])
+        return {label: sorted(placements) for label, placements in out.items()}
+
+    def _placement_for(self, key: BucketKey):
+        """The placement sticky-assigned to ``key`` (least-loaded on first
+        sight, stable forever after — one compiled program per (bucket,
+        placement)). Caller must NOT hold ``_dispatch_cond``."""
+        assert self._placements is not None
+        with self._dispatch_cond:
+            index = self._bucket_placement.get(key)
+            if index is None:
+                load: Dict[int, int] = {p.index: 0 for p in self._placements}
+                for assigned in self._bucket_placement.values():
+                    load[assigned] += 1
+                index = min(load, key=lambda i: (load[i], i))
+                self._bucket_placement[key] = index
+        return self._placements[index]
 
     def queue_depth(self) -> Dict[str, int]:
         """Queued slots by lane — the speculative admission gate's view of
@@ -335,6 +444,18 @@ class BatchExecutor:
                 daemon=True,
             )
             self._thread.start()
+        if self._placements is not None and not self._workers:
+            self._workers = [
+                threading.Thread(
+                    target=self._worker_loop,
+                    args=(placement,),
+                    name=f"vizier-mesh-worker-{placement.index}",
+                    daemon=True,
+                )
+                for placement in self._placements
+            ]
+            for worker in self._workers:
+                worker.start()
 
     def _take_due(self) -> List[Tuple[BucketKey, List[_Slot], str]]:
         """Pops every due (key, slots, reason) batch. Caller holds the lock.
@@ -424,33 +545,96 @@ class BatchExecutor:
                 due = self._take_due()
                 if not due:
                     if self._closed:
+                        self._signal_workers_closed()
                         return
                     self._cond.wait(timeout=self._next_deadline())
                     continue
-            for key, slots, reason in due:
-                self._execute(key, slots, reason)
+            if self._placements is None:
+                # Seed path: the scheduler thread executes flushes itself
+                # (device dispatch naturally serialized).
+                for key, slots, reason in due:
+                    self._execute(key, slots, reason)
+            else:
+                # Mesh path: the scheduler only FORMS flushes; execution
+                # fans out to the per-placement workers so different
+                # buckets dispatch to different devices concurrently.
+                for key, slots, reason in due:
+                    placement = self._placement_for(key)
+                    with self._dispatch_cond:
+                        self._dispatch_queues[placement.index].append(
+                            (key, slots, reason)
+                        )
+                        self._dispatch_cond.notify_all()
+
+    def _signal_workers_closed(self) -> None:
+        if self._placements is None:
+            return
+        with self._dispatch_cond:
+            self._dispatch_closed = True
+            self._dispatch_cond.notify_all()
+
+    def _worker_loop(self, placement: Any) -> None:
+        """One placement's dispatch thread: executes its bucket queue.
+
+        Pops under the dispatch lock, executes outside it — a flush's
+        device dispatch never runs under any executor lock (the lock-order
+        pass's no-compute-under-lock rule covers these threads too).
+        """
+        queue = self._dispatch_queues[placement.index]
+        while True:
+            with self._dispatch_cond:
+                while not queue and not self._dispatch_closed:
+                    self._dispatch_cond.wait()
+                if not queue and self._dispatch_closed:
+                    return
+                key, slots, reason = queue.popleft()
+            self._execute(key, slots, reason, placement)
+            self._placement_flushes[placement.label()] += 1
 
     # -- execution ----------------------------------------------------------
 
-    def _observe_flush(self, key: BucketKey, slots: List[_Slot], reason: str) -> None:
+    def _observe_flush(
+        self,
+        key: BucketKey,
+        slots: List[_Slot],
+        reason: str,
+        placement: Optional[Any] = None,
+    ) -> None:
         now = self._time()
         label = key.label()
+        # The device label only exists in mesh mode so the seed path's
+        # metric series stay byte-identical with the mesh off.
+        device = {"device": placement.label()} if placement is not None else {}
         if self._flushes is not None:
-            self._flushes.inc(reason=reason)
-            self._occupancy.observe(len(slots), bucket=label)
+            self._flushes.inc(reason=reason, **device)
+            self._occupancy.observe(len(slots), bucket=label, **device)
             for slot in slots:
-                self._queue_wait.observe(now - slot.enqueued_at, bucket=label)
+                self._queue_wait.observe(
+                    now - slot.enqueued_at, bucket=label, **device
+                )
         if self._stats is not None:
             self._stats.increment("batch_flushes")
+            if placement is not None:
+                self._stats.increment("mesh_flushes")
 
-    def _execute(self, key: BucketKey, slots: List[_Slot], reason: str) -> None:
-        self._observe_flush(key, slots, reason)
+    def _execute(
+        self,
+        key: BucketKey,
+        slots: List[_Slot],
+        reason: str,
+        placement: Optional[Any] = None,
+    ) -> None:
+        self._observe_flush(key, slots, reason, placement)
         tracer = tracing_lib.get_tracer()
+        device_attr = (
+            {"device": placement.label()} if placement is not None else {}
+        )
         with tracer.span(
             "batch_executor.flush",
             bucket=key.label(),
             occupancy=len(slots),
             reason=reason,
+            **device_attr,
         ) as span:
             # Link the flush span and every member's request span both ways:
             # a member trace shows WHICH batch served it, the flush span
@@ -467,13 +651,15 @@ class BatchExecutor:
                 slots[0].action = "sequential"
                 slots[0].event.set()
                 return
-            self._execute_batched(slots)
+            self._execute_batched(slots, placement)
 
     def _increment(self, field: str, amount: int = 1) -> None:
         if self._stats is not None and amount:
             self._stats.increment(field, amount)
 
-    def _execute_batched(self, slots: List[_Slot]) -> None:
+    def _execute_batched(
+        self, slots: List[_Slot], placement: Optional[Any] = None
+    ) -> None:
         # Prepare any slot that arrived into an empty bucket (typically the
         # flush's first member; the rest prepared on their own threads at
         # submit time). Slot-isolated: a study whose encode/RNG work raises
@@ -494,16 +680,35 @@ class BatchExecutor:
         # A lone prepare survivor still goes through the batched program:
         # its RNG draws already happened in batch order, and pad_partial
         # keeps the compiled shape identical either way.
-        pad_to = self.max_batch_size if self.pad_partial else None
+        program = live[0].program
+        # A shardable program on a mesh placement pads at SHARD granularity
+        # (DevicePlacement.pad_to — a multiple of the placement's device
+        # count, so every device holds an equal slice of the study axis)
+        # and receives the placement so it can commit the stacked batch
+        # onto the submesh. Anything else keeps the seed padding contract.
+        shardable = placement is not None and getattr(
+            program, "shardable_batch_axis", ""
+        )
+        if shardable:
+            pad_to = placement.pad_to(len(live), self.max_batch_size)
+        else:
+            pad_to = self.max_batch_size if self.pad_partial else None
         try:
             # Slot 0's resolved program runs the bucket's device body (the
             # bucket key guarantees every slot resolves the same kind; a
             # chaos-wrapped slot 0 therefore poisons the shared program,
             # exercising the whole-batch fallback — the IR-level twin of
             # the old designer.batch_execute dispatch).
-            outputs = live[0].program.device_program(
-                [slot.item for slot in live], pad_to=pad_to
-            )
+            if shardable:
+                outputs = program.device_program(
+                    [slot.item for slot in live],
+                    pad_to=pad_to,
+                    placement=placement,
+                )
+            else:
+                outputs = program.device_program(
+                    [slot.item for slot in live], pad_to=pad_to
+                )
         except BaseException:
             # The shared device program died: every slot retries alone on
             # its own waiting thread (see _complete), errors slot-isolated.
@@ -538,13 +743,34 @@ class BatchExecutor:
         trained + swept once at batch sizes {1, max} (1 warms the sequential
         per-study programs, max the vmapped multi-study programs, which —
         with ``pad_partial`` — is the only batched shape that ever runs).
+        In mesh mode the batched sizes are instead the placements'
+        shard-granularity padding grid (``DevicePlacement.pad_grid``) and
+        each bucket compiles on its sticky-assigned placement — exactly
+        the (shape, placement) pairs live flushes will use.
         First-request latency then pays no XLA compile. Returns one report
         row per (bucket, count, batch_size) with wall seconds.
         """
         from vizier_tpu.designers import quasi_random
         from vizier_tpu.pyvizier import trial as trial_
 
-        sizes = tuple(batch_sizes or (1, self.max_batch_size))
+        if batch_sizes:
+            sizes = tuple(batch_sizes)
+        elif self._placements is not None:
+            # Mesh mode: the batched shapes a placement can flush are its
+            # shard-granularity padding grid (not just {max}); compile all
+            # of them plus the sequential singleton. The per-placement
+            # grids are identical when shard counts are equal (the normal
+            # case), and de-duped otherwise.
+            grid = sorted(
+                {
+                    size
+                    for placement in self._placements
+                    for size in placement.pad_grid(self.max_batch_size)
+                }
+            )
+            sizes = tuple([1] + [s for s in grid if s != 1])
+        else:
+            sizes = (1, self.max_batch_size)
         probe = designer_factory(problem)
         schedule = probe._converter.padding
         report: List[dict] = []
@@ -592,19 +818,39 @@ class BatchExecutor:
                             if any(r is None for r in resolved):
                                 designers[0].suggest(count)
                             else:
-                                program = resolved[0][0]
+                                program, key = resolved[0]
                                 items = [
                                     program.prepare(d, count)
                                     for d in designers
                                 ]
-                                pad_to = (
-                                    self.max_batch_size
-                                    if self.pad_partial
+                                # Compile through the same placement
+                                # assignment + shard-granularity padding
+                                # live flushes of this bucket will use.
+                                placement = (
+                                    self._placement_for(key)
+                                    if self._placements is not None
+                                    and getattr(
+                                        program, "shardable_batch_axis", ""
+                                    )
                                     else None
                                 )
-                                outputs = program.device_program(
-                                    items, pad_to=pad_to
-                                )
+                                if placement is not None:
+                                    outputs = program.device_program(
+                                        items,
+                                        pad_to=placement.pad_to(
+                                            size, self.max_batch_size
+                                        ),
+                                        placement=placement,
+                                    )
+                                else:
+                                    outputs = program.device_program(
+                                        items,
+                                        pad_to=(
+                                            self.max_batch_size
+                                            if self.pad_partial
+                                            else None
+                                        ),
+                                    )
                                 for d, item, out in zip(
                                     designers, items, outputs
                                 ):

@@ -1,0 +1,227 @@
+"""The mesh execution plane: device placements for the batch executor.
+
+The batch executor (``parallel.batch_executor``) fuses N same-bucket
+studies into ONE vmapped XLA program — but until this module, every flush
+ran on ONE device and a single scheduler thread serialized ALL device
+dispatch, so a pod slice served suggestions no faster than one chip. This
+module carves the process's devices into **placements** (submeshes) that
+the executor schedules over:
+
+- **intra-flush sharding** — a flush dispatched to a placement with S > 1
+  devices is sharded over its leading study axis (``NamedSharding`` over
+  a 1-D submesh, composing with the per-restart/per-pool sharding in
+  ``parallel/__init__``): one fused program spans the placement's devices
+  and the padded-slot masking carries over unchanged, just at sharded
+  granularity;
+- **inter-flush concurrency** — DIFFERENT buckets are sticky-assigned to
+  different placements and executed by per-placement worker threads, so
+  concurrent buckets no longer serialize through one scheduler thread;
+- **shard-granularity padding** — a single-device flush always pads to
+  ``max_batch_size`` (one compiled shape per bucket); a mesh placement
+  pads to the next power-of-two multiple of its shard count instead
+  (``pad_to``), so a placement never computes more padded slots than one
+  grid step above its live occupancy. The compiled-shape set per
+  (bucket, placement) is the small fixed grid :meth:`pad_grid` — the
+  jit-stability contract tests pin it.
+
+Placement assignment is sticky (first flush of a bucket picks the least
+loaded placement; every later flush of that bucket reuses it), so each
+bucket compiles on exactly one placement — the prewarm walker compiles
+through the same assignment path.
+
+Everything here is opt-in: ``VIZIER_MESH=0`` (the default) never touches
+``jax.devices()`` and the executor keeps its single-device, bit-identical
+seed behavior. The multi-host coordinator seam (:func:`multihost_mesh`)
+makes a real pod slice a config change: the same ``VIZIER_MESH*`` switches
+plus a coordinator address turn the local device list into the global one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+# All VIZIER_* switches are declared in (and read through) the central
+# registry; enforced by the env_registry analysis pass.
+from vizier_tpu.analysis import registry as _registry
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Knobs for the mesh execution plane (``VIZIER_MESH*``).
+
+    ``enabled=False`` (the default) is the bit-identical single-device
+    seed path: no device enumeration, no worker threads, no sharding.
+    """
+
+    # Master switch: carve devices into placements and run the executor's
+    # per-placement dispatch workers.
+    enabled: bool = False
+    # Devices to use (0 = every device jax reports). Capped at the
+    # process's device count.
+    num_devices: int = 0
+    # Devices per placement submesh. 1 (the default) gives pure placement
+    # concurrency — N single-device placements executing different buckets
+    # concurrently. >1 additionally shards each flush's study axis over
+    # the placement's devices.
+    shard_devices: int = 1
+    # Multi-host coordinator seam (``multihost_mesh``): when set, the
+    # process joins a jax.distributed cluster before building placements,
+    # so a pod slice is config, not code. Empty = single host.
+    coordinator_address: str = ""
+    num_processes: int = 0
+    process_id: int = -1
+
+    @classmethod
+    def from_env(cls) -> "MeshConfig":
+        return cls(
+            enabled=_registry.env_set("VIZIER_MESH"),
+            num_devices=_registry.env_int("VIZIER_MESH_DEVICES", 0),
+            shard_devices=max(
+                1, _registry.env_int("VIZIER_MESH_SHARD_DEVICES", 1)
+            ),
+            coordinator_address=_registry.env_str("VIZIER_MESH_COORDINATOR"),
+            num_processes=_registry.env_int("VIZIER_MESH_PROCESSES", 0),
+            process_id=_registry.env_int("VIZIER_MESH_PROCESS_ID", -1),
+        )
+
+
+class DevicePlacement:
+    """One schedulable device group: a 1-D submesh plus its padding grid.
+
+    The executor's unit of dispatch — each placement owns one worker
+    thread and the buckets sticky-assigned to it. ``shard`` commits a
+    stacked flush pytree onto the submesh (leading study axis sharded
+    over the devices; with one device this is a plain placement pin), so
+    one compiled program exists per (bucket, placement).
+    """
+
+    def __init__(self, index: int, devices: Sequence[Any]):
+        if not devices:
+            raise ValueError("A DevicePlacement needs at least one device.")
+        self.index = index
+        self.devices = tuple(devices)
+        self._sharding = None  # built lazily (needs jax)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    def label(self) -> str:
+        """Low-cardinality metrics/tracing label (one per placement)."""
+        return f"mesh{self.index}"
+
+    def describe(self) -> str:
+        ids = ",".join(str(getattr(d, "id", d)) for d in self.devices)
+        return f"mesh{self.index}[devices {ids}]"
+
+    def batch_sharding(self):
+        """``NamedSharding`` over the leading (study) axis of this
+        placement's 1-D submesh."""
+        if self._sharding is None:
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            mesh = Mesh(np.asarray(self.devices), ("batch",))
+            self._sharding = NamedSharding(mesh, PartitionSpec("batch"))
+        return self._sharding
+
+    def shard(self, tree: Any) -> Any:
+        """Commits a stacked (leading-study-axis) pytree onto the submesh.
+
+        Every stacked leaf carries the batch axis first, so one leading-
+        axis spec covers the whole tree; the executor guarantees the
+        padded batch is a multiple of ``num_devices``.
+        """
+        import jax
+
+        sharding = self.batch_sharding()
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), tree
+        )
+
+    # -- shard-granularity padding -----------------------------------------
+
+    def pad_to(self, occupancy: int, max_batch_size: int) -> int:
+        """The padded batch for ``occupancy`` live slots on this placement.
+
+        Next power-of-two multiple of the shard count, capped at the full
+        bucket shape (``ceil(max_batch_size / S) * S``): every device gets
+        an equal slot count (sharding needs the batch divisible by S) and
+        the flush never computes more than one grid step of padding —
+        unlike the single-device executor's flat pad-to-max, which makes a
+        low-occupancy flush pay for ``max_batch_size`` slots.
+        """
+        s = self.num_devices
+        chunks = max(1, math.ceil(occupancy / s))
+        cap = max(chunks, math.ceil(max_batch_size / s))
+        q = 1
+        while q < chunks:
+            q *= 2
+        return s * min(q, cap)
+
+    def pad_grid(self, max_batch_size: int) -> List[int]:
+        """Every padded batch shape :meth:`pad_to` can produce — the
+        compiled-shape grid the prewarm walker compiles per (bucket,
+        placement) and the jit-stability tests pin."""
+        s = self.num_devices
+        cap = max(1, math.ceil(max_batch_size / s))
+        grid: List[int] = []
+        q = 1
+        while q < cap:
+            grid.append(s * q)
+            q *= 2
+        grid.append(s * cap)
+        return grid
+
+
+def multihost_mesh(config: Optional[MeshConfig] = None):
+    """The multi-host coordinator seam: the device list a pod slice serves
+    flushes over.
+
+    Single host (no coordinator configured): the local device list. With
+    ``coordinator_address`` set (``VIZIER_MESH_COORDINATOR``), the process
+    joins the jax.distributed cluster first — the same explicit-coordinator
+    wiring ``parallel.initialize_multihost`` uses — and the returned list
+    spans every host's devices, so the executor's placements tile the whole
+    pod slice. Placement workers dispatch only buckets assigned to
+    placements containing local devices; remote-spanning placements shard
+    their flushes over DCN exactly like the test-proven global-mesh data
+    plane in ``tests/parallel/test_multihost_explicit.py``.
+    """
+    import jax
+
+    config = config or MeshConfig.from_env()
+    if config.coordinator_address:
+        from vizier_tpu import parallel as parallel_lib
+
+        parallel_lib.initialize_multihost(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes or None,
+            process_id=(
+                config.process_id if config.process_id >= 0 else None
+            ),
+        )
+    return list(jax.devices())
+
+
+def build_placements(config: MeshConfig) -> List[DevicePlacement]:
+    """Carves the (possibly multi-host) device list into placements.
+
+    ``num_devices`` caps how many devices participate; ``shard_devices``
+    groups them into equal submeshes (a trailing remainder group smaller
+    than ``shard_devices`` is dropped rather than compiled as its own
+    odd shape — use divisible counts for full utilization).
+    """
+    devices = multihost_mesh(config)
+    if config.num_devices:
+        devices = devices[: config.num_devices]
+    s = max(1, config.shard_devices)
+    placements = [
+        DevicePlacement(i, devices[start : start + s])
+        for i, start in enumerate(range(0, len(devices) - s + 1, s))
+    ]
+    if not placements:  # fewer devices than one shard group: use them all
+        placements = [DevicePlacement(0, devices)]
+    return placements

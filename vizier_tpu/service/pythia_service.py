@@ -39,6 +39,7 @@ class PythiaServicer:
         serving_config=None,
         reliability_config=None,
         surrogate_config=None,
+        mesh_config=None,
     ):
         from vizier_tpu.serving import runtime as serving_runtime_lib
 
@@ -48,12 +49,16 @@ class PythiaServicer:
         # vizier_tpu.serving.ServingConfig) and ``reliability_config`` (a
         # vizier_tpu.reliability.ReliabilityConfig) disable parts or all of
         # it; ``surrogate_config`` (a vizier_tpu.surrogates.SurrogateConfig)
-        # sets the exact↔sparse auto-switch every GP designer shares.
-        # None -> defaults with env-var overrides.
+        # sets the exact↔sparse auto-switch every GP designer shares;
+        # ``mesh_config`` (a vizier_tpu.parallel.mesh.MeshConfig) carves
+        # the devices into batch-executor placements (VIZIER_MESH*; off =
+        # the single-device seed path). None -> defaults with env-var
+        # overrides.
         self._serving = serving_runtime_lib.ServingRuntime(
             serving_config,
             reliability=reliability_config,
             surrogates=surrogate_config,
+            mesh=mesh_config,
         )
         self._policy_factory = policy_factory or policy_factory_lib.DefaultPolicyFactory(
             serving_runtime=self._serving

@@ -65,6 +65,7 @@ class ServingRuntime:
         observability: Optional[obs_config_lib.ObservabilityConfig] = None,
         surrogates: Optional[surrogate_config_lib.SurrogateConfig] = None,
         speculative: Optional[speculative_lib.SpeculativeConfig] = None,
+        mesh: Optional[Any] = None,  # parallel.mesh.MeshConfig
     ):
         self.config = config or config_lib.ServingConfig.from_env()
         self.observability = (
@@ -115,11 +116,16 @@ class ServingRuntime:
             )
         # Cross-study batch executor: concurrent same-bucket designer
         # computations share ONE vmapped device program. None = batching
-        # off (VIZIER_BATCHING=0): the exact per-study path.
+        # off (VIZIER_BATCHING=0): the exact per-study path. The mesh
+        # execution plane (VIZIER_MESH=1, parallel.mesh.MeshConfig) carves
+        # the process's devices into placements the executor schedules
+        # buckets over; off (the default) = the single-device seed path.
         self.batch_executor = None
         if self.config.batching:
             from vizier_tpu.parallel import batch_executor as batch_executor_lib
+            from vizier_tpu.parallel import mesh as mesh_lib
 
+            self.mesh = mesh or mesh_lib.MeshConfig.from_env()
             self.batch_executor = batch_executor_lib.BatchExecutor(
                 max_batch_size=self.config.batch_max_size,
                 max_wait_ms=self.config.batch_max_wait_ms,
@@ -128,7 +134,10 @@ class ServingRuntime:
                 metrics=(
                     self.metrics if self.observability.metrics_on else None
                 ),
+                mesh=self.mesh,
             )
+        else:
+            self.mesh = mesh
         # Speculative pre-compute pipeline (vizier_tpu.serving.speculative):
         # after each completion, the NEXT suggestion batch is computed in
         # the background and served from the designer-cache entry. Requires

@@ -49,6 +49,7 @@ class ServingStats:
         "batched_suggests",  # slots served from a shared vmapped program
         "batch_fallbacks",  # slots rerun sequentially after a batch failure
         "batch_slot_errors",  # slot-isolated prepare/finalize/NaN failures
+        "mesh_flushes",  # flushes executed on a mesh placement worker
         # Scalable surrogates (vizier_tpu.surrogates).
         "sparse_suggests",  # suggests served by the sparse-GP posterior
         "surrogate_crossovers",  # exact<->sparse auto-switch transitions

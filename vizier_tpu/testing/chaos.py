@@ -162,8 +162,14 @@ class ChaosDesigner(core_lib.Designer):
             raise failing.FailedSuggestError(str(e)) from None
         return self._inner.batch_prepare(count)
 
-    def batch_execute(self, items, pad_to: Optional[int] = None):
+    def batch_execute(
+        self, items, pad_to: Optional[int] = None, placement=None
+    ):
         self._chaos.strike("designer.batch_execute")
+        if placement is not None:
+            return self._inner.batch_execute(
+                items, pad_to=pad_to, placement=placement
+            )
         return self._inner.batch_execute(items, pad_to=pad_to)
 
     def batch_finalize(self, item: dict, output) -> List[trial_.TrialSuggestion]:
@@ -195,6 +201,12 @@ class ChaosProgram:
         self.kind = inner.kind
         self.device_phase = inner.device_phase
         self.surrogate_family = inner.surrogate_family
+        # Mesh shardability is the wrapped program's call: a chaos-wrapped
+        # shardable program keeps executing on its assigned placement, so
+        # device-failure strikes exercise the mesh dispatch path too.
+        self.shardable_batch_axis = getattr(
+            inner, "shardable_batch_axis", ""
+        )
 
     def bucket_key(self, designer, count):
         return self._inner.bucket_key(
@@ -204,8 +216,10 @@ class ChaosProgram:
     def prepare(self, designer, count):
         return designer.batch_prepare(count)
 
-    def device_program(self, items, pad_to: Optional[int] = None):
-        return self._designer.batch_execute(items, pad_to=pad_to)
+    def device_program(self, items, pad_to: Optional[int] = None, placement=None):
+        return self._designer.batch_execute(
+            items, pad_to=pad_to, placement=placement
+        )
 
     def finalize(self, designer, item, output):
         return designer.batch_finalize(item, output)
