@@ -130,6 +130,13 @@ def _mesh_env_config() -> dict:
     return dataclasses.asdict(MeshConfig.from_env())
 
 
+def _slo_env_config() -> dict:
+    """The process-wide VIZIER_SLO* config, for artifact provenance."""
+    from vizier_tpu.observability.slo import SloConfig
+
+    return SloConfig.from_env().as_dict()
+
+
 def main() -> None:
     backend_tag = None
     platforms = os.environ.get("JAX_PLATFORMS", "")
@@ -409,6 +416,11 @@ def main() -> None:
         # compute.registry): artifacts from trees with more/fewer batched
         # designer programs are distinguishable after the fact.
         "compute_programs": _registered_programs(),
+        # Active SLO configuration (observability.slo / VIZIER_SLO*):
+        # bench itself serves no SLO traffic, but an artifact produced
+        # under armed SLOs (the sampler thread + exemplar capture) must be
+        # distinguishable from one produced bare.
+        "slo": _slo_env_config(),
     }
     if backend_tag:
         line["backend"] = backend_tag

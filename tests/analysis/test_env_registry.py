@@ -53,13 +53,15 @@ class TestRealTree:
                 )
 
     def test_registry_covers_the_trees_switch_count(self):
-        # 43 in-tree env switches (incl. the 6 VIZIER_DISTRIBUTED* tier
+        # 54 in-tree env switches (incl. the 6 VIZIER_DISTRIBUTED* tier
         # knobs, the 5 VIZIER_SPARSE* surrogate knobs, the 6
-        # VIZIER_SPECULATIVE* pre-compute knobs, and the 6 VIZIER_MESH*
-        # execution-plane knobs) + 3 bench switches + the 2 reserved grpc
-        # constants. Growing the tree means growing this registry.
-        assert len(registry.SWITCHES) == 48
-        assert len(registry.env_switch_names()) == 46
+        # VIZIER_SPECULATIVE* pre-compute knobs, the 6 VIZIER_MESH*
+        # execution-plane knobs, the 7 VIZIER_SLO* objectives, the 3
+        # VIZIER_FLIGHT_RECORDER* knobs, and VIZIER_OBS_DUMP_DIR) + 3
+        # bench switches + the 2 reserved grpc constants. Growing the
+        # tree means growing this registry.
+        assert len(registry.SWITCHES) == 59
+        assert len(registry.env_switch_names()) == 57
 
     def test_known_switches_declared(self):
         for name in (

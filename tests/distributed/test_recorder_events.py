@@ -1,0 +1,121 @@
+"""ReplicaManager emits structured flight-recorder events (kill/failover/
+revive with successors) and dumps per-replica observability files."""
+
+import pytest
+
+from vizier_tpu.distributed import ReplicaManager
+from vizier_tpu.observability import fleet as fleet_lib
+from vizier_tpu.observability import flight_recorder as recorder_lib
+from vizier_tpu.observability import tracing as tracing_lib
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service.protos import vizier_service_pb2
+from vizier_tpu import pyvizier as vz
+
+
+def _study_config():
+    config = vz.StudyConfig(algorithm="RANDOM_SEARCH")
+    config.search_space.root.add_float_param("x", 0.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+@pytest.fixture
+def recorder():
+    rec = recorder_lib.FlightRecorder()
+    previous = recorder_lib.set_recorder(rec)
+    yield rec
+    recorder_lib.set_recorder(previous)
+
+
+@pytest.fixture
+def manager(tmp_path):
+    mgr = ReplicaManager(3, wal_root=str(tmp_path / "wal"))
+    yield mgr
+    mgr.shutdown()
+
+
+class TestFailoverEvents:
+    def test_kill_failover_revive_timeline(self, recorder, manager):
+        study = "owners/o/studies/recorder-events"
+        manager.stub.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(
+                parent="owners/o",
+                study=pc.study_to_proto(_study_config(), study),
+            )
+        )
+        owner = manager.router.replica_for(study)
+        manager.kill_replica(owner)
+        manager.check_health()
+        manager.revive_replica(owner)
+
+        events = recorder.ring(recorder_lib.FLEET)
+        kinds = [e["kind"] for e in events]
+        assert kinds == ["replica_killed", "replica_failover", "replica_revive"]
+        killed, failover, revive = events
+        assert killed["attributes"]["replica"] == owner
+        # The failover event reconstructs the handoff after the fact:
+        # timestamp, dead replica, its successors, and the study count.
+        assert failover["attributes"]["replica"] == owner
+        assert failover["attributes"]["restored_studies"] == 1
+        successors = failover["attributes"]["successors"]
+        assert successors and owner not in successors
+        assert set(successors) <= set(manager.replica_ids())
+        assert failover["time"] >= killed["time"]
+        assert revive["attributes"]["was_failed_over"] is True
+
+    def test_ram_only_failover_has_no_successors(self, recorder, tmp_path):
+        mgr = ReplicaManager(2, wal_root="")
+        try:
+            owner = mgr.replica_ids()[0]
+            mgr.kill_replica(owner)
+            mgr.check_health()
+            (event,) = recorder.ring(recorder_lib.FLEET)[1:2]
+            assert event["kind"] == "replica_failover"
+            assert event["attributes"]["successors"] == []
+            assert event["attributes"]["restored_studies"] == 0
+        finally:
+            mgr.shutdown()
+
+
+class TestDumpObservability:
+    def test_per_replica_span_split_and_fleet_files(
+        self, recorder, manager, tmp_path
+    ):
+        tracer = tracing_lib.Tracer()
+        previous = tracing_lib.set_tracer(tracer)
+        try:
+            for i in range(2):
+                study = f"owners/o/studies/dump-{i}"
+                manager.stub.CreateStudy(
+                    vizier_service_pb2.CreateStudyRequest(
+                        parent="owners/o",
+                        study=pc.study_to_proto(_study_config(), study),
+                    )
+                )
+                with tracer.span("client.suggest", study=study):
+                    manager.stub.SuggestTrials(
+                        vizier_service_pb2.SuggestTrialsRequest(
+                            parent=study,
+                            suggestion_count=1,
+                            client_id="w",
+                        )
+                    )
+            out = tmp_path / "dump"
+            written = manager.dump_observability(str(out))
+        finally:
+            tracing_lib.set_tracer(previous)
+        loaded = fleet_lib.load_fleet_dir(str(out))
+        # Client spans split from replica-attributed service spans.
+        assert "client" in loaded["spans"]
+        replica_sources = [s for s in loaded["spans"] if s.startswith("replica-")]
+        assert replica_sources, "no replica-attributed spans dumped"
+        for source in replica_sources:
+            for span in loaded["spans"][source]:
+                assert span["attributes"]["replica"] == source
+        assert "fleet" in loaded["metrics"]
+        # A merged trace crosses the client and replica dump files.
+        merged = fleet_lib.merge_spans(loaded["spans"])
+        assert fleet_lib.cross_replica_traces(merged)
+        assert written["spans"]

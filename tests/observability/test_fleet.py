@@ -1,0 +1,150 @@
+"""Fleet aggregation: dump layout, cross-replica merge, failover timeline."""
+
+import json
+
+from vizier_tpu.observability import fleet as fleet_lib
+from vizier_tpu.observability import flight_recorder as recorder_lib
+from vizier_tpu.observability import metrics as metrics_lib
+from vizier_tpu.observability import tracing as tracing_lib
+
+
+def _span(name, trace_id, span_id, parent=None, start=0.0, **attrs):
+    out = {
+        "name": name,
+        "trace_id": trace_id,
+        "span_id": span_id,
+        "parent_id": parent,
+        "start_time": start,
+        "duration_secs": 0.01,
+        "status": "ok",
+    }
+    if attrs:
+        out["attributes"] = attrs
+    return out
+
+
+class TestDumpAndLoad:
+    def test_dump_process_round_trip(self, tmp_path):
+        tracer = tracing_lib.Tracer()
+        previous = tracing_lib.set_tracer(tracer)
+        try:
+            with tracer.span("service.suggest_trials", replica="replica-0"):
+                pass
+        finally:
+            tracing_lib.set_tracer(previous)
+        registry = metrics_lib.MetricsRegistry()
+        registry.gauge("vizier_slo_burn_rate").set(
+            2.0, slo="suggest_p99:pythia", window="60s"
+        )
+        recorder = recorder_lib.FlightRecorder()
+        recorder.record(None, "replica_failover", replica="replica-0",
+                        successors=["replica-1"])
+        written = fleet_lib.dump_process(
+            str(tmp_path), "replica-0", tracer=tracer, registry=registry,
+            recorder=recorder,
+        )
+        assert set(written) == {"spans", "metrics", "recorder"}
+        loaded = fleet_lib.load_fleet_dir(str(tmp_path))
+        assert list(loaded["spans"]) == ["replica-0"]
+        assert loaded["spans"]["replica-0"][0]["name"] == "service.suggest_trials"
+        assert "vizier_slo_burn_rate" in loaded["metrics"]["replica-0"]
+        assert loaded["recorder"]["replica-0"][0]["kind"] == "replica_failover"
+
+    def test_noop_pieces_write_no_files(self, tmp_path):
+        written = fleet_lib.dump_process(
+            str(tmp_path), "r", tracer=tracing_lib.NOOP_TRACER,
+            recorder=recorder_lib.NOOP_RECORDER,
+        )
+        assert written == {}
+
+    def test_write_spans_explicit_list(self, tmp_path):
+        path = fleet_lib.write_spans(
+            str(tmp_path), "client", [_span("client.suggest", "t", "s")]
+        )
+        assert path.endswith("client-spans.jsonl")
+        loaded = fleet_lib.load_fleet_dir(str(tmp_path))
+        assert loaded["spans"]["client"][0]["trace_id"] == "t"
+
+
+class TestMerge:
+    def _sources(self):
+        return {
+            "client": [
+                _span("client.suggest", "trace-a", "c1", start=1.0),
+                _span("client.suggest", "trace-b", "c2", start=2.0),
+            ],
+            "replica-0": [
+                _span("service.suggest_trials", "trace-a", "r1", parent="c1",
+                      start=1.1, replica="replica-0"),
+            ],
+            "replica-1": [
+                _span("service.suggest_trials", "trace-b", "r2", parent="c2",
+                      start=2.1, replica="replica-1"),
+                _span("service.complete_trial", "trace-c", "r3", start=3.0),
+            ],
+        }
+
+    def test_merge_stamps_source_and_orders(self):
+        merged = fleet_lib.merge_spans(self._sources())
+        assert [s["source"] for s in merged] == [
+            "client", "replica-0", "client", "replica-1", "replica-1",
+        ]
+
+    def test_cross_replica_traces(self):
+        crossing = fleet_lib.cross_replica_traces(
+            fleet_lib.merge_spans(self._sources())
+        )
+        by_id = {row["trace_id"]: row for row in crossing}
+        # trace-a and trace-b each span two sources; trace-c is local-only.
+        assert set(by_id) == {"trace-a", "trace-b"}
+        assert by_id["trace-a"]["sources"] == ["client", "replica-0"]
+        assert by_id["trace-b"]["spans"] == 2
+
+    def test_fleet_report_end_to_end(self, tmp_path):
+        for source, spans in self._sources().items():
+            fleet_lib.write_spans(str(tmp_path), source, spans)
+        recorder = recorder_lib.FlightRecorder()
+        recorder.record(None, "replica_killed", replica="replica-1")
+        recorder.record(None, "replica_failover", replica="replica-1",
+                        successors=["replica-0"], restored_studies=2)
+        recorder.dump_json(str(tmp_path / ("fleet" + fleet_lib.RECORDER_SUFFIX)))
+        registry = metrics_lib.MetricsRegistry()
+        registry.gauge("vizier_slo_breached").set(1.0, slo="suggest_p99:pythia")
+        with open(tmp_path / ("fleet" + fleet_lib.METRICS_SUFFIX), "w") as f:
+            json.dump(registry.snapshot(), f)
+
+        report = fleet_lib.fleet_report(str(tmp_path))
+        assert report["sources"] == ["client", "replica-0", "replica-1"]
+        assert report["spans"] == 5 and report["traces"] == 3
+        assert report["cross_replica_traces"] == 2
+        timeline = report["failover_timeline"]
+        assert [e["kind"] for e in timeline] == [
+            "replica_killed", "replica_failover",
+        ]
+        assert timeline[1]["successors"] == ["replica-0"]
+        assert "vizier_slo_breached" in report["slo"]
+        rendered = fleet_lib.render_fleet_report(report)
+        assert "replica_failover" in rendered
+        assert "2 cross-replica" in rendered
+
+    def test_merged_trace_lookup(self, tmp_path):
+        for source, spans in self._sources().items():
+            fleet_lib.write_spans(str(tmp_path), source, spans)
+        trace = fleet_lib.merged_trace(str(tmp_path), "trace-a")
+        assert [s["source"] for s in trace] == ["client", "replica-0"]
+
+
+class TestTimeline:
+    def test_non_timeline_kinds_excluded(self):
+        events = {
+            "fleet": [
+                {"time": 1.0, "kind": "suggest", "study": "s"},
+                {"time": 2.0, "kind": "replica_revive", "study": "<fleet>",
+                 "attributes": {"replica": "replica-0"}},
+                {"time": 0.5, "kind": "slo_breach", "study": "<fleet>",
+                 "attributes": {"slos": ["suggest_p99:pythia"]}},
+            ]
+        }
+        timeline = fleet_lib.failover_timeline(events)
+        assert [e["kind"] for e in timeline] == ["slo_breach", "replica_revive"]
+        assert timeline[1]["replica"] == "replica-0"

@@ -1,0 +1,131 @@
+"""Flight recorder: rings, bounds, trace correlation, global accessor."""
+
+import json
+
+import pytest
+
+from vizier_tpu.observability import flight_recorder as recorder_lib
+from vizier_tpu.observability import tracing as tracing_lib
+
+
+class TestRecording:
+    def test_events_land_in_the_study_ring(self):
+        rec = recorder_lib.FlightRecorder()
+        rec.record("s1", "suggest", trace_id="t1", duration_secs=0.01)
+        rec.record("s1", "complete", trace_id="t2", trial="s1/trials/1")
+        rec.record("s2", "suggest", trace_id="t3")
+        ring = rec.ring("s1")
+        assert [e["kind"] for e in ring] == ["suggest", "complete"]
+        assert ring[0]["trace_id"] == "t1"
+        assert ring[0]["attributes"]["duration_secs"] == 0.01
+        assert ring[0]["time"] <= ring[1]["time"]
+        assert rec.studies() == ["s1", "s2"]
+
+    def test_none_study_is_the_fleet_pseudo_study(self):
+        rec = recorder_lib.FlightRecorder()
+        rec.record(None, "replica_failover", replica="replica-0",
+                   successors=["replica-1"])
+        (event,) = rec.ring(recorder_lib.FLEET)
+        assert event["attributes"]["successors"] == ["replica-1"]
+
+    def test_ring_is_bounded_oldest_first_out(self):
+        rec = recorder_lib.FlightRecorder(ring_size=3)
+        for i in range(5):
+            rec.record("s", "suggest", trace_id=f"t{i}")
+        assert [e["trace_id"] for e in rec.ring("s")] == ["t2", "t3", "t4"]
+
+    def test_study_population_is_lru_bounded(self):
+        rec = recorder_lib.FlightRecorder(max_studies=2)
+        rec.record("a", "suggest", trace_id="x")
+        rec.record("b", "suggest", trace_id="x")
+        rec.record("a", "suggest", trace_id="x")  # refresh a
+        rec.record("c", "suggest", trace_id="x")  # evicts b, not a
+        assert set(rec.studies()) == {"a", "c"}
+
+    def test_ambient_trace_id_captured(self):
+        tracer = tracing_lib.Tracer()
+        previous = tracing_lib.set_tracer(tracer)
+        try:
+            rec = recorder_lib.FlightRecorder()
+            with tracer.span("request") as span:
+                rec.record("s", "suggest")
+            (event,) = rec.ring("s")
+            assert event["trace_id"] == span.trace_id
+        finally:
+            tracing_lib.set_tracer(previous)
+
+    def test_events_filter_and_order(self):
+        rec = recorder_lib.FlightRecorder()
+        rec.record("s1", "suggest", trace_id="a")
+        rec.record("s2", "complete", trace_id="b")
+        rec.record("s1", "complete", trace_id="c")
+        assert [e["trace_id"] for e in rec.events(kind="complete")] == ["b", "c"]
+        assert len(rec.events()) == 3
+
+    def test_invalidate_drops_the_ring(self):
+        rec = recorder_lib.FlightRecorder()
+        rec.record("s", "suggest", trace_id="x")
+        assert rec.invalidate("s") is True
+        assert rec.ring("s") == []
+        assert rec.invalidate("s") is False
+
+    def test_dump_json_round_trip(self, tmp_path):
+        rec = recorder_lib.FlightRecorder()
+        rec.record("s", "suggest", trace_id="x")
+        rec.record(None, "slo_breach", slos=["suggest_p99:pythia"])
+        path = tmp_path / "recorder.json"
+        assert rec.dump_json(str(path)) == 2
+        loaded = json.loads(path.read_text())
+        assert [e["kind"] for e in loaded] == ["suggest", "slo_breach"]
+
+    def test_snapshot_is_json_ready(self):
+        rec = recorder_lib.FlightRecorder()
+        rec.record("s", "batch_flush", members=["t1", "t2"], occupancy=2)
+        json.dumps(rec.snapshot())  # must not raise
+
+
+class TestNoopAndGlobal:
+    def test_noop_recorder_absorbs_everything(self):
+        rec = recorder_lib.NOOP_RECORDER
+        rec.record("s", "suggest", trace_id="x")
+        assert rec.ring("s") == []
+        assert rec.events() == []
+        assert rec.snapshot() == {}
+        assert rec.enabled is False
+
+    def test_default_env_yields_noop(self, monkeypatch):
+        monkeypatch.delenv("VIZIER_FLIGHT_RECORDER", raising=False)
+        previous = recorder_lib.set_recorder(None)
+        try:
+            assert recorder_lib.get_recorder() is recorder_lib.NOOP_RECORDER
+        finally:
+            recorder_lib.set_recorder(previous)
+
+    def test_env_armed_yields_real_recorder(self, monkeypatch):
+        monkeypatch.setenv("VIZIER_FLIGHT_RECORDER", "1")
+        monkeypatch.setenv("VIZIER_FLIGHT_RECORDER_RING", "7")
+        previous = recorder_lib.set_recorder(None)
+        try:
+            rec = recorder_lib.get_recorder()
+            assert isinstance(rec, recorder_lib.FlightRecorder)
+            assert rec.enabled is True
+            config = recorder_lib.FlightRecorderConfig.from_env()
+            assert config.enabled and config.ring_size == 7
+        finally:
+            recorder_lib.set_recorder(previous)
+
+    def test_set_recorder_returns_previous(self):
+        mine = recorder_lib.FlightRecorder()
+        previous = recorder_lib.set_recorder(mine)
+        try:
+            assert recorder_lib.get_recorder() is mine
+        finally:
+            recorder_lib.set_recorder(previous)
+
+
+class TestConfig:
+    def test_defaults(self):
+        config = recorder_lib.FlightRecorderConfig()
+        assert not config.enabled
+        assert config.ring_size == 256 and config.max_studies == 1024
+        assert config.as_dict()["ring_size"] == 256

@@ -4,6 +4,10 @@ import json
 import pathlib
 import sys
 
+from vizier_tpu.observability import fleet as fleet_lib
+from vizier_tpu.observability import flight_recorder as recorder_lib
+from vizier_tpu.observability import metrics as metrics_lib
+from vizier_tpu.observability import slo as slo_lib
 from vizier_tpu.observability import tracing as tracing_lib
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2] / "tools"))
@@ -144,6 +148,122 @@ class TestSpeculativeActivity:
         )
         assert act["hit"] == act["miss"] == act["precomputes"] == 0
         assert act["hit_rate"] == 0.0
+
+
+def _armed_registry():
+    """A registry that has been through one real SLO evaluation."""
+    registry = metrics_lib.MetricsRegistry()
+    hist = registry.histogram("vizier_suggest_latency_seconds")
+    for _ in range(9):
+        hist.observe(0.001, hop="pythia")
+    hist.observe(0.9, trace_id="t-slow", hop="pythia")
+    engine = slo_lib.SloEngine(
+        slo_lib.SloConfig(
+            enabled=True, windows=(5.0,), min_samples=1, suggest_p99_ms=25.0
+        ),
+        registry,
+        recorder=recorder_lib.FlightRecorder(),
+    )
+    engine.evaluate()
+    return registry
+
+
+class TestSloActivity:
+    def test_round_trip_from_fresh_metrics_dump(self, tmp_path):
+        # The full path every future PR must keep working: armed engine ->
+        # registry snapshot -> JSON file -> load_metrics -> slo_activity.
+        registry = _armed_registry()
+        path = tmp_path / "metrics.json"
+        path.write_text(registry.dump_json())
+        slo = obs_report.slo_activity(obs_report.load_metrics(str(path)))
+        assert slo["armed"] is True
+        assert slo["evaluations"] == 1
+        assert "suggest_p99:pythia" in slo["breached"]
+        assert slo["burn_rates"]["suggest_p99:pythia"]["5s"] >= 5.0
+        assert slo["values"]["suggest_p99:pythia"]["5s"] > 0.025
+        rendered = obs_report.render_slo(slo)
+        assert "BREACHED" in rendered and "suggest_p99:pythia" in rendered
+
+    def test_unarmed_dump(self, tmp_path):
+        registry = metrics_lib.MetricsRegistry()
+        registry.counter("vizier_serving_fallbacks").inc()
+        path = tmp_path / "metrics.json"
+        path.write_text(registry.dump_json())
+        slo = obs_report.slo_activity(obs_report.load_metrics(str(path)))
+        assert slo["armed"] is False and slo["breached"] == []
+        assert "not armed" in obs_report.render_slo(slo)
+
+    def test_label_parser(self):
+        labels = obs_report._parse_label_str(
+            '{slo="suggest_p99:pythia",window="60s"}'
+        )
+        assert labels == {"slo": "suggest_p99:pythia", "window": "60s"}
+
+
+class TestFleetSection:
+    def _dump_dir(self, tmp_path):
+        for source, spans in {
+            "client": [
+                {"name": "client.suggest", "trace_id": "t1", "span_id": "c",
+                 "parent_id": None, "start_time": 1.0, "duration_secs": 0.2},
+            ],
+            "replica-0": [
+                {"name": "service.suggest_trials", "trace_id": "t1",
+                 "span_id": "s", "parent_id": "c", "start_time": 1.1,
+                 "duration_secs": 0.1},
+            ],
+        }.items():
+            fleet_lib.write_spans(str(tmp_path), source, spans)
+        recorder = recorder_lib.FlightRecorder()
+        recorder.record(None, "replica_failover", replica="replica-0",
+                        successors=["replica-1"])
+        recorder.dump_json(
+            str(tmp_path / ("fleet" + fleet_lib.RECORDER_SUFFIX))
+        )
+        return str(tmp_path)
+
+    def test_fleet_section_from_fresh_dump(self, tmp_path):
+        section = obs_report.fleet_section(self._dump_dir(tmp_path))
+        assert section["sources"] == ["client", "replica-0"]
+        assert section["cross_replica_traces"] == 1
+        assert section["failover_timeline"][0]["kind"] == "replica_failover"
+
+    def test_json_report_schema_is_stable(self, tmp_path, capsys, monkeypatch):
+        """Guards the --json contract: device_activity,
+        speculative_activity, slo, and fleet sections must all parse from
+        freshly-dumped span/metric files."""
+        span_path = _trace_file(tmp_path)
+        metrics_path = tmp_path / "metrics.json"
+        metrics_path.write_text(_armed_registry().dump_json())
+        dump_dir = self._dump_dir(tmp_path / "fleet")
+        monkeypatch.setattr(
+            sys, "argv",
+            ["obs_report.py", span_path, "--json",
+             "--slo", str(metrics_path), "--fleet", dump_dir],
+        )
+        obs_report.main()
+        report = json.loads(capsys.readouterr().out)
+        assert {
+            "spans", "surrogate_activity", "speculative_activity",
+            "program_kind_activity", "device_activity", "slo", "fleet",
+            "phases",
+        } <= set(report)
+        assert report["spans"] == 6
+        assert report["slo"]["armed"] is True
+        assert report["slo"]["burn_rates"]["suggest_p99:pythia"]["5s"] >= 5.0
+        assert report["fleet"]["cross_replica_traces"] == 1
+        assert report["device_activity"] == {}
+        assert report["speculative_activity"]["hit"] == 0
+
+    def test_json_report_without_slo_or_fleet_keeps_keys(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        monkeypatch.setattr(
+            sys, "argv", ["obs_report.py", _trace_file(tmp_path), "--json"]
+        )
+        obs_report.main()
+        report = json.loads(capsys.readouterr().out)
+        assert report["slo"] is None and report["fleet"] is None
 
 
 class TestDeviceActivity:

@@ -1,0 +1,294 @@
+"""SLO engine: windowed burn rates, gauges, breach-triggered black box."""
+
+import json
+
+import pytest
+
+from vizier_tpu.observability import flight_recorder as recorder_lib
+from vizier_tpu.observability import metrics as metrics_lib
+from vizier_tpu.observability import slo as slo_lib
+from vizier_tpu.observability import tracing as tracing_lib
+
+
+def _engine(registry, **overrides):
+    base = dict(
+        enabled=True, windows=(5.0,), min_samples=1, eval_interval_s=0.0
+    )
+    base.update(overrides)
+    return slo_lib.SloEngine(
+        slo_lib.SloConfig(**base),
+        registry,
+        recorder=recorder_lib.FlightRecorder(),
+    )
+
+
+def _by_slo(statuses, window=None):
+    out = {}
+    for status in statuses:
+        if window is None or status.window_secs == window:
+            out[status.slo] = status
+    return out
+
+
+class TestConfig:
+    def test_window_parsing(self):
+        assert slo_lib._parse_windows("60,300") == (60.0, 300.0)
+        assert slo_lib._parse_windows(" 10 , junk, 20 ") == (10.0, 20.0)
+        # Garbage degrades to the defaults, never to an empty set.
+        assert slo_lib._parse_windows("") == (60.0, 300.0)
+
+    def test_from_env_defaults_off(self, monkeypatch):
+        for name in (
+            "VIZIER_SLO", "VIZIER_SLO_WINDOWS", "VIZIER_SLO_SUGGEST_P99_MS"
+        ):
+            monkeypatch.delenv(name, raising=False)
+        config = slo_lib.SloConfig.from_env()
+        assert not config.enabled
+        assert config.windows == (60.0, 300.0)
+        assert config.as_dict()["suggest_p99_ms"] == 5000.0
+
+    def test_from_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("VIZIER_SLO", "1")
+        monkeypatch.setenv("VIZIER_SLO_WINDOWS", "7,11")
+        monkeypatch.setenv("VIZIER_SLO_SUGGEST_P99_MS", "42.5")
+        monkeypatch.setenv("VIZIER_SLO_DUMP_DIR", "/tmp/x")
+        config = slo_lib.SloConfig.from_env()
+        assert config.enabled and config.windows == (7.0, 11.0)
+        assert config.suggest_p99_ms == 42.5 and config.dump_dir == "/tmp/x"
+
+
+class TestLatencyObjective:
+    def test_healthy_traffic_does_not_breach(self):
+        registry = metrics_lib.MetricsRegistry()
+        hist = registry.histogram("vizier_suggest_latency_seconds")
+        for _ in range(50):
+            hist.observe(0.002, hop="pythia")
+        engine = _engine(registry, suggest_p99_ms=25.0)
+        status = _by_slo(engine.evaluate())["suggest_p99:pythia"]
+        assert status.total == 50 and not status.breached
+        assert status.burn_rate == 0.0
+        assert status.value is not None and status.value < 0.025
+
+    def test_slow_tail_breaches_and_exports_gauges(self):
+        registry = metrics_lib.MetricsRegistry()
+        hist = registry.histogram("vizier_suggest_latency_seconds")
+        for _ in range(45):
+            hist.observe(0.002, hop="pythia")
+        for _ in range(5):  # 10% above threshold >> the 1% budget
+            hist.observe(0.5, hop="pythia")
+        engine = _engine(registry, suggest_p99_ms=25.0)
+        status = _by_slo(engine.evaluate())["suggest_p99:pythia"]
+        assert status.breached and status.burn_rate >= 5.0
+        burn = registry.get("vizier_slo_burn_rate")
+        assert burn.value(slo="suggest_p99:pythia", window="5s") >= 5.0
+        breached = registry.get("vizier_slo_breached")
+        assert breached.value(slo="suggest_p99:pythia") == 1.0
+
+    def test_per_hop_objectives_are_independent(self):
+        registry = metrics_lib.MetricsRegistry()
+        hist = registry.histogram("vizier_suggest_latency_seconds")
+        for _ in range(20):
+            hist.observe(0.001, hop="service")
+            hist.observe(0.5, hop="pythia")
+        statuses = _by_slo(_engine(registry, suggest_p99_ms=25.0).evaluate())
+        assert statuses["suggest_p99:pythia"].breached
+        assert not statuses["suggest_p99:service"].breached
+
+
+class TestRatioObjectives:
+    def test_hit_rate_skipped_without_speculative_traffic(self):
+        registry = metrics_lib.MetricsRegistry()
+        status = _by_slo(_engine(registry).evaluate())["speculative_hit_rate"]
+        assert status.value is None and not status.breached
+
+    def test_hit_rate_breaches_below_target(self):
+        registry = metrics_lib.MetricsRegistry()
+        registry.counter("vizier_serving_speculative_hits").inc(5)
+        registry.counter("vizier_serving_speculative_misses").inc(5)
+        engine = _engine(registry, speculative_hit_rate=0.8)
+        status = _by_slo(engine.evaluate())["speculative_hit_rate"]
+        assert status.value == 0.5
+        assert status.breached  # 50% bad vs 20% allowed -> burn 2.5
+        assert status.burn_rate == pytest.approx(2.5)
+
+    def test_fallback_rate_over_pythia_volume(self):
+        registry = metrics_lib.MetricsRegistry()
+        hist = registry.histogram("vizier_suggest_latency_seconds")
+        for _ in range(20):
+            hist.observe(0.001, hop="pythia")
+        registry.counter("vizier_serving_fallbacks").inc(4)
+        engine = _engine(registry, fallback_rate=0.05)
+        status = _by_slo(engine.evaluate())["reliability_fallback_rate"]
+        assert status.value == pytest.approx(0.2)
+        assert status.breached and status.burn_rate == pytest.approx(4.0)
+
+
+class TestFleetObjectives:
+    def test_occupancy_floor(self):
+        registry = metrics_lib.MetricsRegistry()
+        occ = registry.histogram(
+            "vizier_batch_occupancy",
+            buckets=metrics_lib.exponential_buckets(1, 2, 5),
+        )
+        for _ in range(10):
+            occ.observe(1.0, bucket="b")
+        engine = _engine(registry, occupancy_min=4.0)
+        status = _by_slo(engine.evaluate())["batch_occupancy_mean"]
+        assert status.value == pytest.approx(1.0)
+        assert status.breached
+
+    def test_mesh_balance_and_utilization_gauges(self):
+        registry = metrics_lib.MetricsRegistry()
+        flushes = registry.counter("vizier_batch_flushes")
+        flushes.inc(30, reason="full", device="mesh0")
+        flushes.inc(2, reason="full", device="mesh1")
+        engine = _engine(registry, mesh_imbalance_max=4.0)
+        status = _by_slo(engine.evaluate())["mesh_utilization_balance"]
+        assert status.value == pytest.approx(15.0)
+        assert status.breached
+        util = registry.get("vizier_slo_mesh_utilization")
+        assert util.value(device="mesh0") == pytest.approx(30 / 32)
+
+    def test_single_placement_is_skipped(self):
+        registry = metrics_lib.MetricsRegistry()
+        registry.counter("vizier_batch_flushes").inc(30, device="mesh0")
+        status = _by_slo(_engine(registry).evaluate())[
+            "mesh_utilization_balance"
+        ]
+        assert status.value is None and not status.breached
+
+
+class TestWindows:
+    def test_old_traffic_falls_out_of_the_window(self):
+        registry = metrics_lib.MetricsRegistry()
+        hist = registry.histogram("vizier_suggest_latency_seconds")
+        engine = _engine(registry, suggest_p99_ms=25.0, windows=(10.0,))
+        for _ in range(20):  # the regression, at t=0
+            hist.observe(0.5, hop="pythia")
+        assert _by_slo(engine.evaluate(now=1000.0))[
+            "suggest_p99:pythia"
+        ].breached
+        for _ in range(50):  # recovery traffic
+            hist.observe(0.001, hop="pythia")
+        engine.evaluate(now=1005.0)
+        # 11s later the slow burst predates the 10s window baseline.
+        status = _by_slo(engine.evaluate(now=1011.0))["suggest_p99:pythia"]
+        assert not status.breached
+        assert status.total == 50
+
+    def test_min_samples_gates_breaching(self):
+        registry = metrics_lib.MetricsRegistry()
+        hist = registry.histogram("vizier_suggest_latency_seconds")
+        hist.observe(0.5, hop="pythia")
+        engine = _engine(registry, suggest_p99_ms=25.0, min_samples=5)
+        status = _by_slo(engine.evaluate())["suggest_p99:pythia"]
+        assert not status.breached and status.burn_rate is None
+
+
+class TestBreachHandling:
+    def _breach_engine(self, tmp_path, recorder=None):
+        registry = metrics_lib.MetricsRegistry()
+        hist = registry.histogram("vizier_suggest_latency_seconds")
+        for _ in range(9):
+            hist.observe(0.001, hop="pythia")
+        hist.observe(0.9, trace_id="breach-trace", hop="pythia")
+        engine = slo_lib.SloEngine(
+            slo_lib.SloConfig(
+                enabled=True,
+                windows=(5.0,),
+                min_samples=1,
+                suggest_p99_ms=25.0,
+                dump_dir=str(tmp_path),
+                breach_cooldown_s=1e6,
+            ),
+            registry,
+            recorder=recorder or recorder_lib.FlightRecorder(),
+        )
+        return engine, registry
+
+    def test_blackbox_dump_contents(self, tmp_path):
+        tracer = tracing_lib.Tracer()
+        previous = tracing_lib.set_tracer(tracer)
+        try:
+            recorder = recorder_lib.FlightRecorder()
+            recorder.record("s1", "suggest", trace_id="breach-trace")
+            engine, _ = self._breach_engine(tmp_path, recorder=recorder)
+            engine.evaluate()
+            assert len(engine.dumps) == 1
+            payload = json.loads(open(engine.dumps[0]).read())
+            assert payload["version"] == 1
+            slos = {s["slo"] for s in payload["breaching"]}
+            assert "suggest_p99:pythia" in slos
+            exemplars = payload["exemplars"]["pythia"]
+            assert exemplars[0]["trace_id"] == "breach-trace"
+            assert "breach-trace" in payload["exemplar_traces"]
+            assert payload["flight_recorder"]["s1"][0]["kind"] == "suggest"
+            assert "vizier_suggest_latency_seconds" in payload["metrics"]
+            # The breach itself landed on the recorder's fleet ring.
+            kinds = [e["kind"] for e in recorder.events(kind="slo_breach")]
+            assert kinds == ["slo_breach"]
+        finally:
+            tracing_lib.set_tracer(previous)
+
+    def test_cooldown_suppresses_repeat_dumps(self, tmp_path):
+        engine, _ = self._breach_engine(tmp_path)
+        engine.evaluate()
+        engine.evaluate()
+        assert len(engine.dumps) == 1
+
+    def test_no_dump_dir_still_records_the_breach(self):
+        registry = metrics_lib.MetricsRegistry()
+        hist = registry.histogram("vizier_suggest_latency_seconds")
+        hist.observe(0.9, hop="pythia")
+        recorder = recorder_lib.FlightRecorder()
+        engine = slo_lib.SloEngine(
+            slo_lib.SloConfig(
+                enabled=True, windows=(5.0,), min_samples=1,
+                suggest_p99_ms=25.0,
+            ),
+            registry,
+            recorder=recorder,
+        )
+        engine.evaluate()
+        assert engine.dumps == []
+        assert recorder.events(kind="slo_breach")
+
+
+class TestRuntimeIntegration:
+    def test_runtime_unarmed_by_default(self):
+        from vizier_tpu.serving import runtime as runtime_lib
+
+        runtime = runtime_lib.ServingRuntime()
+        try:
+            assert runtime.slo_engine is None
+            assert runtime.slo_report() == {"armed": False}
+        finally:
+            runtime.shutdown()
+
+    def test_runtime_armed_reports_and_shuts_down(self):
+        import threading
+
+        from vizier_tpu.serving import runtime as runtime_lib
+
+        before = set(threading.enumerate())
+        runtime = runtime_lib.ServingRuntime(
+            slo=slo_lib.SloConfig(
+                enabled=True, windows=(5.0,), eval_interval_s=0.01
+            )
+        )
+        try:
+            assert runtime.slo_engine is not None
+            runtime.observe_suggest_latency("pythia", 0.001, trace_id="t")
+            report = runtime.slo_report()
+            assert report["armed"] is True
+            assert any(
+                s["slo"] == "suggest_p99:pythia" for s in report["statuses"]
+            )
+        finally:
+            runtime.shutdown()
+        leaked = [
+            t
+            for t in set(threading.enumerate()) - before
+            if t.name == "vizier-slo-eval" and t.is_alive()
+        ]
+        assert not leaked

@@ -4,6 +4,7 @@ separation from exact studies, slot parity, chaos isolation, prewarm."""
 import threading
 
 import numpy as np
+import pytest
 
 from vizier_tpu import pyvizier as vz
 from vizier_tpu.algorithms import core as core_lib
@@ -147,6 +148,11 @@ class TestSparseBatchedParity:
         assert batched[0].sparse_inducing_state() is not None
         assert batched[0]._warm_is_trained
 
+    # ~26 s end-to-end soak on a 1-core box; the per-kind slot parity it
+    # composes is asserted directly by the faster tests in this class, so
+    # the mixed-traffic composition rides the slow tier (tier-1 timing,
+    # ROADMAP.md).
+    @pytest.mark.slow
     def test_mixed_workload_end_to_end(self):
         # 2 exact + 2 sparse studies submitted concurrently: each kind
         # fuses into its own flush, and every slot matches its sequential

@@ -421,6 +421,287 @@ def run_mesh_executor_arm(
     }
 
 
+class _SlowSuggestDesigner:
+    """Wraps a designer so every ``slow_every``-th suggest stalls — the
+    induced latency regression the SLO soak must catch as a p99 breach.
+    ``tick`` is a shared per-study counter held by the factory: policies
+    are rebuilt per request, so the cadence must outlive the instance."""
+
+    def __init__(self, designer, tick, slow_every: int, delay_secs: float):
+        self._designer = designer
+        self._tick = tick
+        self._slow_every = max(1, slow_every)
+        self._delay_secs = delay_secs
+
+    def __getattr__(self, name):
+        return getattr(self._designer, name)
+
+    def suggest(self, count=None):
+        if self._tick() % self._slow_every == 0:
+            time.sleep(self._delay_secs)
+        return self._designer.suggest(count)
+
+
+class _SlowChaosPolicyFactory:
+    def __init__(self, monkey: chaos.ChaosMonkey, slow_every: int, delay_secs: float):
+        import threading
+
+        self._monkey = monkey
+        self._slow_every = slow_every
+        self._delay_secs = delay_secs
+        self._lock = threading.Lock()
+        self._counts: dict = {}
+
+    def _tick(self, study_name: str) -> int:
+        with self._lock:
+            self._counts[study_name] = self._counts.get(study_name, 0) + 1
+            return self._counts[study_name]
+
+    def __call__(self, problem, algorithm, supporter, study_name):
+        return designer_policy.DesignerPolicy(
+            supporter,
+            chaos.chaos_designer_factory(
+                lambda p, **kw: _SlowSuggestDesigner(
+                    random_designer.RandomDesigner(p.search_space, seed=0),
+                    tick=lambda: self._tick(study_name),
+                    slow_every=self._slow_every,
+                    delay_secs=self._delay_secs,
+                ),
+                self._monkey,
+            ),
+        )
+
+
+def run_slo_soak_arm(
+    *,
+    trials: int,
+    seed: int,
+    fault_prob: float,
+    reliability: ReliabilityConfig,
+    num_replicas: int,
+    kill_at: int,
+    out_dir: str,
+    p99_threshold_ms: float = 25.0,
+    slow_every: int = 5,
+    delay_secs: float = 0.12,
+) -> dict:
+    """SLOs armed + flight recorder on, over a 2-study / N-replica tier.
+
+    Induces a latency breach (every ``slow_every``-th suggest stalls
+    ``delay_secs`` — far past the ``p99_threshold_ms`` objective), kills
+    the first study's owning replica mid-run, then checks the whole
+    observability plane end to end: the breach produced a black-box dump
+    whose exemplar trace_ids resolve to complete traces in the merged
+    per-replica span dumps, and the fleet merge stitches cross-source
+    traces plus the failover timeline from the recorder events.
+    """
+    import tempfile
+
+    from vizier_tpu.distributed import ReplicaManager
+    from vizier_tpu.observability import fleet as fleet_lib
+    from vizier_tpu.observability import flight_recorder as recorder_lib
+    from vizier_tpu.observability import tracing as tracing_lib
+
+    import unittest.mock
+
+    os.makedirs(out_dir, exist_ok=True)
+    env_overrides = {
+        "VIZIER_SLO": "1",
+        # Short fast window + a long one; manual evaluation cadence keeps
+        # the soak deterministic on loaded CI machines.
+        "VIZIER_SLO_WINDOWS": "10,120",
+        "VIZIER_SLO_EVAL_INTERVAL_S": "0",
+        "VIZIER_SLO_SUGGEST_P99_MS": str(p99_threshold_ms),
+        "VIZIER_SLO_DUMP_DIR": out_dir,
+        "VIZIER_FLIGHT_RECORDER": "1",
+    }
+    # patch.dict restores the environment on exit (no hand-rolled
+    # save/restore — environ reads stay literal for the env_registry pass).
+    env_patch = unittest.mock.patch.dict(os.environ, env_overrides)
+    env_patch.start()
+    # Fresh global tracer + recorder so the soak's artifacts are self-
+    # contained (and the recorder re-derives as ENABLED from the env).
+    prev_tracer = tracing_lib.set_tracer(tracing_lib.Tracer(max_spans=65536))
+    prev_recorder = recorder_lib.set_recorder(None)
+    manager = None
+    try:
+        monkey = chaos.ChaosMonkey(seed=seed, failure_prob=fault_prob)
+        wal_root = tempfile.mkdtemp(prefix="vizier-slo-wal-")
+        manager = ReplicaManager(
+            num_replicas,
+            wal_root=wal_root,
+            policy_factory=_SlowChaosPolicyFactory(monkey, slow_every, delay_secs),
+            reliability_config=reliability,
+        )
+        runtime = manager.pythia.serving_runtime
+        assert runtime.slo_engine is not None, "SLO engine failed to arm"
+
+        # Two studies owned by two DIFFERENT replicas, so the merged span
+        # dump covers >= 2 replica sources.
+        studies = []
+        owners = set()
+        i = 0
+        while len(studies) < 2 and i < 1000:
+            name = f"owners/chaos/studies/slo-{i}"
+            i += 1
+            owner = manager.router.replica_for(name)
+            if owner not in owners:
+                owners.add(owner)
+                studies.append((name, owner))
+        clients = {}
+        for study_name, _owner in studies:
+            manager.stub.CreateStudy(
+                vizier_service_pb2.CreateStudyRequest(
+                    parent="owners/chaos",
+                    study=pc.study_to_proto(_study_config(), study_name),
+                )
+            )
+            clients[study_name] = vizier_client.VizierClient(
+                chaos.ChaosServiceStub(manager.stub, monkey),
+                study_name,
+                "chaos-worker",
+                reliability=reliability,
+            )
+
+        killed_replica = studies[0][1]
+        completed = 0
+        start = time.perf_counter()
+        for t in range(trials):
+            if t == kill_at:
+                manager.kill_replica(killed_replica)
+            study_name, _ = studies[t % len(studies)]
+            client = clients[study_name]
+            (trial,) = client.get_suggestions(1)
+            client.complete_trial(
+                trial.id, vz.Measurement(metrics={"obj": 0.01 * t})
+            )
+            completed += 1
+            if (t + 1) % 10 == 0:
+                runtime.slo_engine.evaluate()
+        elapsed = time.perf_counter() - start
+        slo_report = runtime.slo_report()
+
+        # Fleet dump: per-replica span files split from the shared ring,
+        # plus the registry snapshot and recorder events.
+        manager.dump_observability(out_dir)
+        fleet_report = fleet_lib.fleet_report(out_dir)
+        merged = fleet_lib.merge_spans(fleet_lib.load_fleet_dir(out_dir)["spans"])
+        by_trace = {}
+        for span in merged:
+            by_trace.setdefault(span.get("trace_id"), []).append(span)
+
+        # The black box must point at real, complete traces: every
+        # exemplar trace_id resolves in the merged span dump with a root
+        # span and a service-side span.
+        dumps = list(runtime.slo_engine.dumps)
+        exemplar_trace_ids = []
+        exemplars_resolve = False
+        if dumps:
+            with open(dumps[0]) as f:
+                blackbox = json.load(f)
+            exemplar_trace_ids = sorted(blackbox.get("exemplar_traces", {}))
+            def _complete(trace_id):
+                spans = by_trace.get(trace_id, [])
+                names = {s.get("name") for s in spans}
+                has_root = any(s.get("parent_id") is None for s in spans)
+                return (
+                    len(spans) >= 3
+                    and has_root
+                    and "service.suggest_trials" in names
+                )
+            exemplars_resolve = bool(exemplar_trace_ids) and all(
+                _complete(tid) for tid in exemplar_trace_ids
+            )
+
+        timeline = fleet_report["failover_timeline"]
+        breached = set(slo_report["breaching"])
+        span_sources = set(fleet_report["sources"])
+        replica_sources = {s for s in span_sources if s.startswith("replica-")}
+        return {
+            "trials": trials,
+            "completed_trials": completed,
+            "elapsed_secs": round(elapsed, 3),
+            "studies": [
+                {"study": name, "owner": owner} for name, owner in studies
+            ],
+            "killed_replica": killed_replica,
+            "killed_at_trial": kill_at,
+            "p99_threshold_ms": p99_threshold_ms,
+            "induced_delay_ms": delay_secs * 1e3,
+            "slo": slo_report,
+            "slo_breached": sorted(breached),
+            "p99_breached": any(b.startswith("suggest_p99") for b in breached),
+            "blackbox_dumps": dumps,
+            "exemplar_trace_ids": exemplar_trace_ids,
+            "exemplars_resolve_to_complete_traces": exemplars_resolve,
+            "fleet": fleet_report,
+            "fleet_replica_sources": sorted(replica_sources),
+            "cross_replica_traces": fleet_report["cross_replica_traces"],
+            "failover_timeline_kinds": sorted(
+                {e["kind"] for e in timeline}
+            ),
+            "serving_stats": {
+                k: v
+                for k, v in sorted(manager.serving_stats().items())
+                if isinstance(v, int) and v
+            },
+            "injected": monkey.counts(),
+            "out_dir": out_dir,
+        }
+    finally:
+        if manager is not None:
+            manager.shutdown()
+        tracing_lib.set_tracer(prev_tracer)
+        recorder_lib.set_recorder(prev_recorder)
+        env_patch.stop()
+
+
+def write_observability_e2e(arm: dict, out_path: str) -> dict:
+    """OBSERVABILITY_E2E.json v2: the SLO-armed soak as evidence."""
+    fleet = arm["fleet"]
+    evidence = {
+        "version": 2,
+        "what": (
+            "PR 11 acceptance: SLO-armed chaos soak over a 2-replica tier "
+            "with an induced p99 breach -> black-box dump whose exemplar "
+            "trace_ids resolve to complete traces in the merged per-replica "
+            "span dumps; fleet merge stitches cross-replica traces and the "
+            "failover timeline from flight-recorder events"
+        ),
+        "slo": {
+            "config": arm["slo"]["config"],
+            "breached": arm["slo_breached"],
+            "p99_breached": arm["p99_breached"],
+            "statuses": arm["slo"]["statuses"],
+            "blackbox_dump": arm["blackbox_dumps"][:1],
+            "exemplar_trace_ids": arm["exemplar_trace_ids"],
+            "exemplars_resolve_to_complete_traces": arm[
+                "exemplars_resolve_to_complete_traces"
+            ],
+        },
+        "fleet": {
+            "sources": fleet["sources"],
+            "spans": fleet["spans"],
+            "traces": fleet["traces"],
+            "cross_replica_traces": fleet["cross_replica_traces"],
+            "cross_replica_examples": fleet["cross_replica_examples"][:3],
+            "failover_timeline": fleet["failover_timeline"],
+        },
+        "soak": {
+            "trials": arm["trials"],
+            "completed_trials": arm["completed_trials"],
+            "killed_replica": arm["killed_replica"],
+            "killed_at_trial": arm["killed_at_trial"],
+            "p99_threshold_ms": arm["p99_threshold_ms"],
+            "induced_delay_ms": arm["induced_delay_ms"],
+            "serving_stats": arm["serving_stats"],
+            "injected": arm["injected"],
+        },
+    }
+    pathlib.Path(out_path).write_text(json.dumps(evidence, indent=2) + "\n")
+    return evidence
+
+
 def _cross_check_locks(observatory, out: dict) -> bool:
     """Diffs the soak's observed lock order against the static graph."""
     from vizier_tpu.analysis import debug_locks, suite
@@ -472,6 +753,33 @@ def main() -> None:
         action="store_true",
         help="record runtime lock order during the soak and fail on edges "
         "the static lock_order graph does not predict",
+    )
+    parser.add_argument(
+        "--slo-soak",
+        action="store_true",
+        help="add the SLO-armed observability arm: 2-replica tier, induced "
+        "p99 breach -> black-box dump + fleet-merged cross-replica traces; "
+        "regenerates OBSERVABILITY_E2E.json (v2)",
+    )
+    parser.add_argument(
+        "--slo-replicas",
+        type=int,
+        default=2,
+        help="replica count for the --slo-soak arm",
+    )
+    parser.add_argument(
+        "--obs-dump-dir",
+        default="",
+        help="dump directory for the --slo-soak arm's span/metric/recorder "
+        "+ black-box files (default: a fresh temp dir)",
+    )
+    parser.add_argument(
+        "--obs-e2e-out",
+        default=str(
+            pathlib.Path(__file__).resolve().parent.parent
+            / "OBSERVABILITY_E2E.json"
+        ),
+        help="where --slo-soak writes the v2 evidence JSON",
     )
     parser.add_argument(
         "--out",
@@ -551,6 +859,25 @@ def main() -> None:
                 seed=args.seed,
                 fault_prob=args.fault_prob,
             )
+        if args.slo_soak:
+            import tempfile
+
+            out_dir = args.obs_dump_dir or tempfile.mkdtemp(
+                prefix="vizier-obs-dump-"
+            )
+            print(
+                f"[chaos_ab] running arm: slo_soak "
+                f"({args.slo_replicas} replicas, dumps -> {out_dir})"
+            )
+            report["arms"]["slo_soak"] = run_slo_soak_arm(
+                trials=args.trials,
+                seed=args.seed,
+                fault_prob=args.fault_prob,
+                reliability=arms["reliability_on"],
+                num_replicas=args.slo_replicas,
+                kill_at=kill_at,
+                out_dir=out_dir,
+            )
 
     on, off = report["arms"]["reliability_on"], report["arms"]["reliability_off"]
     report["verdict"] = {
@@ -581,6 +908,36 @@ def main() -> None:
             }
         )
         ok = ok and mesh_arm["all_accounted"] and mesh_arm["post_soak_liveness"]
+    if args.slo_soak:
+        slo_arm = report["arms"]["slo_soak"]
+        report["verdict"].update(
+            {
+                "slo_completed_all": slo_arm["completed_trials"]
+                == args.trials,
+                "slo_p99_breached": slo_arm["p99_breached"],
+                "slo_blackbox_dumped": bool(slo_arm["blackbox_dumps"]),
+                "slo_exemplars_resolve": slo_arm[
+                    "exemplars_resolve_to_complete_traces"
+                ],
+                "fleet_replica_sources": len(
+                    slo_arm["fleet_replica_sources"]
+                ),
+                "fleet_cross_replica_traces": slo_arm["cross_replica_traces"],
+                "fleet_failover_in_timeline": "replica_failover"
+                in slo_arm["failover_timeline_kinds"],
+            }
+        )
+        ok = ok and (
+            slo_arm["completed_trials"] == args.trials
+            and slo_arm["p99_breached"]
+            and bool(slo_arm["blackbox_dumps"])
+            and slo_arm["exemplars_resolve_to_complete_traces"]
+            and len(slo_arm["fleet_replica_sources"]) >= 2
+            and slo_arm["cross_replica_traces"] >= 1
+            and "replica_failover" in slo_arm["failover_timeline_kinds"]
+        )
+        write_observability_e2e(slo_arm, args.obs_e2e_out)
+        print(f"[chaos_ab] wrote {args.obs_e2e_out}")
     if args.instrument_locks:
         locks_ok = _cross_check_locks(observatory, report)
         report["verdict"]["lock_order_confirmed"] = locks_ok

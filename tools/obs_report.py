@@ -10,6 +10,16 @@ Usage:
     python tools/obs_report.py SPANS.jsonl              # per-phase table
     python tools/obs_report.py SPANS.jsonl --trace ID   # one trace's tree
     python tools/obs_report.py SPANS.jsonl --json       # machine-readable
+    python tools/obs_report.py --slo METRICS.json       # SLO burn rates
+    python tools/obs_report.py --fleet DUMP_DIR         # merged fleet view
+
+``--slo`` reads a ``MetricsRegistry.snapshot()`` JSON dump and renders the
+``vizier_slo_*`` gauge families (burn rates per window, breached SLOs,
+per-placement mesh utilization). ``--fleet`` reads a dump directory of
+per-replica ``<replica>-{spans.jsonl,metrics.json,recorder.json}`` files
+(``replica_main --obs-dump-dir`` / ``ReplicaManager.dump_observability``)
+and prints the merged cross-replica traces + failover timeline. Both
+compose with ``--json`` (the report gains ``slo``/``fleet`` sections).
 
 Stdlib-only; percentiles here are exact (computed from the raw span
 durations, not histogram buckets — the spans ARE the samples).
@@ -243,6 +253,121 @@ def speculative_activity(spans: List[dict]) -> dict:
     return counts
 
 
+_LABEL_RE = None  # compiled lazily; obs_report imports stay minimal
+
+
+def _parse_label_str(label_str: str) -> Dict[str, str]:
+    """``{slo="x",window="60s"}`` -> {"slo": "x", "window": "60s"}."""
+    global _LABEL_RE
+    if _LABEL_RE is None:
+        import re
+
+        _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    return {
+        key: value.replace('\\"', '"').replace("\\\\", "\\")
+        for key, value in _LABEL_RE.findall(label_str)
+    }
+
+
+def slo_activity(metrics_snapshot: dict) -> dict:
+    """The SLO engine's export surface, from a registry snapshot dump.
+
+    Parses the ``vizier_slo_*`` gauge families (what ``SloEngine``
+    exports) into burn rates / windowed values per (slo, window), the
+    breached set, and the per-placement mesh-utilization shares. A dump
+    from an unarmed process reports ``{"armed": False}``.
+    """
+    out = {
+        "armed": False,
+        "burn_rates": {},
+        "values": {},
+        "breached": [],
+        "mesh_utilization": {},
+        "evaluations": 0,
+    }
+    if not isinstance(metrics_snapshot, dict):
+        return out
+
+    def _series(name):
+        family = metrics_snapshot.get(name)
+        return family.get("series", {}) if isinstance(family, dict) else {}
+
+    for label_str, value in _series("vizier_slo_burn_rate").items():
+        labels = _parse_label_str(label_str)
+        out["armed"] = True
+        out["burn_rates"].setdefault(labels.get("slo", "?"), {})[
+            labels.get("window", "?")
+        ] = value
+    for label_str, value in _series("vizier_slo_value").items():
+        labels = _parse_label_str(label_str)
+        out["armed"] = True
+        out["values"].setdefault(labels.get("slo", "?"), {})[
+            labels.get("window", "?")
+        ] = value
+    for label_str, value in _series("vizier_slo_breached").items():
+        out["armed"] = True
+        if value:
+            out["breached"].append(_parse_label_str(label_str).get("slo", "?"))
+    for label_str, value in _series("vizier_slo_mesh_utilization").items():
+        out["mesh_utilization"][
+            _parse_label_str(label_str).get("device", "?")
+        ] = value
+    for _label_str, value in _series("vizier_slo_evaluations").items():
+        out["armed"] = True
+        out["evaluations"] += int(value)
+    out["breached"].sort()
+    return out
+
+
+def load_metrics(path: str) -> dict:
+    """Parses a ``MetricsRegistry.snapshot()`` JSON dump ({} on garbage)."""
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[obs_report] cannot read metrics dump {path}: {e}", file=sys.stderr)
+        return {}
+    return loaded if isinstance(loaded, dict) else {}
+
+
+def fleet_section(dump_dir: str) -> Optional[dict]:
+    """The merged fleet report for a dump directory (None when the
+    observability package is unimportable — the merge lives there)."""
+    try:
+        from vizier_tpu.observability import fleet as fleet_lib
+    except Exception as e:  # stay runnable even on a broken tree
+        print(f"[obs_report] fleet merge unavailable: {e}", file=sys.stderr)
+        return None
+    return fleet_lib.fleet_report(dump_dir)
+
+
+def render_slo(slo: dict) -> str:
+    if not slo.get("armed"):
+        return "slo: not armed (no vizier_slo_* series in the dump)"
+    lines = [
+        f"slo: {len(slo['burn_rates'])} objectives, "
+        f"{slo['evaluations']} evaluations, "
+        f"breached: {', '.join(slo['breached']) or 'none'}"
+    ]
+    for name in sorted(slo["burn_rates"]):
+        windows = slo["burn_rates"][name]
+        values = slo.get("values", {}).get(name, {})
+        per_window = ", ".join(
+            f"{window}: burn {burn:.2f}"
+            + (f" (value {values[window]:.4g})" if window in values else "")
+            for window, burn in sorted(windows.items())
+        )
+        flag = " [BREACHED]" if name in slo["breached"] else ""
+        lines.append(f"  {name:<28} {per_window}{flag}")
+    if slo["mesh_utilization"]:
+        shares = ", ".join(
+            f"{device}: {share:.0%}"
+            for device, share in sorted(slo["mesh_utilization"].items())
+        )
+        lines.append(f"  mesh utilization: {shares}")
+    return "\n".join(lines)
+
+
 def render_table(rows: List[dict]) -> str:
     with_occ = any("mean_occupancy" in row for row in rows)
     header = f"{'phase':<34} {'count':>6} {'p50 ms':>9} {'p95 ms':>9} {'p99 ms':>9} {'max ms':>9} {'total ms':>10}"
@@ -297,14 +422,33 @@ def render_trace(spans: List[dict], trace_id: str) -> str:
 
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("path", help="JSON-lines span file")
+    parser.add_argument(
+        "path", nargs="?", help="JSON-lines span file (optional with --fleet/--slo)"
+    )
     parser.add_argument("--trace", help="Render one trace_id as a tree")
     parser.add_argument(
         "--json", action="store_true", help="Emit the breakdown as JSON"
     )
+    parser.add_argument(
+        "--slo",
+        metavar="METRICS_JSON",
+        help="MetricsRegistry.snapshot() dump: render the vizier_slo_* "
+        "burn rates / breached set",
+    )
+    parser.add_argument(
+        "--fleet",
+        metavar="DUMP_DIR",
+        help="per-replica dump directory: merged cross-replica traces + "
+        "failover timeline",
+    )
     args = parser.parse_args()
+    if not args.path and not (args.slo or args.fleet):
+        parser.error("need a span file, --slo, or --fleet")
 
-    spans = load_spans(args.path)
+    slo = slo_activity(load_metrics(args.slo)) if args.slo else None
+    fleet = fleet_section(args.fleet) if args.fleet else None
+
+    spans = load_spans(args.path) if args.path else []
     if args.trace:
         print(render_trace(spans, args.trace))
         return
@@ -322,11 +466,23 @@ def main() -> None:
                     "speculative_activity": speculative,
                     "program_kind_activity": programs,
                     "device_activity": devices,
+                    "slo": slo,
+                    "fleet": fleet,
                     "phases": rows,
                 },
                 indent=2,
             )
         )
+    elif not args.path:
+        if slo is not None:
+            print(render_slo(slo))
+        if fleet is not None:
+            try:
+                from vizier_tpu.observability import fleet as fleet_lib
+
+                print(fleet_lib.render_fleet_report(fleet))
+            except Exception:
+                print(json.dumps(fleet, indent=2))
     else:
         print(f"{len(spans)} spans")
         print(
@@ -346,6 +502,15 @@ def main() -> None:
             f"(hit rate {speculative['hit_rate']:.0%}, precomputes "
             f"{speculative['precomputes']}, stored {speculative['stored']})"
         )
+        if slo is not None:
+            print(render_slo(slo))
+        if fleet is not None:
+            try:
+                from vizier_tpu.observability import fleet as fleet_lib
+
+                print(fleet_lib.render_fleet_report(fleet))
+            except Exception:
+                print(json.dumps(fleet, indent=2))
         print(render_table(rows))
 
 

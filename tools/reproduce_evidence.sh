@@ -10,7 +10,10 @@ echo "== 0. static analysis: lock order / JAX discipline / env registry (~2 s) =
 #    zero unbaselined violations (docs/guides/static_analysis.md)
 python tools/check_analysis.py
 
-echo "== 1. full test suite (~16 min, 989 tests) =="
+echo "== 1. full test suite (~16 min; sharded recipe for 1-core boxes) =="
+#    On hardware where the single-process run no longer fits the tier-1
+#    wall (see ROADMAP.md "Tier-1 timing"), use the sharded recipe:
+#      bash tools/tier1_sharded.sh
 python -m pytest tests/ -q
 
 echo "== 2. full-scale CPU bench for the shipped default (~30 min) =="
@@ -32,6 +35,15 @@ echo "== 3b. failover chaos: kill one replica mid-study (~1 min) =="
 #    per-placement mesh dispatch workers — vs the static graph
 JAX_PLATFORMS=cpu python tools/chaos_ab.py --distributed 4 --mesh-devices 8 \
   --instrument-locks
+
+echo "== 3b3. SLO-armed observability soak (~2 min) =="
+#    -> OBSERVABILITY_E2E.json (v2): 2-replica tier with SLOs armed +
+#    flight recorder on; an induced p99 breach writes a black-box dump
+#    whose exemplar trace_ids resolve to complete traces in the merged
+#    per-replica span dumps; the fleet merge (obs_report --fleet) stitches
+#    cross-replica traces and the failover timeline from recorder events
+JAX_PLATFORMS=cpu python tools/chaos_ab.py --trials 50 --slo-soak \
+  --out /tmp/chaos_slo.json
 
 echo "== 3b2. mesh-sharded batch execution A/B (~4 min) =="
 #    -> MESH_AB.json: 8 distinct concurrent shape buckets through the

@@ -1,0 +1,60 @@
+#!/bin/bash
+# Sharded tier-1 recipe: the full suite as per-directory groups, each run
+# to completion under the SAME flags as the single-process tier-1 line.
+#
+# Why: the 870 s tier-1 wall no longer fits the whole suite in one pytest
+# process on a 1-core container — the unmodified seed also times out there
+# (rc=124, ~81% of dots emitted; see ROADMAP.md "Tier-1 timing"). Sharding
+# by directory keeps every group inside the wall with the identical
+# selection (-m 'not slow') and plugin set, so a red test cannot hide
+# behind the timeout.
+#
+# Usage:  bash tools/tier1_sharded.sh            # all groups
+#         TIER1_SHARD_TIMEOUT=600 bash tools/tier1_sharded.sh
+#
+# Exit status: nonzero if ANY group fails (including a group timeout).
+set -u
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${TIER1_SHARD_TIMEOUT:-870}"
+FLAGS=(-q -m 'not slow' --continue-on-collection-errors
+       -p no:cacheprovider -p no:xdist -p no:randomly)
+
+# One group per line; directories grouped so each fits the wall with slack
+# (measured on the 1-core container; heaviest groups get their own shard).
+GROUPS_LIST=(
+  "tests/analysis"
+  "tests/parallel tests/compute"
+  "tests/serving"
+  "tests/observability"
+  "tests/service tests/reliability tests/distributed tests/surrogates tests/pythia tests/pyvizier"
+  "tests/designers tests/algorithms tests/converters tests/models"
+  "tests/benchmarks tests/pyglove tests/test_aux.py tests/test_conformance_and_surrogates.py tests/test_imports.py tests/test_round1_extras.py"
+)
+
+overall_rc=0
+total_passed=0
+summary=()
+for group in "${GROUPS_LIST[@]}"; do
+  echo "== tier1 shard: ${group} =="
+  log="$(mktemp /tmp/tier1_shard.XXXXXX.log)"
+  # shellcheck disable=SC2086  # the group is a space-separated path list
+  timeout -k 10 "${TIMEOUT}" env JAX_PLATFORMS=cpu \
+    python -m pytest ${group} "${FLAGS[@]}" 2>&1 | tee "${log}"
+  rc=${PIPESTATUS[0]}
+  passed=$(grep -aoE '[0-9]+ passed' "${log}" | tail -1 | grep -oE '[0-9]+' || echo 0)
+  total_passed=$((total_passed + passed))
+  if [ "${rc}" -ne 0 ]; then
+    overall_rc=1
+    summary+=("FAIL rc=${rc} (${passed} passed)  ${group}")
+  else
+    summary+=("ok   (${passed} passed)  ${group}")
+  fi
+  rm -f "${log}"
+done
+
+echo
+echo "== tier1 sharded summary =="
+for line in "${summary[@]}"; do echo "  ${line}"; done
+echo "TOTAL_PASSED=${total_passed}"
+exit "${overall_rc}"

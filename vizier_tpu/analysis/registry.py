@@ -79,6 +79,40 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             _OBS_DOC, "Finished-span ring-buffer size.", "4096"),
     _switch("VIZIER_OBSERVABILITY_SPAN_LOG", "str", "ObservabilityConfig",
             _OBS_DOC, "JSON-lines span sink path ('' = ring only)."),
+    # -- SLO engine (SloConfig) --------------------------------------------
+    _switch("VIZIER_SLO", "flag", "SloConfig", _OBS_DOC,
+            "Arm the SLO engine: sliding-window error-budget burn rates "
+            "+ breach handling (opt-in; unset/0 = no engine, no sampler).",
+            "0"),
+    _switch("VIZIER_SLO_WINDOWS", "str", "SloConfig", _OBS_DOC,
+            "Comma-separated sliding windows in seconds.", "60,300"),
+    _switch("VIZIER_SLO_EVAL_INTERVAL_S", "float", "SloConfig", _OBS_DOC,
+            "Background evaluation cadence (0 = manual evaluate() only).",
+            "1.0"),
+    _switch("VIZIER_SLO_SUGGEST_P99_MS", "float", "SloConfig", _OBS_DOC,
+            "Objective: 99% of suggests per hop under this many ms.",
+            "5000.0"),
+    _switch("VIZIER_SLO_SPECULATIVE_HIT_RATE", "float", "SloConfig",
+            _OBS_DOC,
+            "Objective: minimum speculative serve hit rate (evaluated "
+            "only when the window saw speculative traffic).", "0.8"),
+    _switch("VIZIER_SLO_FALLBACK_RATE", "float", "SloConfig", _OBS_DOC,
+            "Objective: maximum quasi-random fallback fraction.", "0.05"),
+    _switch("VIZIER_SLO_DUMP_DIR", "str", "SloConfig", _OBS_DOC,
+            "Black-box dump directory for SLO breaches ('' = no dumps)."),
+    # -- flight recorder (FlightRecorderConfig) ----------------------------
+    _switch("VIZIER_FLIGHT_RECORDER", "flag", "FlightRecorderConfig",
+            _OBS_DOC,
+            "Per-study flight recorder of structured lifecycle events "
+            "(opt-in; unset/0 = the stateless no-op recorder).", "0"),
+    _switch("VIZIER_FLIGHT_RECORDER_RING", "int", "FlightRecorderConfig",
+            _OBS_DOC, "Events kept per study ring.", "256"),
+    _switch("VIZIER_FLIGHT_RECORDER_STUDIES", "int", "FlightRecorderConfig",
+            _OBS_DOC, "Study rings kept (LRU-evicted past this).", "1024"),
+    # -- fleet aggregation (observability.fleet) ---------------------------
+    _switch("VIZIER_OBS_DUMP_DIR", "str", "replica_main", _OBS_DOC,
+            "Per-replica observability dump directory: span/metric/"
+            "recorder files written on shutdown for fleet merging."),
     # -- reliability (ReliabilityConfig) -----------------------------------
     _switch("VIZIER_RELIABILITY", "flag", "ReliabilityConfig", _REL_DOC,
             "Master switch for retries/deadlines/breaker/fallback.", "1"),

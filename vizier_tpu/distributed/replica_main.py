@@ -32,14 +32,26 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--snapshot-interval", type=int, default=0, help="0 = config default"
     )
+    parser.add_argument(
+        "--obs-dump-dir",
+        default=None,
+        help="write <replica-id>-{spans.jsonl,metrics.json,recorder.json} "
+        "here on shutdown for fleet merging (obs_report --fleet); "
+        "default: $VIZIER_OBS_DUMP_DIR ('' = no dump)",
+    )
     args = parser.parse_args(argv)
 
     # The replica serves studies, not accelerators-by-default: a dead TPU
     # tunnel must not hang jax init when the subprocess is CPU-bound work.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+    from vizier_tpu.analysis import registry as env_registry
     from vizier_tpu.distributed import wal as wal_lib
     from vizier_tpu.service import vizier_server
+
+    obs_dump_dir = args.obs_dump_dir
+    if obs_dump_dir is None:
+        obs_dump_dir = env_registry.env_str("VIZIER_OBS_DUMP_DIR")
 
     datastore = None
     if args.wal_dir:
@@ -59,12 +71,35 @@ def main(argv=None) -> None:
         port=args.port or None,
         datastore=datastore,
     )
+    # Tag this process's request spans so a merged fleet dump stays
+    # attributable even if files are renamed.
+    server.servicer.replica_id = args.replica_id
     print(f"READY {server.endpoint}", flush=True)
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    if obs_dump_dir:
+        # Shutdown dump: this replica's span ring, metric snapshot, and
+        # flight-recorder events, in the fleet merge's file layout.
+        from vizier_tpu.observability import fleet as fleet_lib
+        from vizier_tpu.observability import flight_recorder as recorder_lib
+        from vizier_tpu.observability import tracing as tracing_lib
+
+        written = fleet_lib.dump_process(
+            obs_dump_dir,
+            args.replica_id,
+            tracer=tracing_lib.get_tracer(),
+            registry=server.pythia_servicer.serving_runtime.metrics,
+            recorder=recorder_lib.get_recorder(),
+        )
+        print(
+            f"[{args.replica_id}] observability dump: "
+            f"{', '.join(sorted(written.values()))}",
+            file=sys.stderr,
+            flush=True,
+        )
     server.stop(grace=1.0)
     if datastore is not None:
         datastore.compact_now()

@@ -35,12 +35,15 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from vizier_tpu.distributed import config as config_lib
 from vizier_tpu.distributed import router_stub
 from vizier_tpu.distributed import routing
 from vizier_tpu.distributed import wal as wal_lib
+from vizier_tpu.observability import fleet as fleet_lib
+from vizier_tpu.observability import flight_recorder as recorder_lib
+from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.service import ram_datastore
 
 _logger = logging.getLogger(__name__)
@@ -177,6 +180,9 @@ class ReplicaManager:
         servicer = vizier_service_mod.VizierServicer(
             datastore=datastore, reliability_config=reliability
         )
+        # Tag the replica's request spans so a fleet dump can split one
+        # process's span ring back into per-replica files.
+        servicer.replica_id = replica_id
         servicer.set_pythia(self._pythia)
         return Replica(replica_id, servicer, datastore, wal_dir)
 
@@ -218,6 +224,38 @@ class ReplicaManager:
     def prometheus_text(self) -> str:
         return self._pythia.prometheus_text()
 
+    def dump_observability(self, out_dir: str) -> Dict[str, List[str]]:
+        """Writes the fleet's observability dumps into ``out_dir``.
+
+        The in-process tier shares one span ring; this splits it back into
+        per-replica ``<replica>-spans.jsonl`` files (request spans carry a
+        ``replica`` attribute) plus a ``client-spans.jsonl`` for
+        unattributed spans, and writes the shared registry snapshot and
+        the flight-recorder event list — the exact file layout subprocess
+        replicas produce via ``replica_main --obs-dump-dir``, so
+        ``observability.fleet`` (and ``tools/obs_report.py --fleet``)
+        merges either deployment the same way.
+        """
+        tracer = tracing_lib.get_tracer()
+        by_source: Dict[str, List[dict]] = {}
+        for span in tracer.finished_spans():
+            data = span.to_dict()
+            source = (data.get("attributes") or {}).get("replica") or "client"
+            by_source.setdefault(source, []).append(data)
+        written: Dict[str, List[str]] = {"spans": [], "other": []}
+        for source, spans in sorted(by_source.items()):
+            written["spans"].append(
+                fleet_lib.write_spans(out_dir, source, spans)
+            )
+        paths = fleet_lib.dump_process(
+            out_dir,
+            "fleet",
+            registry=self._pythia.serving_runtime.metrics,
+            recorder=recorder_lib.get_recorder(),
+        )
+        written["other"] = sorted(paths.values())
+        return written
+
     def shutdown(self) -> None:
         self.stop_health_loop()
         self._pythia.shutdown()
@@ -238,6 +276,9 @@ class ReplicaManager:
         would for a crashed process.
         """
         self.replica(replica_id).alive = False
+        recorder_lib.get_recorder().record(
+            None, "replica_killed", replica=replica_id
+        )
 
     def fail_over(self, replica_id: str) -> int:
         """Marks a dead replica down and lifts its studies onto successors.
@@ -257,24 +298,40 @@ class ReplicaManager:
                     )
                 self._failed_over.add(replica_id)
             self.router.mark_down(replica_id)
-            restored = self._restore_from_wal(replica)
+            restored, successors = self._restore_from_wal(replica)
             if replica.wal_dir:
                 # Its studies now live on successors: a live-replica
                 # ListStudies fan-out is complete again. RAM-only replicas
                 # stay unaccounted — their studies are gone, and listings
                 # keep failing loudly rather than silently shrinking.
                 self._stub.note_failed_over(replica_id)
-        # Counter updates outside the failover lock: metric locks must not
-        # nest under tier mutexes (serving-stack convention, enforced by
-        # the chaos soak's runtime lock-order cross-check).
+        # Counter updates (and the recorder append) outside the failover
+        # lock: metric locks must not nest under tier mutexes
+        # (serving-stack convention, enforced by the chaos soak's runtime
+        # lock-order cross-check).
         self._failovers.inc(replica=replica_id)
         self._restored.inc(restored)
+        # Structured failover event: with just the vizier_replica_*
+        # counters, the fleet's topology history was gone the moment the
+        # numbers were read — the recorder keeps who died, when, which
+        # successors took its studies, and how many moved.
+        recorder_lib.get_recorder().record(
+            None,
+            "replica_failover",
+            replica=replica_id,
+            successors=sorted(successors),
+            restored_studies=restored,
+        )
         return restored
 
-    def _restore_from_wal(self, replica: Replica) -> int:
-        """Replays a dead replica's WAL into its successors' datastores."""
+    def _restore_from_wal(self, replica: Replica) -> Tuple[int, set]:
+        """Replays a dead replica's WAL into its successors' datastores.
+
+        Returns ``(studies_restored, successor_ids)``.
+        """
         if not replica.wal_dir:
-            return 0  # RAM-only replica: its studies are lost until recreated
+            # RAM-only replica: its studies are lost until recreated.
+            return 0, set()
         records, torn = wal_lib.read_directory(replica.wal_dir)
         if torn:
             _logger.warning(
@@ -282,6 +339,7 @@ class ReplicaManager:
                 replica.replica_id,
             )
         studies: set = set()
+        successors: set = set()
         for opcode, payload in records:
             study_key = wal_lib.study_key_of(opcode, payload)
             successor_id = self.router.replica_for(study_key)
@@ -290,7 +348,8 @@ class ReplicaManager:
             # record into the successor's own WAL: the handoff is durable.
             wal_lib.apply_record(successor.datastore, opcode, payload)
             studies.add(study_key)
-        return len(studies)
+            successors.add(successor_id)
+        return len(studies), successors
 
     def revive_replica(self, replica_id: str) -> None:
         """Restarts a replica warm from its WAL and routes its studies back.
@@ -327,6 +386,12 @@ class ReplicaManager:
         # _ReplicaEndpoint objects are bound per Replica; repoint the stub.
         self._stub.set_endpoint(replica_id, fresh.endpoint)
         self.router.mark_up(replica_id)
+        recorder_lib.get_recorder().record(
+            None,
+            "replica_revive",
+            replica=replica_id,
+            was_failed_over=was_failed_over,
+        )
 
     def _copy_back_from_successors(self, fresh: Replica) -> None:
         """Moves studies the revived replica will own back from successors.

@@ -11,8 +11,19 @@ Everything is stdlib-only and gated by :class:`ObservabilityConfig`
 ``docs/guides/observability.md``.
 """
 
+from vizier_tpu.observability import fleet
 from vizier_tpu.observability.config import ObservabilityConfig
+from vizier_tpu.observability.flight_recorder import (
+    FLEET,
+    FlightRecorder,
+    FlightRecorderConfig,
+    NOOP_RECORDER,
+    NoopFlightRecorder,
+    get_recorder,
+    set_recorder,
+)
 from vizier_tpu.observability.jax_timing import device_phase
+from vizier_tpu.observability.slo import SloConfig, SloEngine, SloStatus
 from vizier_tpu.observability.metrics import (
     Counter,
     DEFAULT_LATENCY_BUCKETS,
@@ -39,6 +50,17 @@ from vizier_tpu.observability.tracing import (
 __all__ = [
     "ObservabilityConfig",
     "device_phase",
+    "fleet",
+    "FLEET",
+    "FlightRecorder",
+    "FlightRecorderConfig",
+    "NOOP_RECORDER",
+    "NoopFlightRecorder",
+    "get_recorder",
+    "set_recorder",
+    "SloConfig",
+    "SloEngine",
+    "SloStatus",
     "Counter",
     "DEFAULT_LATENCY_BUCKETS",
     "Gauge",

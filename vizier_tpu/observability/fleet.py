@@ -1,0 +1,258 @@
+"""Fleet aggregation: merge per-replica observability dumps into one view.
+
+A sharded tier has no single span ring or metrics registry: every replica
+process (or every replica of an in-process :class:`ReplicaManager`) owns a
+slice of the fleet's traces. This module defines the **dump format** —
+three files per source, ``<source>-spans.jsonl`` (one span per line,
+exactly what ``Tracer.dump_jsonl`` writes), ``<source>-metrics.json``
+(``MetricsRegistry.snapshot()``), and ``<source>-recorder.json`` (the
+flight recorder's time-ordered event list) — and the **merge**: spans from
+N sources stitched back into single cross-replica traces (trace context
+already propagates across the wire via the request protos), plus the
+failover timeline reconstructed from the recorder's ``replica_*`` events.
+
+File-based on purpose: a dump directory survives the processes that wrote
+it, ships in a bug report, and needs no collector sidecar. Stdlib-only —
+``tools/obs_report.py --fleet`` runs this on machines without jax.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+SPAN_SUFFIX = "-spans.jsonl"
+METRICS_SUFFIX = "-metrics.json"
+RECORDER_SUFFIX = "-recorder.json"
+
+# Recorder event kinds that make up the failover timeline.
+_TIMELINE_KINDS = (
+    "replica_killed",
+    "replica_failover",
+    "replica_revive",
+    "slo_breach",
+)
+
+
+def dump_process(
+    out_dir: str,
+    source: str,
+    tracer=None,
+    registry=None,
+    recorder=None,
+) -> Dict[str, str]:
+    """Writes one source's span/metric/recorder dumps into ``out_dir``.
+
+    ``source`` is the replica id (or ``"client"`` for unattributed spans).
+    Pass only the pieces the process has; missing ones write no file.
+    Returns the paths written, keyed ``spans``/``metrics``/``recorder``.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    written: Dict[str, str] = {}
+    if tracer is not None and getattr(tracer, "enabled", True):
+        path = os.path.join(out_dir, source + SPAN_SUFFIX)
+        tracer.dump_jsonl(path)
+        written["spans"] = path
+    if registry is not None:
+        path = os.path.join(out_dir, source + METRICS_SUFFIX)
+        with open(path, "w") as f:
+            json.dump(registry.snapshot(), f, sort_keys=True)
+        written["metrics"] = path
+    if recorder is not None and getattr(recorder, "enabled", False):
+        path = os.path.join(out_dir, source + RECORDER_SUFFIX)
+        recorder.dump_json(path)
+        written["recorder"] = path
+    return written
+
+
+def write_spans(out_dir: str, source: str, spans: List[dict]) -> str:
+    """Writes an explicit span list as ``<source>-spans.jsonl`` (the
+    split-by-replica path of ``ReplicaManager.dump_observability``)."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, source + SPAN_SUFFIX)
+    with open(path, "w") as f:
+        for span in spans:
+            f.write(json.dumps(span) + "\n")
+    return path
+
+
+def _load_jsonl(path: str) -> List[dict]:
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                item = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(item, dict):
+                out.append(item)
+    return out
+
+
+def load_fleet_dir(dump_dir: str) -> Dict[str, Dict[str, Any]]:
+    """Reads every dump in ``dump_dir``:
+    ``{"spans": {source: [span...]}, "metrics": {...}, "recorder": {...}}``.
+    """
+    spans: Dict[str, List[dict]] = {}
+    metrics: Dict[str, dict] = {}
+    recorder: Dict[str, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(dump_dir, "*" + SPAN_SUFFIX))):
+        source = os.path.basename(path)[: -len(SPAN_SUFFIX)]
+        spans[source] = _load_jsonl(path)
+    for path in sorted(glob.glob(os.path.join(dump_dir, "*" + METRICS_SUFFIX))):
+        source = os.path.basename(path)[: -len(METRICS_SUFFIX)]
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(loaded, dict):
+            metrics[source] = loaded
+    for path in sorted(
+        glob.glob(os.path.join(dump_dir, "*" + RECORDER_SUFFIX))
+    ):
+        source = os.path.basename(path)[: -len(RECORDER_SUFFIX)]
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(loaded, list):
+            recorder[source] = [e for e in loaded if isinstance(e, dict)]
+    return {"spans": spans, "metrics": metrics, "recorder": recorder}
+
+
+def merge_spans(per_source: Dict[str, List[dict]]) -> List[dict]:
+    """One flat span list, each span stamped with its dump ``source``,
+    ordered by start time — the cross-replica trace substrate."""
+    merged: List[dict] = []
+    for source, spans in sorted(per_source.items()):
+        for span in spans:
+            span = dict(span)
+            span["source"] = source
+            merged.append(span)
+    merged.sort(key=lambda s: s.get("start_time", 0.0))
+    return merged
+
+
+def cross_replica_traces(merged: List[dict]) -> List[dict]:
+    """Traces whose spans came from 2+ distinct dump sources — one request
+    observed end-to-end across processes/replicas, stitched back together
+    by the propagated trace id."""
+    by_trace: Dict[str, Dict[str, Any]] = {}
+    for span in merged:
+        trace_id = span.get("trace_id")
+        if not trace_id:
+            continue
+        row = by_trace.setdefault(
+            trace_id, {"trace_id": trace_id, "sources": set(), "spans": 0}
+        )
+        row["sources"].add(span.get("source", ""))
+        row["spans"] += 1
+    out = [
+        {**row, "sources": sorted(row["sources"])}
+        for row in by_trace.values()
+        if len(row["sources"]) >= 2
+    ]
+    out.sort(key=lambda row: (-row["spans"], row["trace_id"]))
+    return out
+
+
+def failover_timeline(
+    per_source_events: Dict[str, List[dict]],
+) -> List[dict]:
+    """The fleet's topology-change history, time-ordered: kill, failover
+    (with successor list), revive, and SLO breach events from every
+    source's flight-recorder dump."""
+    timeline: List[dict] = []
+    for source, events in sorted(per_source_events.items()):
+        for event in events:
+            if event.get("kind") not in _TIMELINE_KINDS:
+                continue
+            row = {
+                "time": event.get("time"),
+                "kind": event.get("kind"),
+                "source": source,
+            }
+            row.update(event.get("attributes") or {})
+            timeline.append(row)
+    timeline.sort(key=lambda row: row.get("time") or 0.0)
+    return timeline
+
+
+def slo_series(metrics_snapshot: dict) -> Dict[str, Any]:
+    """The ``vizier_slo_*`` families from one ``MetricsRegistry.snapshot()``
+    dump, keyed by metric name — the SLO section of a merged report."""
+    out: Dict[str, Any] = {}
+    for name, family in sorted(metrics_snapshot.items()):
+        if name.startswith("vizier_slo_") and isinstance(family, dict):
+            out[name] = family.get("series", {})
+    return out
+
+
+def fleet_report(dump_dir: str) -> Dict[str, Any]:
+    """The merged fleet view of one dump directory (JSON-ready)."""
+    loaded = load_fleet_dir(dump_dir)
+    merged = merge_spans(loaded["spans"])
+    crossing = cross_replica_traces(merged)
+    trace_ids = {s.get("trace_id") for s in merged if s.get("trace_id")}
+    slo: Dict[str, Any] = {}
+    for _source, snapshot in sorted(loaded["metrics"].items()):
+        for name, series in slo_series(snapshot).items():
+            slo.setdefault(name, {}).update(series)
+    return {
+        "dump_dir": dump_dir,
+        "sources": sorted(loaded["spans"]),
+        "spans": len(merged),
+        "traces": len(trace_ids),
+        "cross_replica_traces": len(crossing),
+        "cross_replica_examples": crossing[:10],
+        "failover_timeline": failover_timeline(loaded["recorder"]),
+        "slo": slo,
+    }
+
+
+def merged_trace(dump_dir: str, trace_id: str) -> List[dict]:
+    """One cross-replica trace's spans (source-stamped, time-ordered)."""
+    loaded = load_fleet_dir(dump_dir)
+    merged = merge_spans(loaded["spans"])
+    return [s for s in merged if s.get("trace_id") == trace_id]
+
+
+def render_fleet_report(report: Dict[str, Any]) -> str:
+    """Human-readable rendering of :func:`fleet_report`'s output."""
+    lines = [
+        f"fleet dump: {report['dump_dir']}",
+        f"sources: {', '.join(report['sources']) or '(none)'}",
+        f"{report['spans']} spans across {report['traces']} traces; "
+        f"{report['cross_replica_traces']} cross-replica",
+    ]
+    for row in report["cross_replica_examples"]:
+        lines.append(
+            f"  trace {row['trace_id']}: {row['spans']} spans over "
+            f"{', '.join(row['sources'])}"
+        )
+    timeline = report["failover_timeline"]
+    if timeline:
+        lines.append("failover timeline:")
+        for event in timeline:
+            extras = {
+                k: v
+                for k, v in event.items()
+                if k not in ("time", "kind", "source")
+            }
+            note = f" {extras}" if extras else ""
+            lines.append(
+                f"  t={event.get('time'):.3f} [{event['source']}] "
+                f"{event['kind']}{note}"
+            )
+    else:
+        lines.append("failover timeline: (no events)")
+    if report["slo"]:
+        lines.append("slo gauges: " + ", ".join(sorted(report["slo"])))
+    return "\n".join(lines)

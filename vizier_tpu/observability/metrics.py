@@ -18,6 +18,7 @@ import bisect
 import json
 import math
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 LabelKey = Tuple[Tuple[str, str], ...]
@@ -100,6 +101,11 @@ class Counter(_Metric):
         with self._lock:
             return float(self._series.get(_label_key(labels), 0.0))
 
+    def series_values(self) -> Dict[LabelKey, float]:
+        """Every labeled series' value (sliding-window delta material)."""
+        with self._lock:
+            return {key: float(v) for key, v in self._series.items()}
+
     def reset(self) -> None:
         """Zeroes every series (in-process test/rollup convenience)."""
         with self._lock:
@@ -145,19 +151,30 @@ class Gauge(_Metric):
 
 
 class _HistogramSeries:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, num_buckets: int):
         # One slot per finite bucket plus the +Inf overflow slot.
         self.counts = [0] * (num_buckets + 1)
         self.sum = 0.0
         self.count = 0
+        # Top-valued exemplars: [value, trace_id, time] triples, unordered.
+        self.exemplars: List[list] = []
 
 
 class Histogram(_Metric):
-    """Fixed-bucket histogram with quantile estimation from the buckets."""
+    """Fixed-bucket histogram with quantile estimation from the buckets.
+
+    Observations may carry an **exemplar** ``trace_id``: the top
+    :data:`MAX_EXEMPLARS` highest-valued observations per series keep
+    their trace ids (OpenMetrics-style), so a p99 number links back to
+    real traces. Capture is sampling-only metadata — it never changes what
+    is counted — and costs one comparison when no trace id is supplied.
+    """
 
     kind = "histogram"
+
+    MAX_EXEMPLARS = 8
 
     def __init__(
         self,
@@ -171,7 +188,9 @@ class Histogram(_Metric):
             raise ValueError(f"Histogram {name} needs at least one bucket.")
         self.buckets: Tuple[float, ...] = tuple(float(b) for b in bounds)
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(
+        self, value: float, trace_id: Optional[str] = None, **labels: str
+    ) -> None:
         key = _label_key(labels)
         idx = bisect.bisect_left(self.buckets, value)
         with self._lock:
@@ -181,6 +200,34 @@ class Histogram(_Metric):
             series.counts[idx] += 1
             series.sum += value
             series.count += 1
+            if trace_id is not None:
+                exemplars = series.exemplars
+                if len(exemplars) < self.MAX_EXEMPLARS:
+                    exemplars.append([value, trace_id, time.time()])
+                else:
+                    low = min(range(len(exemplars)), key=lambda i: exemplars[i][0])
+                    if value > exemplars[low][0]:
+                        exemplars[low] = [value, trace_id, time.time()]
+
+    def exemplars(self, **labels: str) -> List[Dict[str, object]]:
+        """The series' kept exemplars, highest value first."""
+        with self._lock:
+            series = self._series.get(_label_key(labels))
+            kept = list(series.exemplars) if series is not None else []
+        kept.sort(key=lambda e: e[0], reverse=True)
+        return [
+            {"value": value, "trace_id": trace_id, "time": t}
+            for value, trace_id, t in kept
+        ]
+
+    def series_data(self) -> Dict[LabelKey, Tuple[List[int], int, float]]:
+        """Per-series ``(bucket_counts, count, sum)`` snapshot — the raw
+        material for sliding-window deltas (the SLO engine)."""
+        with self._lock:
+            return {
+                key: (list(s.counts), s.count, s.sum)
+                for key, s in self._series.items()
+            }
 
     def count(self, **labels: str) -> int:
         with self._lock:

@@ -73,6 +73,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from vizier_tpu.compute import ir as compute_ir
 from vizier_tpu.compute import registry as compute_registry
+from vizier_tpu.observability import flight_recorder as recorder_lib
 from vizier_tpu.observability import metrics as metrics_lib
 from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.reliability import errors as errors_lib
@@ -616,6 +617,22 @@ class BatchExecutor:
             self._stats.increment("batch_flushes")
             if placement is not None:
                 self._stats.increment("mesh_flushes")
+        recorder = recorder_lib.get_recorder()
+        if recorder.enabled:
+            # Flush membership for the flight recorder: the member suggests'
+            # trace ids tie this fleet-scoped event back to each study's
+            # own ring (their request spans carry the same ids).
+            recorder.record(
+                None,
+                "batch_flush",
+                bucket=label,
+                occupancy=len(slots),
+                reason=reason,
+                device=placement.label() if placement is not None else None,
+                members=[
+                    s.span.trace_id for s in slots if s.span is not None
+                ],
+            )
 
     def _execute(
         self,

@@ -139,16 +139,21 @@ class CircuitBreakerRegistry:
         self._lock = threading.Lock()
         self._breakers: Dict[str, CircuitBreaker] = {}
 
-    def _count_transition(self, old: str, new: str) -> None:
+    def _count_transition(self, study_name: str, old: str, new: str) -> None:
         if self._stats is not None:
             self._stats.increment(_TRANSITION_COUNTERS[new])
         # Transitions fire inside the suggest computation that tripped (or
-        # probed) the breaker — stamp them on that span. Lazy import:
-        # reliability must stay importable without the serving stack.
+        # probed) the breaker — stamp them on that span, and on the study's
+        # flight-recorder ring (both leaf sinks). Lazy import: reliability
+        # must stay importable without the serving stack.
+        from vizier_tpu.observability import flight_recorder as recorder_lib
         from vizier_tpu.observability import tracing as tracing_lib
 
         tracing_lib.add_current_event(
             "breaker.transition", from_state=old, to_state=new
+        )
+        recorder_lib.get_recorder().record(
+            study_name, "breaker_transition", from_state=old, to_state=new
         )
 
     def get(self, study_name: str) -> CircuitBreaker:
@@ -156,7 +161,12 @@ class CircuitBreakerRegistry:
             breaker = self._breakers.get(study_name)
             if breaker is None:
                 breaker = CircuitBreaker(
-                    on_transition=self._count_transition, **self._kwargs
+                    on_transition=(
+                        lambda old, new, _study=study_name: (
+                            self._count_transition(_study, old, new)
+                        )
+                    ),
+                    **self._kwargs,
                 )
                 self._breakers[study_name] = breaker
             return breaker

@@ -212,8 +212,9 @@ class PythiaServicer:
             response = self._suggest_coalesced(request)
             if response.error:
                 span.set_attribute("error", response.error.splitlines()[0][:200])
+            trace_id = getattr(span, "trace_id", None)
         self._serving.observe_suggest_latency(
-            "pythia", time.perf_counter() - t0
+            "pythia", time.perf_counter() - t0, trace_id=trace_id
         )
         return response
 
@@ -554,6 +555,10 @@ class PythiaServicer:
         self._serving.stats.increment("fallbacks", len(suggestions))
         tracing_lib.add_current_event(
             "fallback.served", reason=reason, count=len(suggestions)
+        )
+        self._serving.flight_recorder.record(
+            request.study_name, "fallback", reason=reason,
+            count=len(suggestions),
         )
         _logger.warning(
             "Serving %d quasi-random fallback suggestion(s) for %s (%s).",

@@ -17,6 +17,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 from vizier_tpu.algorithms import core as core_lib
 from vizier_tpu.algorithms import designer_policy
+from vizier_tpu.observability import flight_recorder as recorder_lib
 from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.pythia import policy as policy_lib
 from vizier_tpu.pythia import policy_supporter as supporter_lib
@@ -187,6 +188,10 @@ class CachedDesignerStatePolicy(policy_lib.Policy):
             stats.increment("sparse_suggests", sparse)
         if crossed > 0:
             stats.increment("surrogate_crossovers", crossed)
+            recorder_lib.get_recorder().record(
+                self._study_name, "surrogate_crossover", count=crossed,
+                mode=after.get("mode"),
+            )
 
     def _account_trains(self, before: Optional[dict], after: Optional[dict]) -> None:
         if before is None or after is None:

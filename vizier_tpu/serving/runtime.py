@@ -18,7 +18,9 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from vizier_tpu.observability import config as obs_config_lib
+from vizier_tpu.observability import flight_recorder as recorder_lib
 from vizier_tpu.observability import metrics as metrics_lib
+from vizier_tpu.observability import slo as slo_lib
 from vizier_tpu.reliability import breaker as breaker_lib
 from vizier_tpu.reliability import config as reliability_config_lib
 from vizier_tpu.serving import coalescer as coalescer_lib
@@ -66,6 +68,7 @@ class ServingRuntime:
         surrogates: Optional[surrogate_config_lib.SurrogateConfig] = None,
         speculative: Optional[speculative_lib.SpeculativeConfig] = None,
         mesh: Optional[Any] = None,  # parallel.mesh.MeshConfig
+        slo: Optional[slo_lib.SloConfig] = None,
     ):
         self.config = config or config_lib.ServingConfig.from_env()
         self.observability = (
@@ -155,6 +158,21 @@ class ServingRuntime:
                 metrics=(self.metrics if self.observability.metrics_on else None),
                 executor=self.batch_executor,
             )
+        # Fleet observability plane: the process-global flight recorder
+        # (no-op unless VIZIER_FLIGHT_RECORDER=1) and the SLO engine
+        # (VIZIER_SLO=1) evaluating declarative objectives over sliding
+        # windows of this runtime's metrics registry, with breach-triggered
+        # black-box dumps. Both off by default = today's behavior.
+        self.flight_recorder = recorder_lib.get_recorder()
+        self.slo = slo or slo_lib.SloConfig.from_env()
+        self.slo_engine = None
+        if self.slo.enabled:
+            self.slo_engine = slo_lib.SloEngine(
+                config=self.slo,
+                registry=self.metrics,
+                recorder=self.flight_recorder,
+            )
+            self.slo_engine.start()
         self._prewarmed_shapes: set = set()
         self._prewarm_lock = threading.Lock()
         self._prewarm_threads: List[threading.Thread] = []
@@ -212,6 +230,8 @@ class ServingRuntime:
         jobs and joins their worker pool, and drains the batch executor —
         in that order, so no speculative job can submit into a closing
         executor. Idempotent."""
+        if self.slo_engine is not None:
+            self.slo_engine.close()
         if self.speculative_engine is not None:
             self.speculative_engine.close()
         with self._prewarm_lock:
@@ -221,11 +241,22 @@ class ServingRuntime:
         if self.batch_executor is not None:
             self.batch_executor.close()
 
-    def observe_suggest_latency(self, hop: str, seconds: float) -> None:
+    def observe_suggest_latency(
+        self, hop: str, seconds: float, trace_id: Optional[str] = None
+    ) -> None:
         """Records one suggest's wall time at a hop (no-op when metrics are
-        off — the off switch must cost nothing)."""
+        off — the off switch must cost nothing). ``trace_id`` makes the
+        observation an exemplar candidate: the hop's top-latency samples
+        keep their trace ids so an SLO breach links to real traces."""
         if self.observability.metrics_on:
-            self._suggest_latency.observe(seconds, hop=hop)
+            self._suggest_latency.observe(seconds, trace_id=trace_id, hop=hop)
+
+    def slo_report(self) -> Dict[str, Any]:
+        """Evaluates the armed SLOs now and returns the JSON-ready report
+        (``{"armed": False}`` when VIZIER_SLO is off)."""
+        if self.slo_engine is None:
+            return {"armed": False}
+        return self.slo_engine.report()
 
     def suggest_latency_histogram(self) -> metrics_lib.Histogram:
         return self._suggest_latency
@@ -236,6 +267,7 @@ class ServingRuntime:
         self.breakers.invalidate(study_name)
         if self.speculative_engine is not None:
             self.speculative_engine.invalidate(study_name, reason="delete_study")
+        self.flight_recorder.invalidate(study_name)
         return self.designer_cache.invalidate(study_name)
 
     def speculative_invalidate(self, study_name: str, reason: str = "") -> None:

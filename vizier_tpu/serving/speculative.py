@@ -60,6 +60,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 # registry; an undeclared name raises instead of silently reading an
 # always-unset variable. Enforced by the env_registry analysis pass.
 from vizier_tpu.analysis import registry as _registry
+from vizier_tpu.observability import flight_recorder as recorder_lib
 from vizier_tpu.observability import tracing as tracing_lib
 
 _logger = logging.getLogger(__name__)
@@ -707,3 +708,9 @@ class SpeculativeEngine:
         tracing_lib.add_current_event(
             f"speculative.{outcome}", **{k: v for k, v in attrs.items() if v}
         )
+        recorder = recorder_lib.get_recorder()
+        if recorder.enabled:
+            clean = {k: v for k, v in attrs.items() if v and k != "study"}
+            recorder.record(
+                attrs.get("study"), "speculation", outcome=outcome, **clean
+            )
