@@ -121,6 +121,22 @@ def _registered_programs() -> list:
     return list(compute_registry.kinds())
 
 
+def _loadgen_env_config() -> dict:
+    """The process-wide VIZIER_LOADGEN* scenario config, for provenance."""
+    from vizier_tpu.loadgen import ScenarioConfig
+
+    config = ScenarioConfig.from_env()
+    return {
+        "name": config.name,
+        "seed": config.seed,
+        "scale": config.scale,
+        "num_studies": config.num_studies,
+        "total_studies": config.total_studies,
+        "target": config.target,
+        "events": [e.as_dict() for e in config.events],
+    }
+
+
 def _mesh_env_config() -> dict:
     """The process-wide VIZIER_MESH* config, for artifact provenance."""
     import dataclasses
@@ -421,6 +437,11 @@ def main() -> None:
         # under armed SLOs (the sampler thread + exemplar capture) must be
         # distinguishable from one produced bare.
         "slo": _slo_env_config(),
+        # The loadgen scenario config (vizier_tpu.loadgen / VIZIER_LOADGEN*):
+        # bench drives designers directly, not the traffic engine, but a
+        # soak-adjacent artifact stamps which scenario the environment was
+        # set up for (tools/soak.py produces SOAK_REPORT.json itself).
+        "loadgen": _loadgen_env_config(),
     }
     if backend_tag:
         line["backend"] = backend_tag

@@ -1,0 +1,176 @@
+"""Failover vs in-flight RPCs: drain, late-write catch-up, barrier.
+
+The loadgen soak surfaced three interleavings the single-threaded chaos
+A/B never hits; these tests pin their fixes:
+
+1. ``fail_over`` DRAINS a dead replica's in-flight RPCs before reading
+   its WAL — otherwise a write the client already observed (a trial
+   returned by an in-flight suggest) is missing from the successors and
+   the very next ``CompleteTrial`` lands NotFound.
+2. An RPC that outlives its own replica's failover (the self-triggered
+   edge: a nested routed read inside the RPC trips the failover, which
+   must not wait on its own thread) has its late WAL appends **caught
+   up** onto the successors before its response reaches the client.
+3. Fresh RPCs park on the ``failover_barrier`` while a replay/copy-back
+   is mid-flight instead of reading a half-populated successor.
+"""
+
+import threading
+import time
+
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.distributed import ReplicaManager
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service.protos import vizier_service_pb2
+
+from tests.distributed.test_replica_manager import (  # noqa: F401
+    create_study,
+    study_config,
+)
+
+
+@pytest.fixture
+def manager(tmp_path):
+    mgr = ReplicaManager(3, wal_root=str(tmp_path))
+    yield mgr
+    mgr.shutdown()
+
+
+def _create_trial_request(study_name: str):
+    trial = vz.Trial(parameters={"x": 0.5})
+    return vizier_service_pb2.CreateTrialRequest(
+        parent=study_name, trial=pc.trial_to_proto(trial)
+    )
+
+
+class TestInflightDrain:
+    def test_fail_over_waits_for_inflight_rpc(self, manager):
+        name = create_study(manager, "drain")
+        owner = manager.router.replica_for(name)
+        replica = manager.replica(owner)
+
+        entered, release = threading.Event(), threading.Event()
+        original = replica.servicer.CreateTrial
+
+        def slow_create(request):
+            entered.set()
+            assert release.wait(10.0)
+            return original(request)
+
+        replica.servicer.CreateTrial = slow_create
+        rpc = threading.Thread(
+            target=lambda: manager.stub.CreateTrial(
+                _create_trial_request(name)
+            )
+        )
+        rpc.start()
+        assert entered.wait(5.0)
+        manager.kill_replica(owner)
+
+        failed_over = threading.Event()
+        failover = threading.Thread(
+            target=lambda: (manager.fail_over(owner), failed_over.set())
+        )
+        failover.start()
+        # The drain must hold the replay behind the in-flight write.
+        time.sleep(0.25)
+        assert not failed_over.is_set()
+        release.set()
+        rpc.join(5.0)
+        failover.join(5.0)
+        assert failed_over.is_set()
+        # The in-flight write survived onto the successor.
+        successor = manager.router.replica_for(name)
+        assert successor != owner
+        trials = manager.stub.ListTrials(
+            vizier_service_pb2.ListTrialsRequest(parent=name)
+        ).trials
+        assert len(trials) == 1
+
+    def test_late_writes_catch_up_after_self_triggered_failover(
+        self, manager
+    ):
+        name = create_study(manager, "catchup")
+        owner = manager.router.replica_for(name)
+        replica = manager.replica(owner)
+
+        entered, release = threading.Event(), threading.Event()
+        original = replica.servicer.CreateTrial
+
+        def write_after_own_failover(request):
+            entered.set()
+            assert release.wait(10.0)
+            # The RPC's own thread completes the failover (the nested-
+            # read edge): the drain must not wait on this thread, and the
+            # write below lands AFTER the WAL replay.
+            manager.fail_over(owner)
+            return original(request)
+
+        replica.servicer.CreateTrial = write_after_own_failover
+        rpc = threading.Thread(
+            target=lambda: manager.stub.CreateTrial(
+                _create_trial_request(name)
+            )
+        )
+        rpc.start()
+        assert entered.wait(5.0)
+        manager.kill_replica(owner)
+        release.set()
+        rpc.join(10.0)
+        assert not rpc.is_alive()
+        # The post-replay write was caught up onto the successor before
+        # the RPC returned.
+        trials = manager.stub.ListTrials(
+            vizier_service_pb2.ListTrialsRequest(parent=name)
+        ).trials
+        assert len(trials) == 1
+
+    def test_barrier_parks_fresh_rpcs_during_transition(self, manager):
+        name = create_study(manager, "barrier")
+        # Hold a transition open and check a fresh routed RPC waits.
+        manager._begin_transition()
+        started, finished = threading.Event(), threading.Event()
+
+        def fresh_rpc():
+            started.set()
+            manager.stub.GetStudy(
+                vizier_service_pb2.GetStudyRequest(name=name)
+            )
+            finished.set()
+
+        thread = threading.Thread(target=fresh_rpc)
+        thread.start()
+        assert started.wait(5.0)
+        time.sleep(0.2)
+        assert not finished.is_set()
+        manager._end_transition()
+        thread.join(5.0)
+        assert finished.is_set()
+
+    def test_barrier_exempts_threads_inside_an_endpoint_call(self, manager):
+        name = create_study(manager, "nested")
+        owner = manager.router.replica_for(name)
+        replica = manager.replica(owner)
+        original = replica.servicer.GetStudy
+        nested_done = threading.Event()
+
+        def nested_read(request):
+            # A routed read from INSIDE an endpoint call must pass the
+            # barrier even mid-transition (the drain waits on us).
+            manager._begin_transition()
+            try:
+                manager.stub.ListTrials(
+                    vizier_service_pb2.ListTrialsRequest(parent=name)
+                )
+                nested_done.set()
+            finally:
+                manager._end_transition()
+            return original(request)
+
+        replica.servicer.GetStudy = nested_read
+        manager.stub.GetStudy(
+            vizier_service_pb2.GetStudyRequest(name=name)
+        )
+        assert nested_done.is_set()

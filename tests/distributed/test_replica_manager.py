@@ -134,10 +134,15 @@ class TestFailover:
         finally:
             restarted.close()
 
-    def test_fail_over_refuses_live_replica_and_is_idempotent(self, manager):
+    def test_fail_over_of_live_replica_is_noop_and_idempotent(self, manager):
         create_study(manager, "guard")
-        with pytest.raises(ValueError):
-            manager.fail_over("replica-0")
+        # A live replica is never failed over — and it is a no-op rather
+        # than an error because, under load, a concurrent revive can win
+        # the failover lock between a caller observing the replica dead
+        # and getting here (the loadgen soak's kill/revive track hits
+        # exactly that interleaving).
+        assert manager.fail_over("replica-0") == 0
+        assert manager.serving_stats()["failovers"] == 0
         manager.kill_replica("replica-0")
         manager.fail_over("replica-0")
         assert manager.fail_over("replica-0") == 0  # no-op second time

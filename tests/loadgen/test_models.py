@@ -1,0 +1,177 @@
+"""Loadgen traffic models: determinism, mixes, bounds, event tracks.
+
+The contract pinned here is the one the whole subsystem stands on: a
+scenario's expansion is a pure function of its config — same seed, same
+workload, bit for bit (arrivals, budgets, kinds, tenants, events) — and
+every knob produces what it claims (Zipf bounds, guaranteed kind
+coverage, sparse preseeds, crossover-straddling budgets).
+"""
+
+import dataclasses
+import random
+import unittest.mock
+
+import pytest
+
+from vizier_tpu.loadgen import models
+
+
+class TestDeterminism:
+    def test_same_seed_identical_expansion(self):
+        config = models.smoke_config(seed=7)
+        a = models.build_scenario(config)
+        b = models.build_scenario(config)
+        assert a.fingerprint() == b.fingerprint()
+        assert [s.as_dict() for s in a.studies] == [
+            s.as_dict() for s in b.studies
+        ]
+        assert a.events == b.events
+
+    def test_seed_changes_everything(self):
+        a = models.build_scenario(models.smoke_config(seed=0))
+        b = models.build_scenario(models.smoke_config(seed=1))
+        assert a.fingerprint() != b.fingerprint()
+        assert [s.arrival_s for s in a.studies] != [
+            s.arrival_s for s in b.studies
+        ]
+
+    def test_objectives_and_preseeds_are_seeded(self):
+        scenario = models.build_scenario(models.smoke_config(seed=3))
+        spec = scenario.studies[0]
+        assert scenario.optimum(spec) == scenario.optimum(spec)
+        assert scenario.preseed_points(spec) == scenario.preseed_points(spec)
+        params = {"x0": 0.5, "x1": 0.5}
+        assert scenario.objective(spec, params) == scenario.objective(
+            spec, params
+        )
+        # The optimum lives inside the search box, so regret is bounded.
+        assert all(0.2 <= v <= 0.8 for v in scenario.optimum(spec))
+
+    def test_fingerprint_covers_arrivals(self):
+        base = models.smoke_config(seed=5)
+        a = models.build_scenario(base)
+        b = models.build_scenario(
+            dataclasses.replace(base, arrival_rate_per_s=999.0)
+        )
+        assert a.fingerprint() != b.fingerprint()
+
+
+class TestSamplers:
+    def test_zipf_budgets_bounded_and_heavy_headed(self):
+        rng = random.Random(0)
+        sizes = models.zipf_budgets(rng, 2000, alpha=1.1, lo=1, hi=16)
+        assert min(sizes) == 1 and max(sizes) <= 16
+        # Power law: size-1 studies dominate size-16 studies.
+        assert sizes.count(1) > 10 * sizes.count(16)
+
+    def test_arrivals_monotonic_and_bursty(self):
+        config = models.smoke_config(
+            arrival_rate_per_s=100.0, burst_factor=8.0
+        )
+        times = models.arrival_times(random.Random(1), config, 500)
+        assert all(b > a for a, b in zip(times, times[1:]))
+
+    def test_weighted_choice_respects_weights(self):
+        rng = random.Random(2)
+        draws = [
+            models.weighted_choice(rng, (("a", 9.0), ("b", 1.0)))
+            for _ in range(1000)
+        ]
+        assert draws.count("a") > 700
+
+
+class TestMixes:
+    def test_every_mix_kind_gets_a_study(self):
+        scenario = models.build_scenario(models.smoke_config())
+        assert scenario.kinds_present() == sorted(
+            k for k, _ in scenario.config.kind_mix
+        )
+
+    def test_gp_kinds_validated_against_registry(self):
+        with pytest.raises(ValueError, match="Unknown traffic kinds"):
+            models.ScenarioConfig(kind_mix=(("nonsense", 1.0),))
+
+    def test_sparse_kinds_preseed_past_threshold(self):
+        scenario = models.build_scenario(models.smoke_config())
+        threshold = scenario.config.sparse_threshold
+        for spec in scenario.studies:
+            if spec.kind in models.SPARSE_KINDS:
+                assert spec.preseed >= threshold
+            elif spec.kind in models.GP_KINDS:
+                assert spec.preseed < threshold
+
+    def test_crossover_study_guaranteed(self):
+        scenario = models.build_scenario(models.smoke_config())
+        crossers = scenario.crossover_studies()
+        assert crossers, "ensure_crossover must stretch one exact-GP study"
+        threshold = scenario.config.sparse_threshold
+        for spec in crossers:
+            assert spec.preseed < threshold <= spec.preseed + spec.budget
+
+
+class TestEvents:
+    def test_default_track_has_kill_revive_on_replica_target(self):
+        scenario = models.build_scenario(
+            models.smoke_config(target="replicas", replicas=2)
+        )
+        kinds = [e.kind for e in scenario.events]
+        assert "kill_replica" in kinds and "revive_replica" in kinds
+
+    def test_inprocess_target_has_no_replica_events(self):
+        scenario = models.build_scenario(
+            models.smoke_config(target="inprocess", chaos_fault_prob=0.0)
+        )
+        assert scenario.events == ()
+
+    def test_parse_event_track(self):
+        config = models.smoke_config()
+        events = models.parse_event_track(
+            "kill_replica:owner:0@0.4,revive_replica:owner:0@0.7,"
+            "chaos_on@0.5,chaos_off@0.6",
+            config,
+        )
+        assert [e.kind for e in events] == [
+            "kill_replica",
+            "chaos_on",
+            "chaos_off",
+            "revive_replica",
+        ]
+        assert events[0].arg == "owner:0"
+        assert all(e.at_completed >= 1 for e in events)
+
+    def test_unknown_event_kind_rejected(self):
+        with pytest.raises(ValueError, match="Unknown event kind"):
+            models.EventSpec(1, "explode")
+
+
+class TestEnvConfig:
+    def test_from_env_reads_loadgen_switches(self):
+        with unittest.mock.patch.dict(
+            "os.environ",
+            {
+                "VIZIER_LOADGEN_SEED": "42",
+                "VIZIER_LOADGEN_SCALE": "0.5",
+                "VIZIER_LOADGEN_STUDIES": "10",
+                "VIZIER_LOADGEN_TARGET": "inprocess",
+            },
+        ):
+            config = models.ScenarioConfig.from_env()
+        assert config.seed == 42
+        assert config.scale == 0.5
+        assert config.num_studies == 10
+        assert config.target == "inprocess"
+        assert config.total_studies == 5
+
+    def test_from_env_event_track(self):
+        with unittest.mock.patch.dict(
+            "os.environ",
+            {"VIZIER_LOADGEN_EVENTS": "chaos_on@0.2,chaos_off@0.4"},
+        ):
+            config = models.ScenarioConfig.from_env()
+        assert [e.kind for e in config.events] == ["chaos_on", "chaos_off"]
+
+    def test_overrides_beat_env(self):
+        with unittest.mock.patch.dict(
+            "os.environ", {"VIZIER_LOADGEN_SEED": "42"}
+        ):
+            assert models.ScenarioConfig.from_env(seed=7).seed == 7

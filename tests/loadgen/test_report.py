@@ -1,0 +1,160 @@
+"""Loadgen assertion engine: synthetic arms through every verdict path."""
+
+import dataclasses
+
+from vizier_tpu.loadgen import driver as driver_lib
+from vizier_tpu.loadgen import models
+from vizier_tpu.loadgen import report as report_lib
+
+
+def _scenario(**overrides):
+    config = models.smoke_config(
+        kind_mix=(("random", 1.0),),
+        num_studies=4,
+        chaos_fault_prob=0.0,
+        target="inprocess",
+        **overrides,
+    )
+    return models.build_scenario(config)
+
+
+def _outcome(spec, *, completed=None, listed=None, best=0.0, error=None):
+    completed = spec.budget if completed is None else completed
+    listed = (
+        spec.preseed + completed if listed is None else listed
+    )
+    trajectory = tuple(
+        (("x0", 0.1 * (i + 1)), ("x1", 0.2)) for i in range(completed)
+    )
+    return driver_lib.StudyOutcome(
+        spec=spec,
+        completed=completed,
+        expected=spec.budget,
+        listed_completed=listed,
+        trajectory=trajectory,
+        best_curve=tuple(best + 0.01 * i for i in range(completed))
+        or (),
+        error=error,
+    )
+
+
+def _result(scenario, *, arm="engine", lost=(), fallbacks=0, hits=0):
+    records, outcomes = [], {}
+    for spec in scenario.studies:
+        outcomes[spec.index] = _outcome(
+            spec,
+            listed=None if spec.index not in lost else 0,
+        )
+        for step in range(spec.budget):
+            records.append(
+                driver_lib.RequestRecord(
+                    spec.index,
+                    spec.kind,
+                    spec.tenant,
+                    "suggest",
+                    0.002,
+                    trace_id=f"t{spec.index}-{step}",
+                    fallback=fallbacks > 0 and step == 0,
+                    speculative_hit=hits > 0 and step == 0,
+                )
+            )
+    return driver_lib.SoakResult(
+        arm=arm,
+        scenario_fingerprint=scenario.fingerprint(),
+        records=records,
+        outcomes=outcomes,
+        events_fired=[],
+        serving_stats={},
+        slo={"armed": True, "breaching": [], "statuses": []},
+        wall_s=1.0,
+    )
+
+
+class TestAssertions:
+    def test_clean_run_passes_every_assertion(self):
+        scenario = _scenario()
+        engine = _result(scenario)
+        reference = _result(scenario, arm="reference")
+        gated = _result(scenario, arm="gated_off")
+        report = report_lib.build_report(scenario, engine, reference, gated)
+        assert report["ok"], report["assertions"]
+        assert report["scenario"]["fingerprint"] == scenario.fingerprint()
+
+    def test_lost_study_fails_zero_lost(self):
+        scenario = _scenario()
+        engine = _result(scenario, lost=(0,))
+        report = report_lib.build_report(scenario, engine)
+        by_name = {a["name"]: a for a in report["assertions"]}
+        assert not by_name["zero_lost_studies"]["ok"]
+        assert not report["ok"]
+        assert report["failover"]["lost_studies"] == [0]
+
+    def test_missing_arms_fail_their_assertions(self):
+        scenario = _scenario()
+        report = report_lib.build_report(scenario, _result(scenario))
+        by_name = {a["name"]: a for a in report["assertions"]}
+        assert not by_name["regret_parity"]["ok"]
+        assert not by_name["bit_identical_when_gated"]["ok"]
+
+    def test_trajectory_mismatch_fails_bit_identity(self):
+        scenario = _scenario()
+        engine = _result(scenario)
+        reference = _result(scenario, arm="reference")
+        gated = _result(scenario, arm="gated_off")
+        first = scenario.studies[0].index
+        gated.outcomes[first] = dataclasses.replace(
+            gated.outcomes[first],
+            trajectory=((("x0", 0.999), ("x1", 0.2)),),
+        )
+        report = report_lib.build_report(scenario, engine, reference, gated)
+        assert not report["bit_identity"]["identical"]
+        assert not report["ok"]
+
+    def test_fallback_budget_enforced(self):
+        scenario = _scenario()
+        config = dataclasses.replace(
+            scenario.config, max_fallback_rate=0.0
+        )
+        scenario = models.Scenario(config, scenario.studies, scenario.events)
+        engine = _result(scenario, fallbacks=1)
+        report = report_lib.build_report(scenario, engine)
+        by_name = {a["name"]: a for a in report["assertions"]}
+        assert not by_name["fallback_rate_bounded"]["ok"]
+
+    def test_speculative_assertion_when_armed(self):
+        config = models.smoke_config(
+            kind_mix=(("gp_bandit", 1.0),),
+            num_studies=2,
+            chaos_fault_prob=0.0,
+            target="inprocess",
+            planes=models.PlaneConfig(
+                batching=False, speculative=True, mesh=False, slo=False
+            ),
+        )
+        scenario = models.build_scenario(config)
+        # No hits -> the armed speculative assertion fails.
+        report = report_lib.build_report(scenario, _result(scenario))
+        by_name = {a["name"]: a for a in report["assertions"]}
+        assert not by_name["speculative_hits"]["ok"]
+        # With a hit it passes.
+        report = report_lib.build_report(
+            scenario, _result(scenario, hits=1)
+        )
+        by_name = {a["name"]: a for a in report["assertions"]}
+        assert by_name["speculative_hits"]["ok"]
+
+    def test_ranksum_identical_samples_is_parity(self):
+        assert report_lib.ranksum_p([1.0, 2.0, 3.0], [1.0, 2.0, 3.0]) > 0.9
+        assert (
+            report_lib.ranksum_p(
+                [1.0, 1.1, 1.2, 1.3, 1.4], [9.0, 9.1, 9.2, 9.3, 9.4]
+            )
+            < 0.05
+        )
+
+    def test_render_verdict_shape(self):
+        scenario = _scenario()
+        report = report_lib.build_report(scenario, _result(scenario))
+        text = report_lib.render_verdict(report)
+        assert "soak: FAIL" in text  # reference arms missing
+        assert "zero_lost_studies" in text

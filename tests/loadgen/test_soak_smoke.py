@@ -1,0 +1,142 @@
+"""The tier-1 mini-soak: the full loadgen engine, seconds-scale.
+
+One smoke scenario end to end through the REAL stack — every registered
+compute-IR program kind gets traffic (exact + sparse GP-bandit, exact +
+sparse UCB-PE, with a surrogate crossover mid-run), a 2-replica
+WAL-backed tier takes a kill AND a revive, batching + SLO planes armed —
+then the sequential reference and gated-off arms, asserted through the
+report: regret parity, zero lost studies, failover completeness, and
+bit-identical gated-off trajectories. This is the wiring-regression net:
+any serving-plane change that breaks composition fails here, in seconds,
+not in the slow acceptance soak.
+"""
+
+import pytest
+
+from vizier_tpu.loadgen import driver as driver_lib
+from vizier_tpu.loadgen import models
+from vizier_tpu.loadgen import report as report_lib
+
+
+@pytest.fixture(scope="module")
+def soak_arms():
+    scenario = models.build_scenario(models.smoke_config())
+    engine = driver_lib.run(scenario, arm="engine")
+    reference = driver_lib.run_reference(scenario)
+    gated = driver_lib.run_gated_off(scenario)
+    return scenario, engine, reference, gated
+
+
+@pytest.fixture(scope="module")
+def soak_report(soak_arms):
+    scenario, engine, reference, gated = soak_arms
+    return report_lib.build_report(scenario, engine, reference, gated)
+
+
+class TestMiniSoak:
+    def test_all_assertions_pass(self, soak_report):
+        failed = [a for a in soak_report["assertions"] if not a["ok"]]
+        assert soak_report["ok"], failed
+
+    def test_all_program_kinds_served(self, soak_arms, soak_report):
+        scenario, engine, _, _ = soak_arms
+        served = {
+            kind
+            for kind, row in soak_report["outcomes"]["by_kind"].items()
+            if row["suggests"] - row["errors"] > 0
+        }
+        # Every registered DesignerProgram kind carried traffic.
+        assert set(models.GP_KINDS) <= served
+        # ... through real designer compute, not just the policy surface.
+        stats = engine.serving_stats
+        assert stats.get("cold_trains", 0) + stats.get("warm_trains", 0) > 0
+        assert stats.get("sparse_suggests", 0) > 0
+
+    def test_surrogate_crossover_happened(self, soak_arms):
+        _, engine, _, _ = soak_arms
+        assert engine.serving_stats.get("surrogate_crossovers", 0) >= 1
+
+    def test_kill_and_revive_fired_and_failed_over(self, soak_arms):
+        _, engine, _, _ = soak_arms
+        fired = {e["kind"] for e in engine.events_fired}
+        assert {"kill_replica", "revive_replica"} <= fired
+        assert int(engine.serving_stats.get("failovers", 0)) >= 1
+
+    def test_zero_lost_studies(self, soak_arms):
+        _, engine, _, _ = soak_arms
+        assert engine.lost_studies() == []
+        assert engine.errored_studies() == []
+        for outcome in engine.outcomes.values():
+            assert outcome.completed == outcome.expected
+            assert (
+                outcome.listed_completed
+                == outcome.spec.preseed + outcome.completed
+            )
+
+    def test_gated_off_is_bit_identical_to_reference(self, soak_report):
+        bit = soak_report["bit_identity"]
+        assert bit["identical"], bit["mismatched"]
+        assert bit["studies_compared"] >= 4
+
+    def test_outcomes_recorded_in_flight_recorder(self, soak_arms):
+        _, engine, _, _ = soak_arms
+        kinds = engine.recorder_event_kinds
+        assert kinds.get("loadgen_outcome", 0) >= sum(
+            o.completed for o in engine.outcomes.values()
+        )
+        assert kinds.get("replica_failover", 0) >= 1
+
+    def test_request_records_carry_trace_ids(self, soak_arms):
+        _, engine, _, _ = soak_arms
+        suggests = [r for r in engine.records if r.op == "suggest"]
+        assert suggests
+        assert all(r.trace_id for r in suggests)
+
+    def test_report_renders_and_serializes(self, soak_report):
+        import json
+
+        text = report_lib.render_verdict(soak_report)
+        assert "soak: PASS" in text
+        payload = json.loads(json.dumps(soak_report))
+        assert payload["version"] == report_lib.REPORT_VERSION
+
+
+class TestObsReportSoakSection:
+    def test_json_round_trip(self, soak_report, tmp_path):
+        import json
+        import pathlib
+        import sys
+
+        sys.path.insert(
+            0,
+            str(pathlib.Path(__file__).resolve().parents[2] / "tools"),
+        )
+        import obs_report
+
+        path = tmp_path / "SOAK_REPORT.json"
+        path.write_text(json.dumps(soak_report))
+        soak = obs_report.soak_activity(obs_report.load_soak(str(path)))
+        assert soak["ok"] is True
+        assert (
+            soak["traffic"]["studies"] == soak_report["traffic"]["studies"]
+        )
+        assert set(models.GP_KINDS) <= set(soak["by_kind"])
+        assert {a["name"] for a in soak["assertions"]} == {
+            a["name"] for a in soak_report["assertions"]
+        }
+        text = obs_report.render_soak(soak)
+        assert "soak: PASS" in text and "gp_ucb_pe_sparse" in text
+
+    def test_empty_report_degrades(self):
+        import pathlib
+        import sys
+
+        sys.path.insert(
+            0,
+            str(pathlib.Path(__file__).resolve().parents[2] / "tools"),
+        )
+        import obs_report
+
+        soak = obs_report.soak_activity({})
+        assert soak["ok"] is False
+        assert obs_report.render_soak(soak).startswith("soak: FAIL")

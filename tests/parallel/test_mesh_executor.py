@@ -101,6 +101,43 @@ class TestMeshConfigAndPlacements:
         seen = [d.id for p in pairs for d in p.devices]
         assert len(seen) == len(set(seen))
 
+    def test_multihost_carve_prefers_process_local_groups(self):
+        from vizier_tpu.parallel.mesh import _carve_device_groups
+
+        class FakeDevice:
+            def __init__(self, device_id, process_index):
+                self.id = device_id
+                self.process_index = process_index
+
+        # 2 hosts x 4 devices, divisible shard count: every group stays
+        # on one host (the flat slice would already do this — sanity).
+        devices = [FakeDevice(i, i // 4) for i in range(8)]
+        groups = _carve_device_groups(devices, 2)
+        assert len(groups) == 4
+        for group in groups:
+            assert len({d.process_index for d in group}) == 1
+        # Non-divisible shard count: the old flat slice produced [0,1,2]
+        # and [3,4,5] — the second group SPANS hosts. Process-local
+        # carving keeps one full group per host; the per-host remainders
+        # (3 and 7) pool to fewer than s and are dropped, like any
+        # trailing remainder.
+        groups = _carve_device_groups(devices, 3)
+        assert [[d.id for d in g] for g in groups] == [[0, 1, 2], [4, 5, 6]]
+        for group in groups:
+            assert len({d.process_index for d in group}) == 1
+        # Remainders still pool into a (necessarily) cross-host group when
+        # they add up to a full shard group: 2 hosts x 3 devices at s=2
+        # gives one local pair per host plus the pooled [2, 5].
+        tight = [FakeDevice(i, i // 3) for i in range(6)]
+        groups = _carve_device_groups(tight, 2)
+        assert [[d.id for d in g] for g in groups] == [[0, 1], [3, 4], [2, 5]]
+        # Single-host meshes are untouched by the preference: same carve
+        # as the flat slice.
+        single = [FakeDevice(i, 0) for i in range(8)]
+        assert [[d.id for d in g] for g in _carve_device_groups(single, 2)] == [
+            [0, 1], [2, 3], [4, 5], [6, 7],
+        ]
+
     def test_pad_to_shard_granularity(self):
         import jax
 

@@ -330,6 +330,122 @@ def load_metrics(path: str) -> dict:
     return loaded if isinstance(loaded, dict) else {}
 
 
+def soak_activity(report: dict) -> dict:
+    """Condenses a ``SOAK_REPORT.json`` (tools/soak.py) for the report.
+
+    Stdlib-only: traffic shape, the per-kind outcome table, SLO verdicts,
+    and the assertion list — the "did the full-stack soak hold" view.
+    """
+    out: dict = {
+        "ok": bool(report.get("ok")),
+        "traffic": {},
+        "by_kind": {},
+        "slo_breaching": [],
+        "events": [],
+        "assertions": [],
+    }
+    traffic = report.get("traffic") or {}
+    out["traffic"] = {
+        "studies": traffic.get("studies", 0),
+        "driven_trials": traffic.get("driven_trials", 0),
+        "wall_s": traffic.get("wall_s", 0.0),
+        "trials_per_s": traffic.get("achieved_trials_per_s", 0.0),
+        "studies_by_kind": traffic.get("studies_by_kind", {}),
+        "studies_by_tenant": traffic.get("studies_by_tenant", {}),
+        "trial_budget": traffic.get("trial_budget", {}),
+    }
+    outcomes = (report.get("outcomes") or {}).get("by_kind") or {}
+    for kind, row in sorted(outcomes.items()):
+        latency = row.get("latency") or {}
+        out["by_kind"][kind] = {
+            "studies": row.get("studies", 0),
+            "suggests": row.get("suggests", 0),
+            "errors": row.get("errors", 0),
+            "fallback_rate": row.get("fallback_rate", 0.0),
+            "hit_rate": row.get("hit_rate", 0.0),
+            "p50_ms": latency.get("p50_ms", 0.0),
+            "p99_ms": latency.get("p99_ms", 0.0),
+        }
+    slo = report.get("slo") or {}
+    out["slo_breaching"] = sorted(slo.get("breaching", []))
+    out["slo_armed"] = bool(slo.get("armed"))
+    failover = report.get("failover") or {}
+    out["events"] = [
+        e.get("kind") for e in failover.get("events_fired", [])
+    ]
+    out["failovers"] = failover.get("failovers", 0)
+    out["lost_studies"] = failover.get("lost_studies", [])
+    parity = report.get("parity") or {}
+    out["parity_ranksum_p"] = parity.get("ranksum_p")
+    bit = report.get("bit_identity") or {}
+    out["bit_identical"] = bit.get("identical")
+    out["assertions"] = [
+        {"name": a.get("name"), "ok": bool(a.get("ok"))}
+        for a in report.get("assertions", [])
+    ]
+    return out
+
+
+def load_soak(path: str) -> dict:
+    """Parses a SOAK_REPORT.json ({} on garbage)."""
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[obs_report] cannot read soak report {path}: {e}", file=sys.stderr)
+        return {}
+    return loaded if isinstance(loaded, dict) else {}
+
+
+def render_soak(soak: dict) -> str:
+    traffic = soak.get("traffic", {})
+    lines = [
+        f"soak: {'PASS' if soak.get('ok') else 'FAIL'} — "
+        f"{traffic.get('studies', 0)} studies / "
+        f"{traffic.get('driven_trials', 0)} trials in "
+        f"{traffic.get('wall_s', 0)}s "
+        f"({traffic.get('trials_per_s', 0)} trials/s)"
+    ]
+    mix = traffic.get("studies_by_kind") or {}
+    if mix:
+        lines.append(
+            "  traffic: "
+            + ", ".join(f"{kind}: {n}" for kind, n in sorted(mix.items()))
+        )
+    by_kind = soak.get("by_kind") or {}
+    if by_kind:
+        header = (
+            f"  {'kind':<20} {'studies':>7} {'suggests':>8} {'err':>4} "
+            f"{'fb rate':>8} {'hit rate':>8} {'p50 ms':>9} {'p99 ms':>9}"
+        )
+        lines.append(header)
+        for kind, row in sorted(by_kind.items()):
+            lines.append(
+                f"  {kind:<20} {row['studies']:>7d} {row['suggests']:>8d} "
+                f"{row['errors']:>4d} {row['fallback_rate']:>8.3f} "
+                f"{row['hit_rate']:>8.3f} {row['p50_ms']:>9.2f} "
+                f"{row['p99_ms']:>9.2f}"
+            )
+    if soak.get("slo_armed"):
+        breaching = soak.get("slo_breaching") or []
+        lines.append(
+            f"  slo: breached {', '.join(breaching) if breaching else 'none'}"
+        )
+    if soak.get("events"):
+        lines.append(
+            f"  events: {', '.join(soak['events'])} "
+            f"(failovers {soak.get('failovers', 0)}, lost studies "
+            f"{soak.get('lost_studies', [])})"
+        )
+    verdicts = ", ".join(
+        f"{a['name']}={'ok' if a['ok'] else 'FAIL'}"
+        for a in soak.get("assertions", [])
+    )
+    if verdicts:
+        lines.append(f"  assertions: {verdicts}")
+    return "\n".join(lines)
+
+
 def fleet_section(dump_dir: str) -> Optional[dict]:
     """The merged fleet report for a dump directory (None when the
     observability package is unimportable — the merge lives there)."""
@@ -441,12 +557,19 @@ def main() -> None:
         help="per-replica dump directory: merged cross-replica traces + "
         "failover timeline",
     )
+    parser.add_argument(
+        "--soak",
+        metavar="SOAK_REPORT_JSON",
+        help="tools/soak.py report: traffic shape, per-kind outcome "
+        "table, SLO verdicts, assertion list",
+    )
     args = parser.parse_args()
-    if not args.path and not (args.slo or args.fleet):
-        parser.error("need a span file, --slo, or --fleet")
+    if not args.path and not (args.slo or args.fleet or args.soak):
+        parser.error("need a span file, --slo, --fleet, or --soak")
 
     slo = slo_activity(load_metrics(args.slo)) if args.slo else None
     fleet = fleet_section(args.fleet) if args.fleet else None
+    soak = soak_activity(load_soak(args.soak)) if args.soak else None
 
     spans = load_spans(args.path) if args.path else []
     if args.trace:
@@ -468,6 +591,7 @@ def main() -> None:
                     "device_activity": devices,
                     "slo": slo,
                     "fleet": fleet,
+                    "soak": soak,
                     "phases": rows,
                 },
                 indent=2,
@@ -476,6 +600,8 @@ def main() -> None:
     elif not args.path:
         if slo is not None:
             print(render_slo(slo))
+        if soak is not None:
+            print(render_soak(soak))
         if fleet is not None:
             try:
                 from vizier_tpu.observability import fleet as fleet_lib
@@ -504,6 +630,8 @@ def main() -> None:
         )
         if slo is not None:
             print(render_slo(slo))
+        if soak is not None:
+            print(render_soak(soak))
         if fleet is not None:
             try:
                 from vizier_tpu.observability import fleet as fleet_lib

@@ -45,6 +45,16 @@ echo "== 3b3. SLO-armed observability soak (~2 min) =="
 JAX_PLATFORMS=cpu python tools/chaos_ab.py --trials 50 --slo-soak \
   --out /tmp/chaos_slo.json
 
+echo "== 3b4. full-stack loadgen soak (slow arm, ~20 min) =="
+#    -> SOAK_REPORT.json: >=1000 Zipf-sized studies across every
+#    registered program kind on a 2-replica WAL-backed tier, speculation
+#    + batching + mesh + SLO armed, kill/revive + chaos mid-run; asserts
+#    regret parity (rank-sum vs the sequential reference arm), zero lost
+#    studies, failover completeness, bounded fallback rate, SLO p99
+#    verdicts, and bit-identical gated-off trajectories in one verdict
+#    (docs/guides/loadtest.md; render with tools/obs_report.py --soak)
+JAX_PLATFORMS=cpu python tools/soak.py --mesh-devices 2
+
 echo "== 3b2. mesh-sharded batch execution A/B (~4 min) =="
 #    -> MESH_AB.json: 8 distinct concurrent shape buckets through the
 #    single-device executor vs an 8-placement mesh executor on 8

@@ -25,6 +25,7 @@ FLAGS=(-q -m 'not slow' --continue-on-collection-errors
 GROUPS_LIST=(
   "tests/analysis"
   "tests/parallel tests/compute"
+  "tests/loadgen"
   "tests/serving"
   "tests/observability"
   "tests/service tests/reliability tests/distributed tests/surrogates tests/pythia tests/pyvizier"

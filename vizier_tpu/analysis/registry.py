@@ -64,6 +64,7 @@ _SRV_DOC = "docs/guides/serving.md"
 _PERF_DOC = "docs/guides/performance.md"
 _SWITCH_DOC = "docs/guides/switching_from_oss_vizier.md"
 _RUN_DOC = "docs/guides/running_the_service.md"
+_LOAD_DOC = "docs/guides/loadtest.md"
 
 SWITCHES: Tuple[EnvSwitch, ...] = (
     # -- observability (ObservabilityConfig) -------------------------------
@@ -207,6 +208,20 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             "Process count for the multi-host mesh (0 = auto).", "0"),
     _switch("VIZIER_MESH_PROCESS_ID", "int", "MeshConfig", _PERF_DOC,
             "This process's id in the multi-host mesh (-1 = auto).", "-1"),
+    # -- loadgen traffic engine (loadgen.models.ScenarioConfig) ------------
+    _switch("VIZIER_LOADGEN_SEED", "int", "ScenarioConfig", _LOAD_DOC,
+            "Scenario seed: the whole workload expansion (arrivals, "
+            "sizes, mixes, events) is a pure function of it.", "0"),
+    _switch("VIZIER_LOADGEN_SCALE", "float", "ScenarioConfig", _LOAD_DOC,
+            "Study-count multiplier for the configured scenario.", "1.0"),
+    _switch("VIZIER_LOADGEN_STUDIES", "int", "ScenarioConfig", _LOAD_DOC,
+            "Base study count before scaling.", "64"),
+    _switch("VIZIER_LOADGEN_TARGET", "str", "ScenarioConfig", _LOAD_DOC,
+            "Serving target the driver runs against: inprocess | replicas.",
+            "replicas"),
+    _switch("VIZIER_LOADGEN_EVENTS", "str", "ScenarioConfig", _LOAD_DOC,
+            "Scripted event track, kind[:arg]@fraction entries ('' = the "
+            "scenario's built-in kill/revive + chaos track)."),
     # -- designers ---------------------------------------------------------
     _switch("VIZIER_DISABLE_MESH", "flag", "GPBanditDesigner", _SWITCH_DOC,
             "Opt out of the multi-device auto-mesh (set = disabled).", "0"),

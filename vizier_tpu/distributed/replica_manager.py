@@ -35,6 +35,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from vizier_tpu.distributed import config as config_lib
@@ -65,11 +66,11 @@ class _ReplicaEndpoint:
             return attr
 
         def call(*args, **kwargs):
-            if not self._replica.alive:
-                raise ReplicaDownError(
-                    f"replica {self._replica.replica_id} is down"
-                )
-            return attr(*args, **kwargs)
+            self._replica.enter()
+            try:
+                return attr(*args, **kwargs)
+            finally:
+                self._replica.leave()
 
         return call
 
@@ -84,6 +85,62 @@ class Replica:
         self.wal_dir = wal_dir
         self.alive = True
         self.endpoint = _ReplicaEndpoint(self)
+        # Manager-shared per-thread RPC depth (set by the manager): lets
+        # the failover barrier exempt threads already inside an endpoint
+        # call (their nested routed reads must not wait on a drain that is
+        # waiting on them).
+        self.thread_depth = threading.local()
+        # In-flight RPC accounting, per thread: failover drains these
+        # before reading the WAL (a dead replica's in-flight RPCs keep
+        # appending until they return — replaying before they finish
+        # silently drops writes the client already observed).
+        self._inflight_cond = threading.Condition()
+        self._inflight: Dict[int, int] = {}
+        # Set by fail_over: called (outside the condition) whenever an
+        # in-flight RPC leaves a dead replica, so writes it appended after
+        # the failover replay (it was admitted alive and kept executing —
+        # including the self-triggered-failover edge where a dispatch
+        # inside the RPC tripped the failover itself) are caught up onto
+        # the successors before the RPC's response reaches the client.
+        self.on_drained = None
+
+    def enter(self) -> None:
+        """Admits one RPC (liveness check + in-flight count, atomically)."""
+        tid = threading.get_ident()
+        with self._inflight_cond:
+            if not self.alive:
+                raise ReplicaDownError(f"replica {self.replica_id} is down")
+            self._inflight[tid] = self._inflight.get(tid, 0) + 1
+        self.thread_depth.n = getattr(self.thread_depth, "n", 0) + 1
+
+    def leave(self) -> None:
+        tid = threading.get_ident()
+        self.thread_depth.n = getattr(self.thread_depth, "n", 1) - 1
+        with self._inflight_cond:
+            count = self._inflight.get(tid, 0) - 1
+            if count <= 0:
+                self._inflight.pop(tid, None)
+            else:
+                self._inflight[tid] = count
+            self._inflight_cond.notify_all()
+            callback = self.on_drained if not self.alive else None
+        if callback is not None:
+            callback()
+
+    def wait_quiesced(self, timeout_secs: float) -> bool:
+        """Blocks until no OTHER thread has an RPC in flight (the calling
+        thread's own nested RPC must not deadlock its own failover — a
+        self-triggered failover from inside a dispatch is the rare edge
+        the timeout also backstops). Returns False on timeout."""
+        deadline = time.monotonic() + timeout_secs
+        me = threading.get_ident()
+        with self._inflight_cond:
+            while any(tid != me for tid in self._inflight):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cond.wait(remaining)
+        return True
 
 
 class ReplicaManager:
@@ -143,6 +200,13 @@ class ReplicaManager:
         )
 
         self._lock = threading.Lock()  # replica + failover bookkeeping only
+        # One per-thread RPC-depth record shared by every replica: the
+        # failover barrier exempts threads already inside an endpoint call.
+        self._thread_depth = threading.local()
+        # Topology transitions in progress (failover replay / revive
+        # copy-back): fresh RPCs park on the barrier until zero.
+        self._transition_cond = threading.Condition()
+        self._transitions = 0
         self._replicas: Dict[str, Replica] = {}
         for rid in replica_ids:
             self._replicas[rid] = self._build_replica(
@@ -155,12 +219,16 @@ class ReplicaManager:
             on_failure=self._on_endpoint_failure,
             registry=registry,
             retry_sink=self._record_retries,
+            barrier=self.failover_barrier,
         )
         self._pythia.connect_to_vizier(self._stub)
 
         # Failover serialization (never nests inside self._lock).
         self._failover_lock = threading.Lock()
         self._failed_over: set = set()
+        # replica_id -> WAL records already replayed onto successors
+        # (late-write catch-up baseline; see _catch_up_late_writes).
+        self._replayed_records: Dict[str, int] = {}
         self._health_stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
 
@@ -184,7 +252,9 @@ class ReplicaManager:
         # process's span ring back into per-replica files.
         servicer.replica_id = replica_id
         servicer.set_pythia(self._pythia)
-        return Replica(replica_id, servicer, datastore, wal_dir)
+        replica = Replica(replica_id, servicer, datastore, wal_dir)
+        replica.thread_depth = self._thread_depth
+        return replica
 
     def _record_retries(self, amount: int) -> None:
         self._pythia.serving_runtime.stats.increment("retries", amount)
@@ -266,6 +336,36 @@ class ReplicaManager:
             if close is not None:
                 close()
 
+    # -- topology-transition barrier ---------------------------------------
+
+    def failover_barrier(self, timeout_secs: float = 30.0) -> None:
+        """Routed-stub hook: parks fresh RPCs while a failover replay or
+        revive copy-back is mid-flight, so no request can land on a
+        successor the replay has not populated yet (NotFound there reads
+        as "study deleted" — no retry fixes it). Threads already inside an
+        endpoint call pass straight through: the failover drain is waiting
+        on exactly those threads, and parking their nested reads would
+        deadlock the drain. Bounded: after ``timeout_secs`` the request
+        proceeds and at worst degrades through the reliability layer."""
+        if getattr(self._thread_depth, "n", 0) > 0:
+            return
+        deadline = time.monotonic() + timeout_secs
+        with self._transition_cond:
+            while self._transitions > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._transition_cond.wait(remaining)
+
+    def _begin_transition(self) -> None:
+        with self._transition_cond:
+            self._transitions += 1
+
+    def _end_transition(self) -> None:
+        with self._transition_cond:
+            self._transitions -= 1
+            self._transition_cond.notify_all()
+
     # -- chaos / lifecycle -------------------------------------------------
 
     def kill_replica(self, replica_id: str) -> None:
@@ -286,25 +386,58 @@ class ReplicaManager:
         Returns the number of studies restored. Idempotent; a no-op for
         replicas that already failed over.
         """
+        # Fast path WITHOUT the failover lock: an RPC thread whose nested
+        # router read trips over the dead replica mid-failover must return
+        # immediately, not queue behind the in-progress failover that is
+        # draining it (the drain below waits for exactly such threads).
+        with self._lock:
+            if replica_id in self._failed_over:
+                return 0
         with self._failover_lock:
             with self._lock:
                 if replica_id in self._failed_over:
                     return 0
                 replica = self._replicas[replica_id]
                 if replica.alive:
-                    raise ValueError(
-                        f"Refusing to fail over live replica {replica_id}; "
-                        "kill_replica first."
-                    )
+                    # Either caller misuse (no kill first) or, under load,
+                    # a concurrent revive won the failover lock between
+                    # this caller observing the replica dead and getting
+                    # here — the replica is serving again, nothing to do.
+                    return 0
                 self._failed_over.add(replica_id)
-            self.router.mark_down(replica_id)
-            restored, successors = self._restore_from_wal(replica)
-            if replica.wal_dir:
-                # Its studies now live on successors: a live-replica
-                # ListStudies fan-out is complete again. RAM-only replicas
-                # stay unaccounted — their studies are gone, and listings
-                # keep failing loudly rather than silently shrinking.
-                self._stub.note_failed_over(replica_id)
+            self._begin_transition()  # fresh RPCs park until replay lands
+            try:
+                self.router.mark_down(replica_id)
+                # Late-write catch-up hook first (any leave() from here on
+                # serializes behind this failover via _failover_lock), then
+                # drain in-flight RPCs before reading the WAL: an RPC
+                # admitted while the replica was alive may still be
+                # appending; replaying a prefix would hand successors a
+                # store missing writes the client already saw (NotFound on
+                # the very next CompleteTrial).
+                replica.on_drained = (
+                    lambda: self._catch_up_late_writes(replica)
+                )
+                if not replica.wait_quiesced(30.0):
+                    _logger.warning(
+                        "Failing over %s with RPCs still in flight after "
+                        "30s; their writes catch up when they drain.",
+                        replica.replica_id,
+                    )
+                restored, successors, replayed = self._restore_from_wal(
+                    replica
+                )
+                with self._lock:
+                    self._replayed_records[replica_id] = replayed
+                if replica.wal_dir:
+                    # Its studies now live on successors: a live-replica
+                    # ListStudies fan-out is complete again. RAM-only
+                    # replicas stay unaccounted — their studies are gone,
+                    # and listings keep failing loudly rather than
+                    # silently shrinking.
+                    self._stub.note_failed_over(replica_id)
+            finally:
+                self._end_transition()
         # Counter updates (and the recorder append) outside the failover
         # lock: metric locks must not nest under tier mutexes
         # (serving-stack convention, enforced by the chaos soak's runtime
@@ -324,14 +457,14 @@ class ReplicaManager:
         )
         return restored
 
-    def _restore_from_wal(self, replica: Replica) -> Tuple[int, set]:
+    def _restore_from_wal(self, replica: Replica) -> Tuple[int, set, int]:
         """Replays a dead replica's WAL into its successors' datastores.
 
-        Returns ``(studies_restored, successor_ids)``.
+        Returns ``(studies_restored, successor_ids, records_replayed)``.
         """
         if not replica.wal_dir:
             # RAM-only replica: its studies are lost until recreated.
-            return 0, set()
+            return 0, set(), 0
         records, torn = wal_lib.read_directory(replica.wal_dir)
         if torn:
             _logger.warning(
@@ -349,7 +482,40 @@ class ReplicaManager:
             wal_lib.apply_record(successor.datastore, opcode, payload)
             studies.add(study_key)
             successors.add(successor_id)
-        return len(studies), successors
+        return len(studies), successors, len(records)
+
+    def _catch_up_late_writes(self, replica: Replica) -> None:
+        """Replays WAL records a dead replica appended AFTER its failover.
+
+        The self-triggered-failover edge: an RPC in flight on the dying
+        replica can itself trip the failover (a nested routed read hits
+        the corpse) and then keep executing — its writes land in the dead
+        WAL after the replay read. ``Replica.leave`` calls this when the
+        last such RPC drains, so the tail reaches the successors before
+        the RPC's response reaches the client. Idempotent and serialized
+        with failover/revive via ``_failover_lock``.
+        """
+        with self._failover_lock:
+            with self._lock:
+                start = self._replayed_records.get(replica.replica_id)
+            if start is None or not replica.wal_dir:
+                return  # failover incomplete or RAM-only: nothing to do
+            records, _torn = wal_lib.read_directory(replica.wal_dir)
+            tail = records[start:]
+            if not tail:
+                return
+            for opcode, payload in tail:
+                study_key = wal_lib.study_key_of(opcode, payload)
+                successor = self.replica(self.router.replica_for(study_key))
+                wal_lib.apply_record(successor.datastore, opcode, payload)
+            with self._lock:
+                self._replayed_records[replica.replica_id] = len(records)
+        recorder_lib.get_recorder().record(
+            None,
+            "replica_failover_catchup",
+            replica=replica.replica_id,
+            records=len(tail),
+        )
 
     def revive_replica(self, replica_id: str) -> None:
         """Restarts a replica warm from its WAL and routes its studies back.
@@ -365,27 +531,40 @@ class ReplicaManager:
         from vizier_tpu.service import vizier_service
         import dataclasses
 
-        with self._lock:
-            old = self._replicas[replica_id]
-            was_failed_over = replica_id in self._failed_over
-        if old.alive:
-            return
-        close = getattr(old.datastore, "close", None)
-        if close is not None:
-            close()
-        reliability = dataclasses.replace(
-            reliability_config_lib.ReliabilityConfig.from_env(),
-            deadlines=self.config.replica_deadlines,
-        )
-        fresh = self._build_replica(replica_id, vizier_service, reliability)
-        if was_failed_over:
-            self._copy_back_from_successors(fresh)
-        with self._lock:
-            self._replicas[replica_id] = fresh
-            self._failed_over.discard(replica_id)
-        # _ReplicaEndpoint objects are bound per Replica; repoint the stub.
-        self._stub.set_endpoint(replica_id, fresh.endpoint)
-        self.router.mark_up(replica_id)
+        # Serialize with fail_over (and the late-write catch-up): a revive
+        # racing an in-flight failover would copy back from successors the
+        # WAL replay is still populating — partial state marked up, the
+        # rest of the replay stranded on the successors.
+        with self._failover_lock:
+            with self._lock:
+                old = self._replicas[replica_id]
+                was_failed_over = replica_id in self._failed_over
+            if old.alive:
+                return
+            self._begin_transition()  # fresh RPCs park during copy-back
+            try:
+                close = getattr(old.datastore, "close", None)
+                if close is not None:
+                    close()
+                reliability = dataclasses.replace(
+                    reliability_config_lib.ReliabilityConfig.from_env(),
+                    deadlines=self.config.replica_deadlines,
+                )
+                fresh = self._build_replica(
+                    replica_id, vizier_service, reliability
+                )
+                if was_failed_over:
+                    self._copy_back_from_successors(fresh)
+                with self._lock:
+                    self._replicas[replica_id] = fresh
+                    self._failed_over.discard(replica_id)
+                    self._replayed_records.pop(replica_id, None)
+                # _ReplicaEndpoint objects are bound per Replica; repoint
+                # the stub.
+                self._stub.set_endpoint(replica_id, fresh.endpoint)
+                self.router.mark_up(replica_id)
+            finally:
+                self._end_transition()
         recorder_lib.get_recorder().record(
             None,
             "replica_revive",

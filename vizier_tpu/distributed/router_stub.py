@@ -113,6 +113,7 @@ class RoutedVizierStub:
         failure_threshold: int = 2,
         registry: Optional[metrics_lib.MetricsRegistry] = None,
         retry_sink: Optional[Callable[[int], None]] = None,
+        barrier: Optional[Callable[[], None]] = None,
     ):
         if not endpoints:
             raise ValueError("RoutedVizierStub needs at least one endpoint.")
@@ -123,6 +124,12 @@ class RoutedVizierStub:
         self._on_failure = on_failure
         self._failure_threshold = max(1, failure_threshold)
         self._retry_sink = retry_sink
+        # Topology-transition barrier (ReplicaManager.failover_barrier):
+        # called before resolving a route, it briefly parks fresh RPCs
+        # while a failover/revive is mid-replay, so requests cannot land
+        # on a successor the WAL replay has not populated yet (a NotFound
+        # there would read as "study deleted", which no retry fixes).
+        self._barrier = barrier
         self._lock = threading.Lock()  # resolved-endpoint + failure tables
         self._resolved: Dict[str, Any] = {}
         self._consecutive_failures: Dict[str, int] = {}
@@ -206,6 +213,8 @@ class RoutedVizierStub:
         extract = ROUTING_KEYS[method_name]
 
         def call(request):
+            if self._barrier is not None:
+                self._barrier()
             study_key = extract(request)
             replica_id = self.router.replica_for(study_key)
             self._requests.inc(replica=replica_id, method=method_name)

@@ -1,0 +1,58 @@
+"""vizier_tpu.loadgen: the production-shaped traffic engine.
+
+MLPerf-loadgen-shaped workload subsystem for the serving fleet: seeded
+deterministic traffic models (``models``), a virtual-client driver that
+runs them against real serving targets with the opt-in planes toggled per
+scenario (``driver``), and the assertion engine that turns one run into
+``SOAK_REPORT.json`` (``report``). Entry point: ``tools/soak.py``.
+"""
+
+from vizier_tpu.loadgen.driver import (
+    LoadgenPolicyFactory,
+    RequestRecord,
+    SoakResult,
+    StudyOutcome,
+    loadgen_reliability,
+    run,
+    run_gated_off,
+    run_reference,
+    scenario_env,
+)
+from vizier_tpu.loadgen.models import (
+    EventSpec,
+    PlaneConfig,
+    Scenario,
+    ScenarioConfig,
+    StudySpec,
+    build_scenario,
+    default_event_track,
+    parse_event_track,
+    smoke_config,
+    soak_config,
+)
+from vizier_tpu.loadgen.report import build_report, ranksum_p, render_verdict
+
+__all__ = [
+    "EventSpec",
+    "LoadgenPolicyFactory",
+    "PlaneConfig",
+    "RequestRecord",
+    "Scenario",
+    "ScenarioConfig",
+    "SoakResult",
+    "StudyOutcome",
+    "StudySpec",
+    "build_report",
+    "build_scenario",
+    "default_event_track",
+    "loadgen_reliability",
+    "parse_event_track",
+    "ranksum_p",
+    "render_verdict",
+    "run",
+    "run_gated_off",
+    "run_reference",
+    "scenario_env",
+    "smoke_config",
+    "soak_config",
+]

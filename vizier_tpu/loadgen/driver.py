@@ -1,0 +1,837 @@
+"""The loadgen driver: a virtual-client pool running a scenario against a
+real serving target.
+
+One :func:`run` call takes an expanded :class:`~vizier_tpu.loadgen.models.
+Scenario` and drives it end to end through a REAL stack — the in-process
+``VizierServicer`` + shared Pythia, or an N-replica ``ReplicaManager``
+tier behind the routed stub — with the scenario's serving planes
+(batching, speculation, mesh, SLO, flight recorder) armed via their own
+env switches for exactly the duration of the run. Nothing here stubs the
+serving path: suggestions come from the same policy factory, designer
+cache, coalescer, batch executor, and surrogate auto-switch production
+requests use, so a soak failure is a serving failure.
+
+Per-request outcomes (latency, speculative-hit stamp, fallback stamp,
+errors) are recorded keyed by trace_id into the PR 11 flight recorder and
+returned as :class:`RequestRecord` rows; per-study trajectories and
+best-so-far curves feed the report's regret-parity and bit-identity
+checks. The scripted event track fires at deterministic completed-trial
+counts: replica kill/revive (revive behind a drain gate — the handback
+protocol assumes quiesced traffic), and chaos transport-fault windows via
+``testing/chaos.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.loadgen import models
+from vizier_tpu.observability import flight_recorder as recorder_lib
+from vizier_tpu.observability import tracing as tracing_lib
+from vizier_tpu.reliability import config as reliability_config_lib
+from vizier_tpu.reliability import fallback as fallback_lib
+from vizier_tpu.reliability import retry as retry_lib
+from vizier_tpu.serving import speculative as speculative_lib
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import vizier_client
+from vizier_tpu.service.protos import vizier_service_pb2
+from vizier_tpu.testing import chaos as chaos_lib
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One driven request's outcome (what the report tables roll up)."""
+
+    study_index: int
+    kind: str
+    tenant: str
+    op: str  # "suggest" | "complete"
+    latency_s: float
+    trace_id: Optional[str] = None
+    speculative_hit: bool = False
+    fallback: bool = False
+    error: Optional[str] = None
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class StudyOutcome:
+    """One study's end state after the soak."""
+
+    spec: models.StudySpec
+    completed: int = 0
+    expected: int = 0
+    listed_completed: int = -1  # post-run verification sweep (list_trials)
+    trajectory: Tuple = ()
+    best_curve: Tuple = ()
+    error: Optional[str] = None
+
+    @property
+    def final_best(self) -> Optional[float]:
+        return self.best_curve[-1] if self.best_curve else None
+
+    @property
+    def lost(self) -> bool:
+        """True when the fleet dropped state for this study: driven
+        completions that the post-run trial listing cannot account for."""
+        return self.listed_completed < self.spec.preseed + self.completed
+
+
+@dataclasses.dataclass
+class SoakResult:
+    """Everything one arm's run produced (input to ``report.py``)."""
+
+    arm: str
+    scenario_fingerprint: str
+    records: List[RequestRecord]
+    outcomes: Dict[int, StudyOutcome]
+    events_fired: List[Dict[str, object]]
+    serving_stats: Dict[str, object]
+    slo: Dict[str, object]
+    wall_s: float
+    wal_root: Optional[str] = None
+    recorder_event_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def lost_studies(self) -> List[int]:
+        return sorted(i for i, o in self.outcomes.items() if o.lost)
+
+    def errored_studies(self) -> List[int]:
+        return sorted(
+            i for i, o in self.outcomes.items() if o.error is not None
+        )
+
+
+def scenario_env(config: models.ScenarioConfig) -> Dict[str, str]:
+    """The env-switch overlay a scenario runs under (patched around the
+    run, restored after): the planes plus the scenario-scoped surrogate
+    boundary, so a soak process needs no ambient environment setup."""
+    planes = config.planes
+    env = {
+        "VIZIER_BATCHING": "1" if planes.batching else "0",
+        "VIZIER_SPECULATIVE": "1" if planes.speculative else "0",
+        "VIZIER_MESH": "1" if planes.mesh else "0",
+        "VIZIER_SLO": "1" if planes.slo else "0",
+        "VIZIER_FLIGHT_RECORDER": "1" if planes.recorder else "0",
+        "VIZIER_SPARSE_THRESHOLD": str(config.sparse_threshold),
+        "VIZIER_SPARSE_INDUCING": str(config.sparse_inducing),
+        "VIZIER_SPARSE_HYSTERESIS": "2",
+    }
+    if planes.slo:
+        # Manual evaluation cadence: the driver evaluates at deterministic
+        # completion counts instead of a wall-clock sampler thread.
+        env["VIZIER_SLO_EVAL_INTERVAL_S"] = "0"
+        env["VIZIER_SLO_WINDOWS"] = "30,600"
+        env["VIZIER_SLO_SUGGEST_P99_MS"] = str(config.p99_budget_ms)
+    if planes.speculative:
+        env["VIZIER_SPECULATIVE_WORKERS"] = "2"
+    return env
+
+
+def loadgen_reliability() -> reliability_config_lib.ReliabilityConfig:
+    """Soak-speed reliability: full machinery, compressed backoffs (the
+    soak measures fleet behavior, not wall-clock sleeps — same shape as
+    tools/chaos_ab.py). Attempts are provisioned for the fault rate the
+    chaos windows inject: at the default 10% transport-fault probability,
+    3 attempts lose ~1e-3 of RPCs to consecutive faults — a thousands-of-
+    requests soak would flake on its own injected noise; 6 attempts put
+    exhaustion at ~1e-6, so a lost study means a real fleet bug again."""
+    return reliability_config_lib.ReliabilityConfig(
+        retry_max_attempts=6,
+        retry_base_delay_secs=0.01,
+        retry_max_delay_secs=0.1,
+        breaker_window_secs=0.5,
+        breaker_cooldown_secs=0.2,
+    )
+
+
+class LoadgenPolicyFactory:
+    """The service's own policy factory, made per-study deterministic.
+
+    GP algorithms keep the full serving path (designer cache, warm ARD,
+    surrogate auto-switch — ``DefaultPolicyFactory`` with the runtime)
+    while the scenario injects a per-study ``rng_seed`` plus its designer
+    economics (trimmed acquisition sweep / ARD budget) through the
+    factory's kwargs hook; RANDOM_SEARCH gets a per-study seeded designer
+    so baseline trajectories are reproducible too. Thread-safe: the
+    per-call injection rides a thread-local around the delegate call.
+    """
+
+    def __init__(self, scenario: models.Scenario):
+        self._scenario = scenario
+        self._seed_by_study = {s.name: s.seed for s in scenario.studies}
+        self._local = threading.local()
+        self._base = None
+        self._lock = threading.Lock()
+
+    def bind_runtime(self, serving_runtime) -> None:
+        """Connects the serving runtime (built by the target's Pythia)."""
+        from vizier_tpu.service import policy_factory as policy_factory_lib
+
+        with self._lock:
+            base = policy_factory_lib.DefaultPolicyFactory(
+                serving_runtime=serving_runtime
+            )
+            original = base._gp_designer_kwargs
+
+            def kwargs_hook():
+                kwargs = original()
+                extra = getattr(self._local, "gp_kwargs", None)
+                if extra:
+                    kwargs.update(extra)
+                return kwargs
+
+            base._gp_designer_kwargs = kwargs_hook
+            self._base = base
+
+    def _require_base(self):
+        with self._lock:
+            if self._base is None:
+                self.bind_runtime(None)
+            return self._base
+
+    def _gp_overrides(self, study_name: str) -> Dict[str, object]:
+        config = self._scenario.config
+        kwargs: Dict[str, object] = {}
+        seed = self._seed_by_study.get(study_name)
+        if seed is not None:
+            kwargs["rng_seed"] = seed
+        if config.acquisition_evals:
+            kwargs["max_acquisition_evaluations"] = config.acquisition_evals
+        if config.ard_restarts:
+            kwargs["ard_restarts"] = config.ard_restarts
+        if config.ard_maxiter:
+            from vizier_tpu.optimizers import lbfgs as lbfgs_lib
+
+            kwargs["ard_optimizer"] = lbfgs_lib.AdamOptimizer(
+                maxiter=config.ard_maxiter
+            )
+            kwargs["warm_start_min_trials"] = 0
+        return kwargs
+
+    def __call__(self, problem, algorithm, supporter, study_name):
+        base = self._require_base()
+        algo = (algorithm or "DEFAULT").upper()
+        seed = self._seed_by_study.get(study_name)
+        if algo == "RANDOM_SEARCH" and seed is not None:
+            from vizier_tpu.algorithms import designer_policy
+            from vizier_tpu.designers import random as random_designer
+
+            return designer_policy.DesignerPolicy(
+                supporter,
+                lambda p, **kw: random_designer.RandomDesigner(
+                    p.search_space, seed=seed
+                ),
+            )
+        self._local.gp_kwargs = self._gp_overrides(study_name)
+        try:
+            return base(problem, algorithm, supporter, study_name)
+        finally:
+            self._local.gp_kwargs = None
+
+
+# -- targets ---------------------------------------------------------------
+
+
+class _InProcessTarget:
+    """One VizierServicer + shared Pythia (the PR 1–5 single-node stack)."""
+
+    supports_replicas = False
+
+    def __init__(self, scenario: models.Scenario, reliability, factory):
+        from vizier_tpu.service import pythia_service, vizier_service
+
+        self._servicer = vizier_service.VizierServicer(
+            reliability_config=reliability
+        )
+        self._pythia = pythia_service.PythiaServicer(
+            self._servicer, factory, reliability_config=reliability
+        )
+        factory.bind_runtime(self._pythia.serving_runtime)
+        self._servicer.set_pythia(self._pythia)
+        self.wal_root = None
+
+    @property
+    def stub(self):
+        return self._servicer
+
+    @property
+    def runtime(self):
+        return self._pythia.serving_runtime
+
+    def serving_stats(self) -> dict:
+        return self._pythia.serving_stats()
+
+    def owner_of(self, study_name: str) -> Optional[str]:
+        return None
+
+    def kill_replica(self, replica_id: str) -> None:
+        raise RuntimeError("kill_replica needs the replicas target.")
+
+    revive_replica = kill_replica
+
+    def shutdown(self) -> None:
+        self._pythia.shutdown()
+
+
+class _ReplicaTarget:
+    """An N-replica WAL-backed ``ReplicaManager`` tier (the PR 6 stack)."""
+
+    supports_replicas = True
+
+    def __init__(self, scenario: models.Scenario, reliability, factory):
+        from vizier_tpu.distributed import ReplicaManager
+
+        self.wal_root = tempfile.mkdtemp(prefix="vizier-loadgen-wal-")
+        self._manager = ReplicaManager(
+            scenario.config.replicas,
+            wal_root=self.wal_root,
+            policy_factory=factory,
+            reliability_config=reliability,
+        )
+        factory.bind_runtime(self._manager.pythia.serving_runtime)
+
+    @property
+    def stub(self):
+        return self._manager.stub
+
+    @property
+    def runtime(self):
+        return self._manager.pythia.serving_runtime
+
+    def serving_stats(self) -> dict:
+        return self._manager.serving_stats()
+
+    def owner_of(self, study_name: str) -> str:
+        return self._manager.router.replica_for(study_name)
+
+    def kill_replica(self, replica_id: str) -> None:
+        self._manager.kill_replica(replica_id)
+
+    def revive_replica(self, replica_id: str) -> None:
+        self._manager.revive_replica(replica_id)
+
+    def shutdown(self) -> None:
+        self._manager.shutdown()
+
+
+def _build_target(scenario, reliability, factory):
+    if scenario.config.target == "replicas":
+        return _ReplicaTarget(scenario, reliability, factory)
+    return _InProcessTarget(scenario, reliability, factory)
+
+
+# -- traffic gate + event engine -------------------------------------------
+
+
+class _TrafficGate:
+    """Drain gate for handback windows: ``quiesce`` blocks new requests
+    and waits for in-flight ones; ``resume`` reopens. ``revive_replica``
+    is not a transactional migration (see ReplicaManager docs), so the
+    driver models what a production rollout would do: drain, hand back,
+    resume."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._active = 0
+        self._paused = False
+
+    def __enter__(self):
+        with self._cond:
+            while self._paused:
+                self._cond.wait()
+            self._active += 1
+        return self
+
+    def __exit__(self, *exc):
+        with self._cond:
+            self._active -= 1
+            self._cond.notify_all()
+        return False
+
+    def quiesce(self) -> None:
+        with self._cond:
+            self._paused = True
+            while self._active > 0:
+                self._cond.wait()
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+
+class _EventEngine:
+    """Fires the scripted track at deterministic completed-trial counts.
+
+    Exactly-once: whichever worker's completion crosses an event's
+    threshold fires it (under a lock, outside the request gate). Kill is
+    fire-and-forget — detection/failover runs through the normal channels;
+    revive drains traffic first via the gate.
+    """
+
+    def __init__(
+        self,
+        scenario: models.Scenario,
+        target,
+        monkey: chaos_lib.ChaosMonkey,
+        gate: _TrafficGate,
+    ):
+        self._scenario = scenario
+        self._target = target
+        self._monkey = monkey
+        self._gate = gate
+        self._lock = threading.Lock()
+        self._pending = sorted(
+            scenario.events, key=lambda e: (e.at_completed, e.kind)
+        )
+        self._resolved: Dict[str, str] = {}
+        self.fired: List[Dict[str, object]] = []
+
+    def _resolve_replica(self, arg: str, kind: str) -> Optional[str]:
+        if arg.startswith("owner:"):
+            # A kill's resolution is remembered so the paired revive
+            # targets the replica that actually died — after failover the
+            # router resolves the owner to the SUCCESSOR, not the corpse.
+            if kind != "kill_replica" and arg in self._resolved:
+                return self._resolved[arg]
+            index = int(arg.split(":", 1)[1])
+            spec = next(
+                (s for s in self._scenario.studies if s.index == index),
+                self._scenario.studies[0],
+            )
+            replica = self._target.owner_of(spec.name)
+            if kind == "kill_replica" and replica is not None:
+                self._resolved[arg] = replica
+            return replica
+        return arg or None
+
+    def on_completed(self, total_completed: int) -> None:
+        with self._lock:
+            due = [
+                e for e in self._pending if e.at_completed <= total_completed
+            ]
+            if not due:
+                return
+            self._pending = [
+                e for e in self._pending if e.at_completed > total_completed
+            ]
+        for event in due:
+            self._fire(event, total_completed)
+
+    def _fire(self, event: models.EventSpec, at: int) -> None:
+        record: Dict[str, object] = {
+            "kind": event.kind,
+            "scheduled_at": event.at_completed,
+            "fired_at": at,
+            "arg": event.arg,
+        }
+        try:
+            if event.kind == "chaos_on":
+                self._monkey.failure_prob = self._scenario.config.chaos_fault_prob
+            elif event.kind == "chaos_off":
+                self._monkey.failure_prob = 0.0
+            elif event.kind == "kill_replica":
+                replica = self._resolve_replica(event.arg, event.kind)
+                record["replica"] = replica
+                if replica is None or not self._target.supports_replicas:
+                    record["skipped"] = "no replica tier"
+                else:
+                    self._target.kill_replica(replica)
+            elif event.kind == "revive_replica":
+                replica = self._resolve_replica(event.arg, event.kind)
+                record["replica"] = replica
+                if replica is None or not self._target.supports_replicas:
+                    record["skipped"] = "no replica tier"
+                else:
+                    self._gate.quiesce()
+                    try:
+                        self._target.revive_replica(replica)
+                    finally:
+                        self._gate.resume()
+        except Exception as e:  # a failed event is a finding, not a crash
+            record["error"] = f"{type(e).__name__}: {e}"
+        self.fired.append(record)
+
+
+# -- the driver ------------------------------------------------------------
+
+
+def _study_config(spec: models.StudySpec, dim: int) -> vz.StudyConfig:
+    config = vz.StudyConfig(algorithm=spec.algorithm)
+    for d in range(dim):
+        config.search_space.root.add_float_param(f"x{d}", 0.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+def _is_speculative_hit(metadata) -> bool:
+    return (
+        metadata.ns(speculative_lib.SPECULATIVE_NAMESPACE).get(
+            speculative_lib.SPECULATIVE_KEY
+        )
+        == speculative_lib.SPECULATIVE_HIT_VALUE
+    )
+
+
+class _Run:
+    """Mutable state shared by the worker pool for one arm."""
+
+    def __init__(self, scenario: models.Scenario, target, monkey, recorder):
+        self.scenario = scenario
+        self.target = target
+        self.monkey = monkey
+        self.recorder = recorder
+        self.gate = _TrafficGate()
+        self.events = _EventEngine(scenario, target, monkey, self.gate)
+        self.records: List[RequestRecord] = []
+        self.outcomes: Dict[int, StudyOutcome] = {}
+        self.completed_total = 0
+        self.lock = threading.Lock()
+        self.start = time.perf_counter()
+        self.next_index = 0
+
+    def record(self, row: RequestRecord) -> None:
+        with self.lock:
+            self.records.append(row)
+
+    def completion(self) -> int:
+        with self.lock:
+            self.completed_total += 1
+            total = self.completed_total
+        if (
+            self.scenario.config.planes.slo
+            and total % 25 == 0
+            and self.target.runtime.slo_engine is not None
+        ):
+            self.target.runtime.slo_engine.evaluate()
+        self.events.on_completed(total)
+        return total
+
+    def pop_spec(self) -> Optional[models.StudySpec]:
+        with self.lock:
+            if self.next_index >= len(self.scenario.studies):
+                return None
+            spec = self.scenario.studies[self.next_index]
+            self.next_index += 1
+        scale = self.scenario.config.time_scale
+        if scale > 0:
+            release = self.start + spec.arrival_s * scale
+            delay = release - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        return spec
+
+
+def _run_study(run: _Run, spec: models.StudySpec, reliability) -> StudyOutcome:
+    scenario = run.scenario
+    outcome = StudyOutcome(spec=spec, expected=spec.budget)
+    tracer = tracing_lib.get_tracer()
+    parent = spec.name.rsplit("/studies/", 1)[0]
+    stub = chaos_lib.ChaosServiceStub(run.target.stub, run.monkey)
+    try:
+        # CreateStudy goes straight to the stub (VizierClient has no
+        # create-by-resource-name), so it needs its own transient-retry
+        # wrap — a chaos fault or a mid-failover routing error here must
+        # behave like it does on every other RPC. Every mutating RPC runs
+        # inside the traffic gate: the revive event's handback window
+        # quiesces ALL writes, not just the suggest loop (a study created
+        # on a successor mid-copy-back would strand there).
+        with run.gate:
+            retry_lib.RetryPolicy.from_config(
+                reliability, seed=spec.seed
+            ).call(
+                lambda: stub.CreateStudy(
+                    vizier_service_pb2.CreateStudyRequest(
+                        parent=parent,
+                        study=pc.study_to_proto(
+                            _study_config(spec, scenario.config.dim),
+                            spec.name,
+                        ),
+                    )
+                )
+            )
+        client = vizier_client.VizierClient(
+            stub, spec.name, f"loadgen-{spec.tenant}", reliability=reliability
+        )
+        for params, value in scenario.preseed_points(spec):
+            with run.gate:
+                created = client.create_trial(vz.Trial(parameters=params))
+                client.complete_trial(
+                    created.id, vz.Measurement(metrics={"obj": value})
+                )
+        trajectory: List[Tuple] = []
+        best_curve: List[float] = []
+        best = float("-inf")
+        for step in range(spec.budget):
+            with run.gate, tracer.span(
+                "loadgen.request",
+                study=spec.name,
+                kind=spec.kind,
+                tenant=spec.tenant,
+            ) as span:
+                ctx = tracer.current_context()
+                trace_id = ctx.trace_id if ctx is not None else None
+                t0 = time.perf_counter()
+                try:
+                    (trial,) = client.get_suggestions(1)
+                except Exception as e:
+                    latency = time.perf_counter() - t0
+                    span.add_event("loadgen.suggest_failed")
+                    run.record(
+                        RequestRecord(
+                            spec.index,
+                            spec.kind,
+                            spec.tenant,
+                            "suggest",
+                            latency,
+                            trace_id=trace_id,
+                            error=f"{type(e).__name__}: {e}",
+                        )
+                    )
+                    raise
+                latency = time.perf_counter() - t0
+                hit = _is_speculative_hit(trial.metadata)
+                fellback = fallback_lib.is_fallback_suggestion(trial.metadata)
+                run.record(
+                    RequestRecord(
+                        spec.index,
+                        spec.kind,
+                        spec.tenant,
+                        "suggest",
+                        latency,
+                        trace_id=trace_id,
+                        speculative_hit=hit,
+                        fallback=fellback,
+                    )
+                )
+                run.recorder.record(
+                    spec.name,
+                    "loadgen_outcome",
+                    op="suggest",
+                    traffic_kind=spec.kind,
+                    tenant=spec.tenant,
+                    step=step,
+                    latency_ms=round(latency * 1e3, 3),
+                    speculative_hit=hit,
+                    fallback=fellback,
+                )
+                parameters = {
+                    name: float(value)
+                    for name, value in trial.parameters.as_dict().items()
+                }
+                trajectory.append(
+                    tuple(
+                        sorted(
+                            (name, round(value, 12))
+                            for name, value in parameters.items()
+                        )
+                    )
+                )
+                objective = scenario.objective(spec, parameters)
+                best = max(best, objective)
+                best_curve.append(best)
+                t1 = time.perf_counter()
+                client.complete_trial(
+                    trial.id, vz.Measurement(metrics={"obj": objective})
+                )
+                run.record(
+                    RequestRecord(
+                        spec.index,
+                        spec.kind,
+                        spec.tenant,
+                        "complete",
+                        time.perf_counter() - t1,
+                        trace_id=trace_id,
+                    )
+                )
+            outcome.completed += 1
+            run.completion()
+            if (
+                scenario.config.think_time_s > 0
+                and spec.kind in models.GP_KINDS
+            ):
+                # The evaluation window: real trials take time to
+                # evaluate, which is exactly what gives the speculative
+                # pre-compute room to land before the next suggest.
+                time.sleep(scenario.config.think_time_s)
+        outcome.trajectory = tuple(trajectory)
+        outcome.best_curve = tuple(best_curve)
+    except Exception as e:
+        outcome.error = f"{type(e).__name__}: {e}"
+    return outcome
+
+
+def _verification_sweep(run: _Run, reliability) -> None:
+    """Post-run completeness check: every study's trials must all be
+    accounted for through the (possibly failed-over) serving tier."""
+    for spec in run.scenario.studies:
+        outcome = run.outcomes.get(spec.index)
+        if outcome is None:
+            continue
+        try:
+            client = vizier_client.VizierClient(
+                run.target.stub, spec.name, "loadgen-verify",
+                reliability=reliability,
+            )
+            trials = client.list_trials()
+            outcome.listed_completed = sum(
+                1 for t in trials if t.status == vz.TrialStatus.COMPLETED
+            )
+        except Exception as e:
+            outcome.listed_completed = -1
+            if outcome.error is None:
+                outcome.error = f"verify: {type(e).__name__}: {e}"
+
+
+def run(
+    scenario: models.Scenario,
+    *,
+    arm: str = "engine",
+    only_indices: Optional[Set[int]] = None,
+) -> SoakResult:
+    """Drives one arm of the scenario and returns its :class:`SoakResult`.
+
+    The scenario's env overlay (planes + surrogate boundary) is patched
+    around the run and restored after; the global tracer and flight
+    recorder are swapped for fresh ones so the run's observability is
+    self-contained.
+    """
+    import unittest.mock
+
+    config = scenario.config
+    if only_indices is not None:
+        scenario = models.Scenario(
+            config,
+            [s for s in scenario.studies if s.index in only_indices],
+            scenario.events,
+        )
+    env_patch = unittest.mock.patch.dict(
+        "os.environ", scenario_env(config)
+    )
+    env_patch.start()
+    prev_tracer = tracing_lib.set_tracer(tracing_lib.Tracer(max_spans=65536))
+    prev_recorder = recorder_lib.set_recorder(None)
+    target = None
+    reliability = loadgen_reliability()
+    try:
+        recorder = recorder_lib.get_recorder()
+        monkey = chaos_lib.ChaosMonkey(
+            seed=config.seed, failure_prob=0.0
+        )
+        factory = LoadgenPolicyFactory(scenario)
+        target = _build_target(scenario, reliability, factory)
+        run_state = _Run(scenario, target, monkey, recorder)
+
+        def worker():
+            while True:
+                spec = run_state.pop_spec()
+                if spec is None:
+                    return
+                outcome = _run_study(run_state, spec, reliability)
+                with run_state.lock:
+                    run_state.outcomes[spec.index] = outcome
+
+        threads = [
+            threading.Thread(target=worker, name=f"loadgen-client-{i}")
+            for i in range(max(1, config.concurrency))
+        ]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # Any events still pending at drain (trial volume fell short of a
+        # threshold — e.g. an errored study) fire now so the track always
+        # completes and the revive/copy-back is always exercised.
+        run_state.events.on_completed(1 << 62)
+        if config.planes.slo and target.runtime.slo_engine is not None:
+            target.runtime.slo_engine.evaluate()
+        _verification_sweep(run_state, reliability)
+        wall = time.perf_counter() - start
+        recorder_kinds: Dict[str, int] = {}
+        for event in recorder.events():
+            recorder_kinds[event["kind"]] = (
+                recorder_kinds.get(event["kind"], 0) + 1
+            )
+        return SoakResult(
+            arm=arm,
+            scenario_fingerprint=scenario.fingerprint(),
+            records=run_state.records,
+            outcomes=run_state.outcomes,
+            events_fired=run_state.events.fired,
+            serving_stats=target.serving_stats(),
+            slo=target.runtime.slo_report(),
+            wall_s=round(wall, 3),
+            wal_root=target.wal_root,
+            recorder_event_kinds=dict(sorted(recorder_kinds.items())),
+        )
+    finally:
+        if target is not None:
+            target.shutdown()
+        tracing_lib.set_tracer(prev_tracer)
+        recorder_lib.set_recorder(prev_recorder)
+        env_patch.stop()
+
+
+def run_reference(
+    scenario: models.Scenario, indices: Optional[Sequence[int]] = None
+) -> SoakResult:
+    """The sequential reference arm: the parity cohort's studies, one
+    client, in-process target, every plane gated off, no chaos, no events
+    — the seed-path ground truth the engine is compared against."""
+    cohort = (
+        set(indices)
+        if indices is not None
+        else {s.index for s in scenario.parity_cohort()}
+    )
+    ref_config = dataclasses.replace(
+        scenario.config,
+        target="inprocess",
+        concurrency=1,
+        planes=models.PlaneConfig.gated_off(),
+        chaos_fault_prob=0.0,
+        think_time_s=0.0,
+        time_scale=0.0,
+    )
+    reference = models.Scenario(
+        ref_config,
+        [s for s in scenario.studies if s.index in cohort],
+        (),
+    )
+    return run(reference, arm="reference")
+
+
+def run_gated_off(
+    scenario: models.Scenario, indices: Optional[Sequence[int]] = None
+) -> SoakResult:
+    """The engine with every plane gated off, same cohort as the
+    reference: bit-identity between this arm and the reference is the
+    proof that the loadgen engine itself perturbs nothing."""
+    cohort = (
+        set(indices)
+        if indices is not None
+        else {s.index for s in scenario.parity_cohort()}
+    )
+    gated_config = dataclasses.replace(
+        scenario.config,
+        target="inprocess",
+        planes=models.PlaneConfig.gated_off(),
+        chaos_fault_prob=0.0,
+        think_time_s=0.0,
+        time_scale=0.0,
+    )
+    gated = models.Scenario(
+        gated_config,
+        [s for s in scenario.studies if s.index in cohort],
+        (),
+    )
+    return run(gated, arm="gated_off")

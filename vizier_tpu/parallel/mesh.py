@@ -206,22 +206,56 @@ def multihost_mesh(config: Optional[MeshConfig] = None):
     return list(jax.devices())
 
 
+def _carve_device_groups(devices: Sequence[Any], s: int) -> List[List[Any]]:
+    """Groups ``devices`` into shard groups of ``s``, process-local first.
+
+    On a multi-host mesh a naive flat slice can put one submesh's devices
+    on different hosts, turning every intra-flush all-gather into a DCN
+    hop. Instead: group devices by ``process_index`` (order preserved),
+    carve each process's devices into s-sized groups, then pool each
+    process's remainder — in process order — into cross-process groups so
+    no device is dropped that a flat slice would have used. A final
+    remainder smaller than ``s`` is dropped, exactly like before.
+    """
+    by_process: dict = {}
+    order: List[Any] = []
+    for device in devices:
+        pid = getattr(device, "process_index", 0)
+        if pid not in by_process:
+            by_process[pid] = []
+            order.append(pid)
+        by_process[pid].append(device)
+    groups: List[List[Any]] = []
+    leftovers: List[Any] = []
+    for pid in order:
+        local = by_process[pid]
+        for start in range(0, len(local) - s + 1, s):
+            groups.append(local[start : start + s])
+        leftovers.extend(local[len(local) - len(local) % s :])
+    for start in range(0, len(leftovers) - s + 1, s):
+        groups.append(leftovers[start : start + s])
+    return groups
+
+
 def build_placements(config: MeshConfig) -> List[DevicePlacement]:
     """Carves the (possibly multi-host) device list into placements.
 
     ``num_devices`` caps how many devices participate; ``shard_devices``
-    groups them into equal submeshes (a trailing remainder group smaller
-    than ``shard_devices`` is dropped rather than compiled as its own
-    odd shape — use divisible counts for full utilization).
+    groups them into equal submeshes, **preferring process-local groups**
+    on multi-host meshes (see :func:`_carve_device_groups`) so a
+    placement's intra-flush sharding stays on-host whenever the counts
+    allow. A trailing remainder group smaller than ``shard_devices`` is
+    dropped rather than compiled as its own odd shape — use divisible
+    counts for full utilization.
     """
     devices = multihost_mesh(config)
     if config.num_devices:
         devices = devices[: config.num_devices]
     s = max(1, config.shard_devices)
+    groups = _carve_device_groups(devices, s)
     placements = [
-        DevicePlacement(i, devices[start : start + s])
-        for i, start in enumerate(range(0, len(devices) - s + 1, s))
+        DevicePlacement(i, group) for i, group in enumerate(groups)
     ]
     if not placements:  # fewer devices than one shard group: use them all
-        placements = [DevicePlacement(0, devices)]
+        placements = [DevicePlacement(0, list(devices))]
     return placements
