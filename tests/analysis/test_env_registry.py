@@ -53,16 +53,16 @@ class TestRealTree:
                 )
 
     def test_registry_covers_the_trees_switch_count(self):
-        # 59 in-tree env switches (incl. the 6 VIZIER_DISTRIBUTED* tier
-        # knobs, the 5 VIZIER_SPARSE* surrogate knobs, the 6
-        # VIZIER_SPECULATIVE* pre-compute knobs, the 6 VIZIER_MESH*
-        # execution-plane knobs, the 7 VIZIER_SLO* objectives, the 3
-        # VIZIER_FLIGHT_RECORDER* knobs, VIZIER_OBS_DUMP_DIR, and the 5
-        # VIZIER_LOADGEN* traffic-engine knobs) + 3 bench switches + the
-        # 2 reserved grpc constants. Growing the tree means growing this
-        # registry.
-        assert len(registry.SWITCHES) == 64
-        assert len(registry.env_switch_names()) == 62
+        # 63 in-tree env switches (incl. the 10 VIZIER_DISTRIBUTED* tier
+        # knobs — 6 topology/WAL + 4 replication — the 5 VIZIER_SPARSE*
+        # surrogate knobs, the 6 VIZIER_SPECULATIVE* pre-compute knobs,
+        # the 6 VIZIER_MESH* execution-plane knobs, the 7 VIZIER_SLO*
+        # objectives, the 3 VIZIER_FLIGHT_RECORDER* knobs,
+        # VIZIER_OBS_DUMP_DIR, and the 5 VIZIER_LOADGEN* traffic-engine
+        # knobs) + 3 bench switches + the 2 reserved grpc constants.
+        # Growing the tree means growing this registry.
+        assert len(registry.SWITCHES) == 68
+        assert len(registry.env_switch_names()) == 66
 
     def test_known_switches_declared(self):
         for name in (

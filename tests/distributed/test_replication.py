@@ -1,0 +1,660 @@
+"""Shared-nothing WAL replication: standby logs, streamers, recovery
+source selection, multi-failure failover, epoch-fenced revive."""
+
+import shutil
+import time
+
+import pytest
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.distributed import ReplicaManager
+from vizier_tpu.distributed import replication as repl
+from vizier_tpu.distributed import wal as wal_lib
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import ram_datastore
+from vizier_tpu.service.protos import study_pb2, vizier_service_pb2
+
+from tests.service import datastore_test_lib
+
+
+def _study_config(algorithm="RANDOM_SEARCH"):
+    config = vz.StudyConfig(algorithm=algorithm)
+    config.search_space.root.add_float_param("x", 0.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    return config
+
+
+def _create_study(stub, name):
+    parent = name.rsplit("/studies/", 1)[0]
+    stub.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(
+            parent=parent, study=pc.study_to_proto(_study_config(), name)
+        )
+    )
+
+
+def _complete_one_trial(stub, study_name, client_id="w"):
+    from vizier_tpu.service import vizier_client
+
+    client = vizier_client.VizierClient(stub, study_name, client_id)
+    (trial,) = client.get_suggestions(1)
+    client.complete_trial(
+        trial.id, vz.Measurement(metrics={"obj": 0.5})
+    )
+    return f"{study_name}/trials/{trial.id}"
+
+
+def _state_of(store) -> list:
+    inner = getattr(store, "_inner", store)
+    return list(wal_lib.export_records(inner))
+
+
+def _records(*items):
+    """(seq, opcode-ish study payloads) helper for plan tests."""
+    out = []
+    for seq, opcode, study in items:
+        if opcode == wal_lib.DELETE_STUDY:
+            payload = f"owners/o/studies/{study}".encode()
+        else:
+            payload = datastore_test_lib.make_study(
+                study=study
+            ).SerializeToString()
+        out.append((seq, opcode, payload))
+    return out
+
+
+class TestStandbyStore:
+    def test_append_ack_and_records(self, tmp_path):
+        store = repl.StandbyStore(str(tmp_path))
+        ok, last = store.append_batch(
+            "origin-a", 1, _records((1, wal_lib.CREATE_STUDY, "s0")),
+            reset=True, baseline_seq=0,
+        )
+        assert ok and last == 1
+        ok, last = store.append_batch(
+            "origin-a", 1, _records((2, wal_lib.UPDATE_STUDY, "s0"))
+        )
+        assert ok and last == 2
+        assert [r[0] for r in store.records_for("origin-a")] == [1, 2]
+
+    def test_stale_epoch_is_fenced(self, tmp_path):
+        store = repl.StandbyStore(str(tmp_path))
+        store.append_batch("origin-a", 2, [], reset=True)
+        ok, value = store.append_batch(
+            "origin-a", 1, _records((3, wal_lib.CREATE_STUDY, "s0"))
+        )
+        assert not ok and value == 2
+        # A reset from the stale epoch is fenced too.
+        ok, value = store.append_batch(
+            "origin-a", 1, [], reset=True
+        )
+        assert not ok and value == 2
+
+    def test_fence_without_data_rejects_old_generation(self, tmp_path):
+        store = repl.StandbyStore(str(tmp_path))
+        store.append_batch(
+            "origin-a", 1, _records((1, wal_lib.CREATE_STUDY, "s0")),
+            reset=True,
+        )
+        store.fence("origin-a", 2)
+        ok, _ = store.append_batch(
+            "origin-a", 1, _records((2, wal_lib.UPDATE_STUDY, "s0"))
+        )
+        assert not ok
+        # The new generation introduces itself with a baseline.
+        ok, _ = store.append_batch(
+            "origin-a", 2, _records((5, wal_lib.CREATE_STUDY, "s0")),
+            reset=True, baseline_seq=5,
+        )
+        assert ok
+
+    def test_epoch_advance_requires_baseline(self, tmp_path):
+        store = repl.StandbyStore(str(tmp_path))
+        store.append_batch("origin-a", 1, [], reset=True)
+        ok, _ = store.append_batch(
+            "origin-a", 2, _records((9, wal_lib.CREATE_STUDY, "s0"))
+        )
+        assert not ok  # bare append across an epoch boundary
+
+    def test_baseline_reset_replaces_log(self, tmp_path):
+        store = repl.StandbyStore(str(tmp_path))
+        store.append_batch(
+            "origin-a", 1,
+            _records((1, wal_lib.CREATE_STUDY, "s0"),
+                     (2, wal_lib.CREATE_STUDY, "s1")),
+            reset=True,
+        )
+        store.append_batch(
+            "origin-a", 2, _records((10, wal_lib.CREATE_STUDY, "s2")),
+            reset=True, baseline_seq=10,
+        )
+        records = store.records_for("origin-a")
+        assert [r[0] for r in records] == [10]
+        assert store.view_for("origin-a").baseline_seq == 10
+
+    def test_stale_records_below_last_seq_dropped(self, tmp_path):
+        store = repl.StandbyStore(str(tmp_path))
+        store.append_batch(
+            "origin-a", 1, _records((10, wal_lib.CREATE_STUDY, "s0")),
+            reset=True, baseline_seq=10,
+        )
+        # A straggler older than the baseline must not append behind it:
+        # replay order would regress state.
+        ok, last = store.append_batch(
+            "origin-a", 1, _records((7, wal_lib.UPDATE_STUDY, "s0"))
+        )
+        assert ok and last == 10
+        assert [r[0] for r in store.records_for("origin-a")] == [10]
+
+    def test_disk_round_trip(self, tmp_path):
+        store = repl.StandbyStore(str(tmp_path))
+        store.append_batch(
+            "origin-a", 3,
+            _records((5, wal_lib.CREATE_STUDY, "s0")),
+            reset=True, baseline_seq=5,
+        )
+        store.append_batch(
+            "origin-a", 3, _records((6, wal_lib.UPDATE_STUDY, "s0"))
+        )
+        store.close()
+        reloaded = repl.StandbyStore(str(tmp_path))
+        assert reloaded.epoch("origin-a") == 3
+        assert [r[0] for r in reloaded.records_for("origin-a")] == [5, 6]
+        assert reloaded.view_for("origin-a").baseline_seq == 5
+
+    def test_memory_mode_without_directory(self):
+        store = repl.StandbyStore(None)
+        store.append_batch(
+            "origin-a", 1, _records((1, wal_lib.CREATE_STUDY, "s0")),
+            reset=True,
+        )
+        assert store.last_seq("origin-a") == 1
+
+
+class TestPlanRecovery:
+    """The per-study recovery-source matrices the ISSUE names."""
+
+    def test_standby_wins_when_local_missing(self):
+        plan = repl.plan_recovery(
+            "origin",
+            [],  # no shared fs: the corpse's disk is gone
+            False,
+            [repl.StandbyView(0, _records((1, wal_lib.CREATE_STUDY, "s0")))],
+        )
+        (item,) = plan.studies
+        assert item.source == "standby" and item.seq == 1
+
+    def test_standby_wins_ties(self):
+        local = _records((5, wal_lib.CREATE_STUDY, "s0"))
+        standby = repl.StandbyView(
+            0, _records((5, wal_lib.CREATE_STUDY, "s0"))
+        )
+        plan = repl.plan_recovery("origin", local, False, [standby])
+        (item,) = plan.studies
+        assert item.source == "standby"
+
+    def test_local_wins_only_when_strictly_longer(self):
+        local = _records(
+            (5, wal_lib.CREATE_STUDY, "s0"),
+            (6, wal_lib.UPDATE_STUDY, "s0"),
+        )
+        standby = repl.StandbyView(
+            0, _records((5, wal_lib.CREATE_STUDY, "s0"))
+        )
+        plan = repl.plan_recovery("origin", local, False, [standby])
+        (item,) = plan.studies
+        assert item.source == "local" and item.seq == 6
+        assert len(item.records) == 2
+
+    def test_corrupt_mid_log_prefix_loses_to_longer_standby(self):
+        # The quarantine truncated local to seq 5; the standby streamed
+        # through seq 8 before the host vanished.
+        local = _records((5, wal_lib.CREATE_STUDY, "s0"))
+        standby = repl.StandbyView(
+            0,
+            _records(
+                (5, wal_lib.CREATE_STUDY, "s0"),
+                (8, wal_lib.UPDATE_STUDY, "s0"),
+            ),
+        )
+        plan = repl.plan_recovery("origin", local, True, [standby])
+        (item,) = plan.studies
+        assert item.source == "standby" and item.seq == 8
+        assert plan.local_torn
+
+    def test_best_standby_log_chosen_per_study(self):
+        stale = repl.StandbyView(
+            0, _records((3, wal_lib.CREATE_STUDY, "s0"))
+        )
+        fresh = repl.StandbyView(
+            0,
+            _records(
+                (3, wal_lib.CREATE_STUDY, "s0"),
+                (9, wal_lib.UPDATE_STUDY, "s0"),
+            ),
+        )
+        plan = repl.plan_recovery("origin", [], False, [stale, fresh])
+        (item,) = plan.studies
+        assert item.seq == 9 and len(item.records) == 2
+
+    def test_net_deleted_study_contributes_nothing(self):
+        local = _records(
+            (1, wal_lib.CREATE_STUDY, "s0"),
+            (2, wal_lib.DELETE_STUDY, "s0"),
+        )
+        plan = repl.plan_recovery("origin", local, False, [])
+        assert plan.studies == []
+        assert plan.max_seq == 2  # watermark still advances past it
+
+    def test_baseline_absence_outranks_stale_local_presence(self):
+        # The handback tombstone fell into the quarantined corrupt
+        # suffix: local still shows the moved-away study as live, but a
+        # LATER baseline (seq 20) omits it — absence wins.
+        local = _records((6, wal_lib.CREATE_STUDY, "s0"))
+        standby = repl.StandbyView(20, [])
+        plan = repl.plan_recovery("origin", local, True, [standby])
+        assert plan.studies == []
+
+    def test_absence_claim_ignored_for_non_successor_holders(self):
+        local = _records((6, wal_lib.CREATE_STUDY, "s0"))
+        standby = repl.StandbyView(20, [])
+        plan = repl.plan_recovery(
+            "origin",
+            local,
+            False,
+            [standby],
+            successors_fn=lambda study: ["replica-9"],  # holder not in set
+            holders=["replica-1"],
+        )
+        (item,) = plan.studies
+        assert item.source == "local"
+
+    def test_catch_up_tail_keeps_late_deletes(self):
+        local = _records(
+            (1, wal_lib.CREATE_STUDY, "s0"),
+            (7, wal_lib.DELETE_STUDY, "s0"),
+        )
+        plan = repl.plan_recovery("origin", local, False, [], min_seq=5)
+        (item,) = plan.studies
+        assert [opcode for opcode, _ in item.records] == [
+            wal_lib.DELETE_STUDY
+        ]
+
+    def test_catch_up_skips_already_replayed(self):
+        local = _records((1, wal_lib.CREATE_STUDY, "s0"))
+        plan = repl.plan_recovery("origin", local, False, [], min_seq=4)
+        assert plan.studies == []
+
+
+@pytest.fixture
+def manager(tmp_path):
+    mgr = ReplicaManager(3, wal_root=str(tmp_path / "wal"))
+    yield mgr
+    mgr.shutdown()
+
+
+class TestReplicatedFailover:
+    def test_failover_with_wal_dir_deleted(self, manager, tmp_path):
+        """The shared-nothing headline: the corpse's disk is GONE and the
+        study still fails over, from the successors' standby logs."""
+        study = "owners/o/studies/no-shared-fs"
+        _create_study(manager.stub, study)
+        _complete_one_trial(manager.stub, study)
+        owner = manager.router.replica_for(study)
+        assert manager.flush_replication(owner)
+        shutil.rmtree(tmp_path / "wal" / owner)
+        manager.kill_replica(owner)
+        restored = manager.fail_over(owner)
+        assert restored == 1
+        stats = manager.serving_stats()
+        assert stats["recovery_sources"].get("standby", 0) >= 1
+        got = manager.stub.GetStudy(
+            vizier_service_pb2.GetStudyRequest(name=study)
+        )
+        assert got.name == study
+        trials = manager.stub.ListTrials(
+            vizier_service_pb2.ListTrialsRequest(parent=study)
+        )
+        assert len(trials.trials) == 1
+        assert trials.trials[0].state == study_pb2.Trial.SUCCEEDED
+
+    def test_standby_replay_equals_local_replay_bit_for_bit(
+        self, manager, tmp_path
+    ):
+        """The ISSUE's equivalence matrix: recovering a replica's state
+        from the standby logs produces byte-identical records to
+        recovering it from its own local WAL."""
+        studies = [f"owners/o/studies/eq-{i}" for i in range(6)]
+        for name in studies:
+            _create_study(manager.stub, name)
+        for name in studies[:3]:
+            _complete_one_trial(manager.stub, name)
+        origin = manager.router.replica_for(studies[0])
+        assert manager.flush_replication(origin)
+        replica = manager.replica(origin)
+
+        # Local replay: the origin's own WAL directory.
+        local_store = ram_datastore.NestedDictRAMDataStore()
+        for opcode, payload in wal_lib.read_directory(replica.wal_dir)[0]:
+            wal_lib.apply_record(local_store, opcode, payload)
+
+        # Standby replay: merge the live peers' standby logs per study.
+        standby_store = ram_datastore.NestedDictRAMDataStore()
+        plan = manager.recovery_plan(origin, None)
+        for item in plan.studies:
+            assert item.source == "standby"
+            for opcode, payload in item.records:
+                wal_lib.apply_record(standby_store, opcode, payload)
+
+        assert wal_lib.export_records(standby_store) == (
+            wal_lib.export_records(local_store)
+        )
+
+    def test_concurrent_multi_replica_failure(self, manager):
+        studies = [f"owners/o/studies/multi-{i}" for i in range(12)]
+        for name in studies:
+            _create_study(manager.stub, name)
+        owners = {name: manager.router.replica_for(name) for name in studies}
+        dead = sorted(set(owners.values()))[:2]
+        for rid in dead:
+            manager.kill_replica(rid)
+        # ONE call sweeps every corpse, re-routing between steps.
+        manager.fail_over(dead[0])
+        assert manager.serving_stats()["failovers"] == 2
+        for name in studies:
+            assert manager.router.replica_for(name) not in dead
+            got = manager.stub.GetStudy(
+                vizier_service_pb2.GetStudyRequest(name=name)
+            )
+            assert got.name == name
+
+    def test_corrupt_local_wal_recovers_from_standby(
+        self, manager, tmp_path
+    ):
+        study = "owners/o/studies/corrupt-recovery"
+        _create_study(manager.stub, study)
+        trial_name = _complete_one_trial(manager.stub, study)
+        owner = manager.router.replica_for(study)
+        assert manager.flush_replication(owner)
+        # Mid-file corruption of the live log: the suffix (which holds
+        # the trial completion) becomes unreadable locally.
+        log = tmp_path / "wal" / owner / wal_lib.LOG_FILE
+        data = bytearray(log.read_bytes())
+        midpoint = len(data) // 2
+        data[midpoint : midpoint + 16] = b"\xff" * 16
+        log.write_bytes(bytes(data))
+        manager.kill_replica(owner)
+        manager.fail_over(owner)
+        trial = manager.stub.GetTrial(
+            vizier_service_pb2.GetTrialRequest(name=trial_name)
+        )
+        assert trial.state == study_pb2.Trial.SUCCEEDED
+        assert (
+            manager.serving_stats()["recovery_sources"].get("standby", 0)
+            >= 1
+        )
+
+    def test_replication_off_uses_legacy_local_path(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("VIZIER_DISTRIBUTED_REPLICATION", "0")
+        mgr = ReplicaManager(3, wal_root=str(tmp_path / "wal"))
+        try:
+            assert not mgr.replication_active
+            study = "owners/o/studies/legacy"
+            _create_study(mgr.stub, study)
+            owner = mgr.router.replica_for(study)
+            mgr.kill_replica(owner)
+            assert mgr.fail_over(owner) == 1
+            stats = mgr.serving_stats()
+            assert stats["recovery_sources"] == {"local": 1}
+            assert "replication" not in stats
+            got = mgr.stub.GetStudy(
+                vizier_service_pb2.GetStudyRequest(name=study)
+            )
+            assert got.name == study
+        finally:
+            mgr.shutdown()
+
+
+class TestEpochFencedRevive:
+    def test_revive_bumps_epoch_and_fences_stale_streamer(self, manager):
+        study = "owners/o/studies/fence"
+        _create_study(manager.stub, study)
+        owner = manager.router.replica_for(study)
+        plane = manager._replication
+        assert plane.epoch_of(owner) == 1
+        manager.kill_replica(owner)
+        manager.fail_over(owner)
+        manager.revive_replica(owner)
+        assert plane.epoch_of(owner) == 2
+        # A delivery from the dead generation (epoch 1) is rejected by
+        # every live standby store.
+        successor = next(
+            rid for rid in manager.replica_ids() if rid != owner
+        )
+        standby = manager.replica(successor).standby
+        ok, value = standby.append_batch(
+            owner, 1, _records((99, wal_lib.CREATE_STUDY, "stale"))
+        )
+        assert not ok and value == 2
+
+    def test_revive_under_live_traffic_keeps_state(self, manager):
+        study = "owners/o/studies/handback"
+        _create_study(manager.stub, study)
+        _complete_one_trial(manager.stub, study)
+        owner = manager.router.replica_for(study)
+        manager.kill_replica(owner)
+        manager.fail_over(owner)
+        _complete_one_trial(manager.stub, study, client_id="mid-failover")
+        # No external traffic gate: the epoch fence + failover barrier
+        # make the handback safe.
+        manager.revive_replica(owner)
+        assert manager.router.replica_for(study) == owner
+        trials = manager.stub.ListTrials(
+            vizier_service_pb2.ListTrialsRequest(parent=study)
+        )
+        completed = [
+            t for t in trials.trials if t.state == study_pb2.Trial.SUCCEEDED
+        ]
+        assert len(completed) == 2
+
+    def test_revive_resyncs_returning_replicas_standby_logs(self, manager):
+        study = "owners/o/studies/resync"
+        _create_study(manager.stub, study)
+        owner = manager.router.replica_for(study)
+        successor = manager._replication.successors_for(study, owner)[0]
+        # Kill the SUCCESSOR, mutate the study, revive the successor: its
+        # standby log must catch back up (proactive resync), so a
+        # subsequent owner death with a dead disk still recovers.
+        manager.kill_replica(successor)
+        manager.fail_over(successor)
+        _complete_one_trial(manager.stub, study)
+        manager.revive_replica(successor)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            records = manager.replica(successor).standby.records_for(owner)
+            if any(
+                wal_lib.study_key_of(op, pl) == study
+                and op == wal_lib.UPDATE_TRIAL
+                for _s, op, pl in records
+            ) or any(
+                _s >= manager.replica(owner).datastore.seq
+                for _s, op, pl in records
+            ):
+                break
+            time.sleep(0.02)
+        view = manager.replica(successor).standby.view_for(owner)
+        assert view is not None
+        assert max(
+            [view.baseline_seq] + [r[0] for r in view.records]
+        ) >= manager.replica(owner).datastore.seq - 1
+
+
+class TestSpeculativeRearm:
+    def test_failover_rearms_speculation_per_restored_study(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("VIZIER_SPECULATIVE", "1")
+        mgr = ReplicaManager(3, wal_root=str(tmp_path / "wal"))
+        try:
+            engine = mgr.pythia.serving_runtime.speculative_engine
+            assert engine is not None and engine.bound
+            study = "owners/o/studies/rearm"
+            _create_study(mgr.stub, study)
+            _complete_one_trial(mgr.stub, study)
+            owner = mgr.router.replica_for(study)
+            mgr.kill_replica(owner)
+            mgr.fail_over(owner)
+            stats = mgr.serving_stats()
+            assert stats.get("speculative_rearms", 0) >= 1
+        finally:
+            mgr.shutdown()
+
+    def test_no_rearm_without_completed_trials(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("VIZIER_SPECULATIVE", "1")
+        mgr = ReplicaManager(3, wal_root=str(tmp_path / "wal"))
+        try:
+            study = "owners/o/studies/no-rearm"
+            _create_study(mgr.stub, study)  # no completions
+            owner = mgr.router.replica_for(study)
+            mgr.kill_replica(owner)
+            mgr.fail_over(owner)
+            assert mgr.serving_stats().get("speculative_rearms", 0) == 0
+        finally:
+            mgr.shutdown()
+
+
+class TestStreamerMechanics:
+    def _fake_plane(self):
+        """A minimal in-memory successor pair driven directly."""
+        stores = {
+            "succ-a": repl.StandbyStore(None),
+            "succ-b": repl.StandbyStore(None),
+        }
+        alive = {"succ-a": True, "succ-b": True}
+        state = {"seq": 0, "records": []}
+
+        def deliver(successor, origin, epoch, records, reset, baseline_seq):
+            if not alive[successor]:
+                return None
+            return stores[successor].append_batch(
+                origin, epoch, records, reset=reset, baseline_seq=baseline_seq
+            )
+
+        def baseline(successor):
+            return state["seq"], [
+                (state["seq"], op, pl) for op, pl in state["records"]
+            ]
+
+        return stores, alive, state, deliver, baseline
+
+    def test_appends_reach_both_successors(self):
+        stores, alive, state, deliver, baseline = self._fake_plane()
+        streamer = repl.ReplicationStreamer(
+            "origin",
+            1,
+            successors_fn=lambda key: ["succ-a", "succ-b"],
+            deliver_fn=deliver,
+            baseline_fn=baseline,
+        )
+        try:
+            payload = datastore_test_lib.make_study(
+                study="s0"
+            ).SerializeToString()
+            state["seq"] = 1
+            state["records"] = [(wal_lib.CREATE_STUDY, payload)]
+            streamer.submit(1, wal_lib.CREATE_STUDY, payload)
+            assert streamer.flush(5)
+            for store in stores.values():
+                assert store.last_seq("origin") == 1
+            assert streamer.lag() == 0
+        finally:
+            streamer.close()
+
+    def test_dead_successor_resynced_on_return(self):
+        stores, alive, state, deliver, baseline = self._fake_plane()
+        alive["succ-b"] = False
+        streamer = repl.ReplicationStreamer(
+            "origin",
+            1,
+            successors_fn=lambda key: ["succ-a", "succ-b"],
+            deliver_fn=deliver,
+            baseline_fn=baseline,
+        )
+        try:
+            payload = datastore_test_lib.make_study(
+                study="s0"
+            ).SerializeToString()
+            state["seq"] = 1
+            state["records"] = [(wal_lib.CREATE_STUDY, payload)]
+            streamer.submit(1, wal_lib.CREATE_STUDY, payload)
+            assert streamer.flush(5)
+            assert stores["succ-b"].last_seq("origin") == 0
+            alive["succ-b"] = True
+            streamer.request_resync("succ-b")
+            assert streamer.flush(5)
+            assert stores["succ-b"].last_seq("origin") == 1
+        finally:
+            streamer.close()
+
+    def test_queue_overflow_drops_then_rebaselines(self):
+        stores, alive, state, deliver, baseline = self._fake_plane()
+        alive["succ-a"] = alive["succ-b"] = False  # deliveries stall
+        streamer = repl.ReplicationStreamer(
+            "origin",
+            1,
+            successors_fn=lambda key: ["succ-a", "succ-b"],
+            deliver_fn=deliver,
+            baseline_fn=baseline,
+            queue_size=4,
+            batch_max=2,
+        )
+        try:
+            payload = datastore_test_lib.make_study(
+                study="s0"
+            ).SerializeToString()
+            for seq in range(1, 64):
+                streamer.submit(seq, wal_lib.CREATE_STUDY, payload)
+            state["seq"] = 63
+            state["records"] = [(wal_lib.CREATE_STUDY, payload)]
+            streamer.flush(2)
+            assert streamer.dropped > 0  # never blocked the write path
+            alive["succ-a"] = alive["succ-b"] = True
+            streamer.submit(64, wal_lib.CREATE_STUDY, payload)
+            state["seq"] = 64
+            assert streamer.flush(5)
+            # Overflow cost a resync, not correctness: both successors
+            # hold the full-state baseline.
+            for store in stores.values():
+                assert store.last_seq("origin") == 64
+        finally:
+            streamer.close()
+
+    def test_fenced_streamer_stops(self):
+        stores, alive, state, deliver, baseline = self._fake_plane()
+        streamer = repl.ReplicationStreamer(
+            "origin",
+            1,
+            successors_fn=lambda key: ["succ-a"],
+            deliver_fn=deliver,
+            baseline_fn=baseline,
+        )
+        try:
+            payload = datastore_test_lib.make_study(
+                study="s0"
+            ).SerializeToString()
+            state["seq"] = 1
+            state["records"] = [(wal_lib.CREATE_STUDY, payload)]
+            streamer.submit(1, wal_lib.CREATE_STUDY, payload)
+            assert streamer.flush(5)
+            # A new generation fences the store; the old streamer's next
+            # delivery must stop it for good.
+            stores["succ-a"].fence("origin", 2)
+            streamer.submit(2, wal_lib.UPDATE_STUDY, payload)
+            deadline = time.monotonic() + 5
+            while not streamer.fenced and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert streamer.fenced
+        finally:
+            streamer.close()

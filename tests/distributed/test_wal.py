@@ -224,6 +224,140 @@ class TestDivergenceFailStop:
         ]
 
 
+class TestCorruptionQuarantine:
+    """Mid-log corruption must not poison appends made after a restart:
+    the invalid suffix moves to a ``.corrupt`` sidecar and the live log
+    truncates to its longest valid prefix."""
+
+    def _corrupt_midpoint(self, log_path) -> bytes:
+        data = bytearray(log_path.read_bytes())
+        midpoint = len(data) // 2
+        original = bytes(data)
+        data[midpoint : midpoint + 16] = b"\xff" * 16
+        log_path.write_bytes(bytes(data))
+        return original[midpoint:]
+
+    def test_mid_log_corruption_quarantined_on_reopen(self, tmp_path):
+        ds = wal.PersistentDataStore(str(tmp_path))
+        populate(ds, studies=3)
+        ds.close()
+        log = tmp_path / wal.LOG_FILE
+        self._corrupt_midpoint(log)
+        corrupted = log.read_bytes()
+        revived = wal.PersistentDataStore(str(tmp_path))
+        assert revived.recovered_torn_tail
+        assert revived.recovered_quarantined_bytes > 0
+        # Sidecar holds the EXACT invalid suffix; the live log is the
+        # valid prefix.
+        sidecar = tmp_path / (wal.LOG_FILE + wal.CORRUPT_SUFFIX)
+        assert sidecar.exists()
+        prefix = log.read_bytes()
+        assert prefix + sidecar.read_bytes() == corrupted
+        records, torn = wal.WriteAheadLog._read_records(str(log))
+        assert not torn and records  # the prefix reads clean
+        revived.close()
+
+    def test_appends_after_quarantine_survive_replay(self, tmp_path):
+        """The poison scenario the quarantine exists for: without it, a
+        record appended after mid-log damage is acknowledged and then
+        silently unreadable on the next replay."""
+        ds = wal.PersistentDataStore(str(tmp_path))
+        populate(ds, studies=2)
+        ds.close()
+        self._corrupt_midpoint(tmp_path / wal.LOG_FILE)
+        revived = wal.PersistentDataStore(str(tmp_path))
+        revived.create_study(datastore_test_lib.make_study(study="after"))
+        after = state_of(revived)
+        revived.close()
+        again = wal.PersistentDataStore(str(tmp_path))
+        assert state_of(again) == after
+        assert again.load_study("owners/o/studies/after").name
+
+    def test_clean_log_quarantines_nothing(self, tmp_path):
+        ds = wal.PersistentDataStore(str(tmp_path))
+        populate(ds, studies=1)
+        ds.close()
+        revived = wal.PersistentDataStore(str(tmp_path))
+        assert revived.recovered_quarantined_bytes == 0
+        assert not (tmp_path / (wal.LOG_FILE + wal.CORRUPT_SUFFIX)).exists()
+
+
+class TestSequenceNumbers:
+    def test_seq_counts_mutations(self, tmp_path):
+        ds = wal.PersistentDataStore(str(tmp_path))
+        assert ds.seq == 0
+        ds.create_study(datastore_test_lib.make_study(study="s0"))
+        ds.create_trial(datastore_test_lib.make_trial(study="s0"))
+        assert ds.seq == 2
+
+    def test_seq_survives_restart(self, tmp_path):
+        ds = wal.PersistentDataStore(str(tmp_path))
+        populate(ds, studies=2)
+        seq = ds.seq
+        ds.close()
+        revived = wal.PersistentDataStore(str(tmp_path))
+        assert revived.seq == seq
+
+    def test_seq_survives_compaction(self, tmp_path):
+        ds = wal.PersistentDataStore(str(tmp_path))
+        populate(ds, studies=2)
+        seq = ds.seq
+        ds.compact_now()
+        assert ds.seq == seq
+        ds.close()
+        # The snapshot's SNAPSHOT_META record carries the base.
+        revived = wal.PersistentDataStore(str(tmp_path))
+        assert revived.seq == seq
+        revived.create_study(datastore_test_lib.make_study(study="extra"))
+        assert revived.seq == seq + 1
+
+    def test_read_directory_with_seqs_places_records(self, tmp_path):
+        ds = wal.PersistentDataStore(str(tmp_path))
+        ds.create_study(datastore_test_lib.make_study(study="s0"))
+        ds.compact_now()
+        ds.create_study(datastore_test_lib.make_study(study="s1"))
+        seq = ds.seq
+        ds.close()
+        records, torn = wal.read_directory_with_seqs(str(tmp_path))
+        assert not torn
+        # Snapshot records carry the base seq; the live-log record sits
+        # one past it.
+        seqs = [s for s, _op, _pl in records]
+        assert max(seqs) == seq
+        assert seqs == sorted(seqs)
+        # read_directory strips meta + seqs but keeps the records.
+        plain, _ = wal.read_directory(str(tmp_path))
+        assert [(op, pl) for _s, op, pl in records] == plain
+
+    def test_export_with_seq_is_atomic_pair(self, tmp_path):
+        ds = wal.PersistentDataStore(str(tmp_path))
+        populate(ds, studies=1)
+        seq, records = ds.export_with_seq()
+        assert seq == ds.seq
+        assert records == wal.export_records(ds._inner)
+
+    def test_on_append_hook_sees_ordered_seqs(self, tmp_path):
+        seen = []
+
+        class Sink:
+            def submit(self, seq, opcode, payload):
+                seen.append(seq)
+
+        ds = wal.PersistentDataStore(str(tmp_path), on_append=Sink())
+        populate(ds, studies=1)
+        assert seen == list(range(1, len(seen) + 1))
+
+    def test_on_append_failure_never_fails_the_mutation(self, tmp_path):
+        class BoomSink:
+            def submit(self, seq, opcode, payload):
+                raise RuntimeError("streamer exploded")
+
+        ds = wal.PersistentDataStore(str(tmp_path), on_append=BoomSink())
+        ds.create_study(datastore_test_lib.make_study(study="s0"))
+        assert ds.load_study("owners/o/studies/s0").name
+        assert ds.seq == 1
+
+
 class TestRecordFraming:
     def test_unknown_opcode_rejected_at_append(self, tmp_path):
         log = wal.WriteAheadLog(str(tmp_path))

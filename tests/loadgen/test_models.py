@@ -143,6 +143,48 @@ class TestEvents:
         with pytest.raises(ValueError, match="Unknown event kind"):
             models.EventSpec(1, "explode")
 
+    def test_three_replica_tier_gets_the_severity_track(self):
+        scenario = models.build_scenario(
+            models.smoke_config(target="replicas", replicas=3)
+        )
+        kinds = [e.kind for e in scenario.events]
+        assert "multi_kill" in kinds
+        assert "wal_corrupt" in kinds
+        assert "rolling_restart" in kinds
+        assert "kill_replica" not in kinds  # severity replaces the pair
+        multi = next(e for e in scenario.events if e.kind == "multi_kill")
+        assert multi.arg == "2"
+
+    def test_soak_config_is_three_replica_severity(self):
+        config = models.soak_config()
+        assert config.replicas == 3
+        kinds = [e.kind for e in models.build_scenario(config).events]
+        for kind in ("multi_kill", "wal_corrupt", "rolling_restart"):
+            assert kind in kinds
+
+    def test_severity_events_parse_and_fingerprint(self):
+        config = models.smoke_config(replicas=3)
+        events = models.parse_event_track(
+            "multi_kill:2@0.35,wal_corrupt:owner:0@0.45,"
+            "rolling_restart@0.75",
+            config,
+        )
+        assert [e.kind for e in events] == [
+            "multi_kill",
+            "wal_corrupt",
+            "rolling_restart",
+        ]
+        # Scripted severity events are part of the scenario identity.
+        base = models.build_scenario(config)
+        scripted = models.build_scenario(
+            dataclasses.replace(config, events=events)
+        )
+        assert scripted.fingerprint() != base.fingerprint()
+        again = models.build_scenario(
+            dataclasses.replace(config, events=events)
+        )
+        assert scripted.fingerprint() == again.fingerprint()
+
 
 class TestEnvConfig:
     def test_from_env_reads_loadgen_switches(self):

@@ -158,3 +158,99 @@ class TestAssertions:
         text = report_lib.render_verdict(report)
         assert "soak: FAIL" in text  # reference arms missing
         assert "zero_lost_studies" in text
+
+
+def _report_dict(
+    *,
+    p99_by_kind=None,
+    assertions=None,
+    hits=5,
+    gp_hit_rate=0.5,
+    fallbacks_by_kind=None,
+    fingerprint="fp",
+):
+    p99_by_kind = p99_by_kind or {"random": 10.0}
+    fallbacks_by_kind = fallbacks_by_kind or {}
+    by_kind = {}
+    for kind, p99 in p99_by_kind.items():
+        by_kind[kind] = {
+            "suggests": 100,
+            "errors": 0,
+            "fallbacks": fallbacks_by_kind.get(kind, 0),
+            "speculative_hits": 0,
+            "fallback_rate": fallbacks_by_kind.get(kind, 0) / 100,
+            "hit_rate": 0.0,
+            "latency": {"p50_ms": p99 / 2, "p99_ms": p99},
+        }
+    return {
+        "scenario": {"fingerprint": fingerprint},
+        "ok": all(ok for _n, ok in (assertions or {"a": True}).items()),
+        "assertions": [
+            {"name": name, "ok": ok, "detail": ""}
+            for name, ok in (assertions or {"a": True}).items()
+        ],
+        "outcomes": {"by_kind": by_kind},
+        "speculative": {"armed": True, "hits": hits, "gp_hit_rate": gp_hit_rate},
+    }
+
+
+class TestDiffReports:
+    def test_identical_reports_are_clean(self):
+        a = _report_dict()
+        diff = report_lib.diff_reports(a, _report_dict())
+        assert diff["ok"] and diff["regressions"] == []
+        assert diff["same_scenario"]
+
+    def test_assertion_flip_is_a_regression(self):
+        a = _report_dict(assertions={"zero_lost_studies": True})
+        b = _report_dict(assertions={"zero_lost_studies": False})
+        diff = report_lib.diff_reports(a, b)
+        assert not diff["ok"]
+        assert any("zero_lost_studies" in r for r in diff["regressions"])
+        assert diff["assertion_changes"]["zero_lost_studies"] == {
+            "before": True,
+            "after": False,
+        }
+
+    def test_assertion_fixed_is_not_a_regression(self):
+        a = _report_dict(assertions={"x": False})
+        b = _report_dict(assertions={"x": True})
+        diff = report_lib.diff_reports(a, b)
+        assert diff["ok"]
+        assert diff["assertion_changes"]["x"]["after"] is True
+
+    def test_hit_rate_drop_is_a_regression(self):
+        a = _report_dict(gp_hit_rate=0.8)
+        b = _report_dict(gp_hit_rate=0.3)
+        diff = report_lib.diff_reports(a, b)
+        assert not diff["ok"]
+        assert any("hit rate" in r for r in diff["regressions"])
+
+    def test_fallback_rise_is_a_regression(self):
+        a = _report_dict()
+        b = _report_dict(fallbacks_by_kind={"random": 20})
+        diff = report_lib.diff_reports(a, b)
+        assert not diff["ok"]
+        assert any("fallback" in r for r in diff["regressions"])
+
+    def test_kind_vanishing_is_a_regression(self):
+        a = _report_dict(p99_by_kind={"random": 10.0, "gp_bandit": 50.0})
+        b = _report_dict(p99_by_kind={"random": 10.0})
+        diff = report_lib.diff_reports(a, b)
+        assert not diff["ok"]
+
+    def test_latency_deltas_reported_but_advisory(self):
+        a = _report_dict(p99_by_kind={"random": 10.0})
+        b = _report_dict(p99_by_kind={"random": 40.0})
+        diff = report_lib.diff_reports(a, b)
+        assert diff["ok"]  # wall clock alone never fails the gate
+        assert diff["per_kind"]["random"]["p99_ms"]["ratio"] == 4.0
+        # ...unless an explicit ratio budget is given.
+        strict = report_lib.diff_reports(a, b, latency_ratio=2.0)
+        assert not strict["ok"]
+
+    def test_render_diff_shape(self):
+        a = _report_dict(assertions={"x": True})
+        b = _report_dict(assertions={"x": False})
+        text = report_lib.render_diff(report_lib.diff_reports(a, b))
+        assert "REGRESSED" in text and "verdict x" in text

@@ -1,0 +1,75 @@
+"""Severity-track soak: multi_kill + wal_corrupt + rolling_restart on a
+3-replica tier, driven end to end with zero lost studies."""
+
+from vizier_tpu.loadgen import driver as driver_lib
+from vizier_tpu.loadgen import models
+
+
+def _severity_config(**overrides):
+    values = dict(
+        name="severity",
+        replicas=3,
+        num_studies=12,
+        max_trials=4,
+        kind_mix=(("random", 4.0), ("quasi_random", 2.0)),
+        planes=models.PlaneConfig(
+            batching=False, speculative=False, mesh=False, slo=False
+        ),
+    )
+    values.update(overrides)
+    return models.smoke_config(**values)
+
+
+class TestSeveritySoak:
+    def test_zero_lost_through_the_full_severity_track(self):
+        scenario = models.build_scenario(_severity_config())
+        kinds = [e.kind for e in scenario.events]
+        assert kinds == ["multi_kill", "wal_corrupt", "rolling_restart"]
+
+        result = driver_lib.run(scenario, arm="engine")
+
+        fired = {e["kind"]: e for e in result.events_fired}
+        assert set(fired) == set(kinds)
+        for event in result.events_fired:
+            assert "error" not in event, event
+            assert "skipped" not in event, event
+        # multi_kill really killed two replicas simultaneously and one
+        # sweep restored them.
+        assert len(fired["multi_kill"]["replicas"]) == 2
+        assert fired["multi_kill"]["restored"] >= 1
+        # wal_corrupt flipped real bytes mid-file.
+        assert fired["wal_corrupt"]["corruption"]["log_bytes"] > 64
+        # rolling_restart revived the multi_kill victims first, then
+        # cycled the rest.
+        restarted = fired["rolling_restart"]
+        assert sorted(
+            restarted["revived_first"] + restarted["restarted"]
+        ) == sorted(f"replica-{i}" for i in range(3))
+
+        assert result.lost_studies() == []
+        assert result.errored_studies() == []
+        stats = result.serving_stats
+        # Every replica died at least once across the track.
+        assert stats["failovers"] >= 3
+        # The corrupted replica's restart recovered through standby logs.
+        assert stats["recovery_sources"].get("standby", 0) >= 1
+        assert stats["replication"]["factor"] >= 1
+
+    def test_gated_replication_off_still_survives_single_kill(self, monkeypatch):
+        """VIZIER_DISTRIBUTED_REPLICATION=0 = the pre-replication tier:
+        the classic kill/revive track (external drain gate for the
+        handback) still runs clean."""
+        monkeypatch.setenv("VIZIER_DISTRIBUTED_REPLICATION", "0")
+        config = _severity_config(
+            replicas=2,
+            num_studies=8,
+        )
+        scenario = models.build_scenario(config)
+        kinds = [e.kind for e in scenario.events]
+        assert "kill_replica" in kinds and "revive_replica" in kinds
+        result = driver_lib.run(scenario, arm="engine")
+        for event in result.events_fired:
+            assert "error" not in event, event
+        assert result.lost_studies() == []
+        assert result.errored_studies() == []
+        assert "replication" not in result.serving_stats

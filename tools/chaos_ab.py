@@ -17,8 +17,16 @@ rate (≈ the injected designer-fault rate); the OFF arm dies at the first
 injected fault that reaches the client.
 
 Usage:  python tools/chaos_ab.py [--trials 50] [--seed 11] [--fault-prob 0.1]
-        [--distributed N] [--kill-at K] [--instrument-locks]
-        [--mesh-devices N]
+        [--distributed N] [--kill-at K] [--no-shared-fs]
+        [--instrument-locks] [--mesh-devices N]
+
+``--no-shared-fs`` (with ``--distributed``) adds the **replicated_failover**
+arm: same kill-the-owner schedule, but the dead replica's WAL directory is
+``rm -rf``'d at the moment of the kill — the run can only complete via the
+rendezvous successors' replication standby logs
+(``distributed/replication.py``), proving failover needs no shared
+filesystem. The verdict asserts all trials completed AND >= 1 study was
+recovered from source ``standby``.
 
 ``--mesh-devices N`` adds a mesh-executor chaos arm: chaos-wrapped GP
 designers across multiple shape buckets drive a mesh-sharded
@@ -196,8 +204,17 @@ def run_distributed_arm(
     reliability: ReliabilityConfig,
     num_replicas: int,
     kill_at: int,
+    delete_wal_dir: bool = False,
 ) -> dict:
-    """Kill-one-replica failover under the same seeded fault schedule."""
+    """Kill-one-replica failover under the same seeded fault schedule.
+
+    With ``delete_wal_dir`` the dead replica's entire WAL directory is
+    ``rm -rf``'d at the moment of the kill — the shared-nothing proof:
+    the run must still complete every trial, with recovery sourced from
+    the rendezvous successors' replication standby logs instead of the
+    corpse's (now nonexistent) disk.
+    """
+    import shutil
     import tempfile
 
     from vizier_tpu.distributed import ReplicaManager
@@ -238,6 +255,15 @@ def run_distributed_arm(
     try:
         for i in range(trials):
             if i == kill_at:
+                if delete_wal_dir:
+                    # Drain the streamer, then vaporize the owner's disk
+                    # BEFORE the kill: nothing local remains to fail over
+                    # from — the standby logs must carry the recovery.
+                    manager.flush_replication(owner_before)
+                    shutil.rmtree(
+                        os.path.join(wal_root, owner_before),
+                        ignore_errors=True,
+                    )
                 manager.kill_replica(owner_before)
                 killed = True
             t0 = time.perf_counter()
@@ -267,11 +293,14 @@ def run_distributed_arm(
         "error": error,
         "replicas": num_replicas,
         "wal_root": wal_root,
+        "dead_wal_dir_deleted": bool(delete_wal_dir and killed),
         "killed_replica": owner_before if killed else None,
         "killed_at_trial": kill_at if killed else None,
         "owner_after_failover": owner_after,
         "failovers": stats["failovers"],
         "restored_studies": stats["restored_studies"],
+        "recovery_sources": stats.get("recovery_sources", {}),
+        "replication": stats.get("replication", {}),
         "router": stats["router"],
         "fallback_trials": fallback_trials,
         "fallback_rate": fallback_trials / max(1, completed),
@@ -740,6 +769,14 @@ def main() -> None:
         help="trial index at which the owning replica dies (-1 = halfway)",
     )
     parser.add_argument(
+        "--no-shared-fs",
+        action="store_true",
+        help="with --distributed: add the replicated_failover arm — the "
+        "dead replica's WAL directory is DELETED at the kill, so the "
+        "run can only complete via the successors' replication standby "
+        "logs (the shared-nothing durability proof)",
+    )
+    parser.add_argument(
         "--mesh-devices",
         type=int,
         default=0,
@@ -849,6 +886,21 @@ def main() -> None:
                 num_replicas=args.distributed,
                 kill_at=kill_at,
             )
+            if args.no_shared_fs:
+                print(
+                    "[chaos_ab] running arm: replicated_failover "
+                    f"({args.distributed} replicas, dead WAL dir DELETED "
+                    f"at trial {kill_at})"
+                )
+                report["arms"]["replicated_failover"] = run_distributed_arm(
+                    trials=args.trials,
+                    seed=args.seed,
+                    fault_prob=args.fault_prob,
+                    reliability=arms["reliability_on"],
+                    num_replicas=args.distributed,
+                    kill_at=kill_at,
+                    delete_wal_dir=True,
+                )
         if args.mesh_devices:
             print(
                 f"[chaos_ab] running arm: mesh_executor "
@@ -898,6 +950,26 @@ def main() -> None:
             }
         )
         ok = ok and dist["completed_trials"] == args.trials and dist["failovers"] >= 1
+        if args.no_shared_fs:
+            repl = report["arms"]["replicated_failover"]
+            standby_recoveries = int(
+                repl["recovery_sources"].get("standby", 0)
+            )
+            report["verdict"].update(
+                {
+                    "replicated_completed_all": repl["completed_trials"]
+                    == args.trials,
+                    "replicated_wal_dir_deleted": repl[
+                        "dead_wal_dir_deleted"
+                    ],
+                    "replicated_standby_recoveries": standby_recoveries,
+                }
+            )
+            ok = ok and (
+                repl["completed_trials"] == args.trials
+                and repl["dead_wal_dir_deleted"]
+                and standby_recoveries >= 1
+            )
     if args.mesh_devices:
         mesh_arm = report["arms"]["mesh_executor"]
         report["verdict"].update(

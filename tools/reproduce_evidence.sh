@@ -29,12 +29,16 @@ JAX_PLATFORMS=cpu python tools/service_throughput.py --replicas 4 --out /tmp/st.
 
 echo "== 3b. failover chaos: kill one replica mid-study (~1 min) =="
 #    -> CHAOS_AB.json gains the distributed_failover arm (50/50 trials
-#    complete via router failover + WAL handoff), the mesh_executor arm
-#    (device-program failure isolated to ONE placement of an 8-device
-#    mesh), and the runtime lock-order cross-check — now including the
-#    per-placement mesh dispatch workers — vs the static graph
+#    complete via router failover + WAL handoff), the replicated_failover
+#    arm (--no-shared-fs: the dead replica's WAL directory is DELETED at
+#    the kill; 50/50 still completes via the successors' replication
+#    standby logs), the mesh_executor arm (device-program failure
+#    isolated to ONE placement of an 8-device mesh), and the runtime
+#    lock-order cross-check — now including the per-placement mesh
+#    dispatch workers AND the replication streamer threads — vs the
+#    static graph
 JAX_PLATFORMS=cpu python tools/chaos_ab.py --distributed 4 --mesh-devices 8 \
-  --instrument-locks
+  --no-shared-fs --instrument-locks
 
 echo "== 3b3. SLO-armed observability soak (~2 min) =="
 #    -> OBSERVABILITY_E2E.json (v2): 2-replica tier with SLOs armed +

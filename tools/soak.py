@@ -24,6 +24,13 @@ Usage:
     python tools/soak.py                     # acceptance-scale soak
     python tools/soak.py --smoke             # seconds-scale CI shape
     python tools/soak.py --studies 200 --replicas 4 --mesh-devices 4
+    python tools/soak.py --diff A.json B.json   # compare two reports
+
+``--diff`` compares two SOAK_REPORTs (the defaults-ON before/after
+campaign gate): per-kind latency deltas, assertion verdict changes,
+speculative hit-rate / fallback-rate deltas — exits nonzero on any
+regression (an assertion flipping pass→fail, a hit-rate drop, a
+fallback rise).
 
 Scenario seed/scale/studies/target/events can also come from the
 ``VIZIER_LOADGEN*`` environment switches (docs/guides/loadtest.md).
@@ -124,12 +131,39 @@ def main() -> None:
         "— for iterating on scenarios, not for evidence)",
     )
     parser.add_argument(
+        "--diff",
+        nargs=2,
+        metavar=("A.json", "B.json"),
+        default=None,
+        help="compare two SOAK_REPORTs (A = before, B = after) instead "
+        "of running a soak; exits nonzero on regression",
+    )
+    parser.add_argument(
+        "--diff-out",
+        default="",
+        help="optional path for the --diff JSON result",
+    )
+    parser.add_argument(
         "--out",
         default=str(
             pathlib.Path(__file__).resolve().parent.parent / "SOAK_REPORT.json"
         ),
     )
     args = parser.parse_args()
+
+    if args.diff:
+        before = json.loads(pathlib.Path(args.diff[0]).read_text())
+        after = json.loads(pathlib.Path(args.diff[1]).read_text())
+        diff = report_lib.diff_reports(before, after)
+        print(report_lib.render_diff(diff))
+        if args.diff_out:
+            pathlib.Path(args.diff_out).write_text(
+                json.dumps(diff, indent=2) + "\n"
+            )
+            print(f"[soak] wrote {args.diff_out}")
+        if not diff["ok"]:
+            sys.exit(1)
+        return
 
     # Fast client polling: the soak measures fleet behavior, not the
     # client's long-poll sleep cadence.

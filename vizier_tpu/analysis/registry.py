@@ -155,6 +155,23 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             _RUN_DOC, "fsync the WAL per append (power-loss durability).", "0"),
     _switch("VIZIER_DISTRIBUTED_ROUTE_CACHE_SIZE", "int", "StudyRouter",
             _RUN_DOC, "LRU cap on the router's placement cache.", "65536"),
+    _switch("VIZIER_DISTRIBUTED_REPLICATION", "flag", "DistributedConfig",
+            _RUN_DOC,
+            "Stream WAL appends to each study's rendezvous successors' "
+            "standby logs so failover needs no shared filesystem "
+            "(0 = local-disk-only failover, the pre-replication path).",
+            "1"),
+    _switch("VIZIER_DISTRIBUTED_REPLICATION_FACTOR", "int",
+            "DistributedConfig", _RUN_DOC,
+            "Standby copies per study (K rendezvous successors).", "2"),
+    _switch("VIZIER_DISTRIBUTED_REPLICATION_QUEUE", "int",
+            "DistributedConfig", _RUN_DOC,
+            "Per-origin replication streamer queue bound; overflow drops "
+            "and re-baselines rather than blocking the write path.",
+            "4096"),
+    _switch("VIZIER_DISTRIBUTED_REPLICATION_BATCH", "int",
+            "DistributedConfig", _RUN_DOC,
+            "Records per streamed replication batch.", "64"),
     # -- speculative pre-compute (SpeculativeConfig) -----------------------
     _switch("VIZIER_SPECULATIVE", "flag", "SpeculativeConfig", _SRV_DOC,
             "Background pre-compute of the next suggestion batch after "

@@ -6,7 +6,9 @@ names (``routing.StudyRouter``), clients reach the owning replica through a
 drop-in stub wrapper (``router_stub.RoutedVizierStub`` — ``VizierClient``
 code is unchanged), each replica's RAM datastore persists through a
 snapshot + write-ahead log (``wal.PersistentDataStore``) so replicas
-restart warm, and ``replica_manager.ReplicaManager`` health-checks the
+restart warm, WAL appends stream to each study's rendezvous successors'
+standby logs (``replication.py``) so failover needs **no shared
+filesystem**, and ``replica_manager.ReplicaManager`` health-checks the
 fleet and fails a dead replica's studies over to their rendezvous
 successors — the reliability layer's retries absorb the transition.
 
@@ -29,6 +31,10 @@ rendezvous hash.
 
 from vizier_tpu.distributed.config import DistributedConfig
 from vizier_tpu.distributed.replica_manager import ReplicaManager
+from vizier_tpu.distributed.replication import (
+    ReplicationStreamer,
+    StandbyStore,
+)
 from vizier_tpu.distributed.router_stub import RoutedVizierStub
 from vizier_tpu.distributed.routing import StudyRouter
 from vizier_tpu.distributed.sharded_datastore import ShardedDataStore
@@ -38,8 +44,10 @@ __all__ = [
     "DistributedConfig",
     "PersistentDataStore",
     "ReplicaManager",
+    "ReplicationStreamer",
     "RoutedVizierStub",
     "ShardedDataStore",
+    "StandbyStore",
     "StudyRouter",
     "WriteAheadLog",
 ]

@@ -15,7 +15,16 @@ in the central registry (``vizier_tpu.analysis.registry``) and documented in
   snapshot compactions (smaller = shorter replay, more snapshot I/O);
 - ``VIZIER_DISTRIBUTED_WAL_FSYNC=1``       — fsync the WAL per append:
   mutations survive OS crashes/power loss, not just process crashes, at
-  the cost of a disk sync on every write (off by default).
+  the cost of a disk sync on every write (off by default);
+- ``VIZIER_DISTRIBUTED_REPLICATION=0``     — WAL replication off-switch:
+  appends stream to each study's rendezvous successors' standby logs so
+  failover needs no shared filesystem (on by default when a WAL root is
+  configured; off = the PR 12 local-disk-only failover, bit-identical);
+- ``VIZIER_DISTRIBUTED_REPLICATION_FACTOR`` — standby copies per study (K
+  rendezvous successors receive its records);
+- ``VIZIER_DISTRIBUTED_REPLICATION_QUEUE``  — per-origin streamer queue
+  bound (overflow drops + re-baselines, never blocks the write path);
+- ``VIZIER_DISTRIBUTED_REPLICATION_BATCH``  — records per streamed batch.
 """
 
 from __future__ import annotations
@@ -29,6 +38,9 @@ from vizier_tpu.analysis import registry as _registry
 
 DEFAULT_REPLICAS = 4
 DEFAULT_SNAPSHOT_INTERVAL = 256
+DEFAULT_REPLICATION_FACTOR = 2
+DEFAULT_REPLICATION_QUEUE = 4096
+DEFAULT_REPLICATION_BATCH = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +67,16 @@ class DistributedConfig:
     # spawns is redundant overhead inside a managed tier; subprocess
     # replicas (no manager watching them) keep it on.
     replica_deadlines: bool = False
+    # Shared-nothing durability: stream every WAL append to the study's
+    # K rendezvous successors' standby logs, so failover needs no shared
+    # filesystem. Active only when a WAL root is configured (the stream
+    # IS the WAL's append feed); off = PR 12 local-disk-only failover.
+    replication: bool = True
+    replication_factor: int = DEFAULT_REPLICATION_FACTOR
+    # Streamer bounds: a full queue drops + re-baselines (the write path
+    # never blocks on replication); batches cap per-delivery work.
+    replication_queue: int = DEFAULT_REPLICATION_QUEUE
+    replication_batch: int = DEFAULT_REPLICATION_BATCH
 
     @classmethod
     def from_env(cls) -> "DistributedConfig":
@@ -76,6 +98,28 @@ class DistributedConfig:
                 ),
             ),
             wal_fsync=_registry.env_on("VIZIER_DISTRIBUTED_WAL_FSYNC"),
+            replication=_registry.env_on("VIZIER_DISTRIBUTED_REPLICATION"),
+            replication_factor=max(
+                1,
+                _registry.env_int(
+                    "VIZIER_DISTRIBUTED_REPLICATION_FACTOR",
+                    DEFAULT_REPLICATION_FACTOR,
+                ),
+            ),
+            replication_queue=max(
+                1,
+                _registry.env_int(
+                    "VIZIER_DISTRIBUTED_REPLICATION_QUEUE",
+                    DEFAULT_REPLICATION_QUEUE,
+                ),
+            ),
+            replication_batch=max(
+                1,
+                _registry.env_int(
+                    "VIZIER_DISTRIBUTED_REPLICATION_BATCH",
+                    DEFAULT_REPLICATION_BATCH,
+                ),
+            ),
         )
 
     def as_dict(self) -> dict:

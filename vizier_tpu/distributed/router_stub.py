@@ -234,6 +234,13 @@ class RoutedVizierStub:
         return call
 
     def _list_studies(self, request):
+        if self._barrier is not None:
+            # The fan-out honors the topology-transition barrier like any
+            # routed RPC: listing mid-replay would observe a half-restored
+            # successor (or raise on a corpse the sweep is about to
+            # account for) when waiting out the transition returns a
+            # complete listing.
+            self._barrier()
         live = self.router.live_replicas()
         with self._lock:
             failed_over = set(self._failed_over)
@@ -278,6 +285,8 @@ class RoutedVizierStub:
     def stats(self) -> Dict[str, Any]:
         """Router + per-replica request/failure counters (JSON-ready)."""
         per_replica: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            failed_over = set(self._failed_over)
         for rid in self.router.replica_ids:
             requests = sum(
                 self._requests.value(replica=rid, method=m)
@@ -287,5 +296,6 @@ class RoutedVizierStub:
                 "requests": requests,
                 "failures": self._failures.value(replica=rid),
                 "state": self.router.snapshot()[rid],
+                "failed_over": rid in failed_over,
             }
         return {"replicas": per_replica}

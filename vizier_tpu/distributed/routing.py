@@ -92,6 +92,20 @@ class StudyRouter:
             reverse=True,
         )
 
+    def successors(
+        self, study_key: str, origin: str, count: int
+    ) -> List[str]:
+        """The study's next-``count`` rendezvous choices after ``origin``.
+
+        Liveness-BLIND on purpose: replication successor sets must stay
+        stable while replicas bounce (a dead successor just misses
+        deliveries until it returns and is re-baselined), and the first
+        entry is exactly the replica :meth:`replica_for` falls to when
+        ``origin`` dies — the standby log lives where the failover lands.
+        """
+        ranked = [rid for rid in self.ranking(study_key) if rid != origin]
+        return ranked[: max(0, count)]
+
     def replica_for(self, study_key: str) -> str:
         """The live replica that owns ``study_key``.
 

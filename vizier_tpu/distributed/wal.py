@@ -26,6 +26,15 @@ Crash windows:
 
 - mid-append: the torn tail record fails its length/CRC check and is
   dropped on replay (the mutation was never acknowledged);
+- corrupt record mid-log (bit rot, injected corruption): replay recovers
+  the longest valid prefix, and reopening the log **quarantines** the
+  invalid suffix into a ``wal.log.corrupt`` sidecar before appending —
+  without the quarantine, records appended after the damage would be
+  acknowledged and then silently lost on the next replay (the reader
+  stops at the first bad record). The lost suffix is recoverable from a
+  replication standby log (``distributed/replication.py``) when one is
+  longer — failover compares sources by mutation *sequence number*,
+  which snapshots record in a leading ``SNAPSHOT_META`` record;
 - mid-snapshot-write: the tmp file is ignored; old snapshot + full log
   still replay;
 - after the snapshot rename but before the log truncate: replay applies
@@ -46,7 +55,10 @@ import os
 import struct
 import threading
 import zlib
-from typing import Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple, TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (typing only)
+    from vizier_tpu.distributed.replication import AppendSink
 
 from vizier_tpu.service import datastore as datastore_lib
 from vizier_tpu.service import ram_datastore
@@ -66,13 +78,23 @@ UPDATE_SUGGESTION_OP = 8
 CREATE_EARLY_STOPPING_OP = 9
 UPDATE_EARLY_STOPPING_OP = 10
 UPDATE_METADATA = 11
+# A snapshot's first record: its payload is the origin's mutation sequence
+# number at compaction time (u64). Pure bookkeeping — replay skips it; it
+# is what lets a failover compare a local WAL against a replication
+# standby log by *sequence number* rather than by incomparable record
+# counts (a snapshot compacts history, so its record count is not its
+# mutation count).
+SNAPSHOT_META = 12
 
-_OPCODES = frozenset(range(CREATE_STUDY, UPDATE_METADATA + 1))
+_OPCODES = frozenset(range(CREATE_STUDY, SNAPSHOT_META + 1))
+DATA_OPCODES = frozenset(range(CREATE_STUDY, UPDATE_METADATA + 1))
 
 _HEADER = struct.Struct("<II")  # payload length, crc32(opcode byte + payload)
+_SEQ = struct.Struct("<Q")
 
 SNAPSHOT_FILE = "snapshot.bin"
 LOG_FILE = "wal.log"
+CORRUPT_SUFFIX = ".corrupt"
 
 
 def study_key_of(opcode: int, payload: bytes) -> str:
@@ -102,6 +124,21 @@ def study_key_of(opcode: int, payload: bytes) -> str:
     raise ValueError(f"Unknown WAL opcode: {opcode}")
 
 
+def split_meta(records: List[Tuple[int, bytes]]) -> Tuple[int, List[Tuple[int, bytes]]]:
+    """``(base_seq, data_records)`` of a snapshot record sequence.
+
+    A snapshot written by this version starts with a :data:`SNAPSHOT_META`
+    record carrying the mutation sequence the compaction folded up to.
+    Older snapshots have no meta record; their record count stands in as
+    the base (each compacted record was at least one mutation) — an
+    approximation that only matters for standby-vs-local comparisons, and
+    pre-replication directories have no standby logs to compare against.
+    """
+    if records and records[0][0] == SNAPSHOT_META:
+        return int(_SEQ.unpack(records[0][1])[0]), records[1:]
+    return len(records), list(records)
+
+
 class WriteAheadLog:
     """Append-only mutation log with atomic snapshot compaction."""
 
@@ -112,6 +149,16 @@ class WriteAheadLog:
         self._fsync = fsync
         self._log_path = os.path.join(directory, LOG_FILE)
         self._snapshot_path = os.path.join(directory, SNAPSHOT_FILE)
+        # Quarantine BEFORE opening for append: a log with a corrupt or
+        # torn record mid-file must not be appended past it — replay stops
+        # at the first bad record, so anything written after the damage
+        # would be acknowledged and then silently lost on the next replay.
+        # The invalid suffix moves to a ``wal.log.corrupt`` sidecar (kept
+        # for forensics) and the live log truncates to its longest valid
+        # prefix.
+        self.quarantined_bytes = self._quarantine_invalid_suffix(
+            self._log_path
+        )
         self._log = open(self._log_path, "ab")
         self._appended = 0
 
@@ -125,10 +172,54 @@ class WriteAheadLog:
         return _HEADER.pack(len(body), zlib.crc32(body)) + body
 
     @staticmethod
+    def _valid_prefix_end(data: bytes) -> int:
+        """Byte offset where the valid record prefix of ``data`` ends."""
+        offset = 0
+        while offset < len(data):
+            if offset + _HEADER.size > len(data):
+                return offset
+            length, crc = _HEADER.unpack_from(data, offset)
+            start = offset + _HEADER.size
+            end = start + length
+            if length < 1 or end > len(data):
+                return offset
+            if zlib.crc32(data[start:end]) != crc:
+                return offset
+            offset = end
+        return offset
+
+    @classmethod
+    def _quarantine_invalid_suffix(cls, path: str) -> int:
+        """Moves everything past the longest valid record prefix of
+        ``path`` into ``path + '.corrupt'``; returns the bytes moved."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return 0
+        end = cls._valid_prefix_end(data)
+        if end >= len(data):
+            return 0
+        suffix = data[end:]
+        with open(path + CORRUPT_SUFFIX, "ab") as sidecar:
+            sidecar.write(suffix)
+            sidecar.flush()
+            os.fsync(sidecar.fileno())
+        with open(path, "r+b") as f:
+            f.truncate(end)
+            f.flush()
+            os.fsync(f.fileno())
+        return len(suffix)
+
+    @staticmethod
     def _read_records(path: str) -> Tuple[List[Tuple[int, bytes]], bool]:
         """Records of one file; second element is True when a torn/corrupt
-        tail was dropped. Reading stops at the first bad record — with one
-        appender flushing sequentially, damage can only be a tail."""
+        suffix was dropped. Reading stops at the first bad record: with one
+        appender flushing sequentially damage is normally a tail, and a
+        mid-log corruption (bit rot, an injected ``wal_corrupt`` chaos
+        event) makes everything after it unreadable — the longest valid
+        prefix is what this returns, and :meth:`_quarantine_invalid_suffix`
+        is what keeps a reopened log from appending past the damage."""
         records: List[Tuple[int, bytes]] = []
         try:
             with open(path, "rb") as f:
@@ -174,24 +265,46 @@ class WriteAheadLog:
         (a crash mid-append, or — without per-append fsync — an OS crash
         that lost flushed-but-unsynced tail records).
         """
+        records, torn, _seq = self.load_with_seq()
+        return records, torn
+
+    def load_with_seq(self) -> Tuple[List[Tuple[int, bytes]], bool, int]:
+        """Like :meth:`load`, plus the mutation sequence number the loaded
+        state corresponds to (snapshot meta base + live log records)."""
         snapshot_records, snapshot_torn = self._read_records(self._snapshot_path)
         if snapshot_torn:
             # A torn snapshot can only be a crashed *tmp* promoted by an
             # outside force; never trust it over replaying nothing.
             snapshot_records = []
+        base_seq, snapshot_records = split_meta(snapshot_records)
         log_records, log_torn = self._read_records(self._log_path)
-        return snapshot_records + log_records, log_torn or snapshot_torn
+        log_records = [r for r in log_records if r[0] != SNAPSHOT_META]
+        return (
+            snapshot_records + log_records,
+            log_torn or snapshot_torn,
+            base_seq + len(log_records),
+        )
 
-    def compact(self, records: Iterable[Tuple[int, bytes]]) -> None:
+    def compact(
+        self,
+        records: Iterable[Tuple[int, bytes]],
+        *,
+        seq: Optional[int] = None,
+    ) -> None:
         """Atomically replaces the snapshot with ``records``, truncates the log.
 
-        The caller must hold whatever lock serializes its mutations (the
-        compaction must see a quiescent state and no append may interleave
-        with the truncate).
+        ``seq`` (the store's mutation sequence at compaction time) is
+        recorded as the snapshot's leading :data:`SNAPSHOT_META` record so
+        a later reader can place the snapshot on the origin's sequence
+        axis. The caller must hold whatever lock serializes its mutations
+        (the compaction must see a quiescent state and no append may
+        interleave with the truncate).
         """
         tmp_path = self._snapshot_path + ".tmp"
         with self._lock:
             with open(tmp_path, "wb") as f:
+                if seq is not None:
+                    f.write(self._frame(SNAPSHOT_META, _SEQ.pack(int(seq))))
                 for opcode, payload in records:
                     f.write(self._frame(opcode, payload))
                 f.flush()
@@ -227,6 +340,7 @@ class PersistentDataStore(datastore_lib.DataStore):
         snapshot_interval: Optional[int] = None,
         fsync: Optional[bool] = None,
         inner: Optional[ram_datastore.NestedDictRAMDataStore] = None,
+        on_append: Optional["AppendSink"] = None,
     ):
         from vizier_tpu.distributed import config as config_lib
 
@@ -244,7 +358,22 @@ class PersistentDataStore(datastore_lib.DataStore):
         # the inner store's lock and the WAL file lock only.
         self._lock = threading.Lock()
         self._diverged: Optional[str] = None
-        records, self.recovered_torn_tail = self._wal.load()
+        # Post-append observer (the WAL replication streamer): its
+        # ``submit(seq, opcode, payload)`` runs AFTER the record is
+        # durably appended, still under ``self._lock`` so the observed
+        # order equals the log order. Must be non-blocking and never
+        # raise usefully — failures are swallowed (replication is
+        # redundancy, not the write path). Annotated with the concrete
+        # sink type so the lock-order pass sees the acquisition chain.
+        self._on_append: Optional["AppendSink"] = on_append
+        records, loaded_torn, self._seq = self._wal.load_with_seq()
+        # Torn/corrupt damage now surfaces as quarantined bytes (the WAL
+        # moved the invalid suffix aside before this load), but the flag
+        # keeps meaning "the directory carried damage we dropped".
+        self.recovered_quarantined_bytes = self._wal.quarantined_bytes
+        self.recovered_torn_tail = (
+            loaded_torn or self._wal.quarantined_bytes > 0
+        )
         self.recovered_records = len(records)
         for opcode, payload in records:
             apply_record(self._inner, opcode, payload)
@@ -254,6 +383,21 @@ class PersistentDataStore(datastore_lib.DataStore):
     @property
     def wal(self) -> WriteAheadLog:
         return self._wal
+
+    @property
+    def seq(self) -> int:
+        """The store's monotonic mutation sequence number (replication
+        stream positions and failover source comparisons key off it)."""
+        with self._lock:
+            return self._seq
+
+    def export_with_seq(self) -> Tuple[int, List[Tuple[int, bytes]]]:
+        """An atomic ``(seq, compacted records)`` snapshot of the store —
+        the replication baseline: a successor that applies the records and
+        remembers the seq holds exactly the state at that sequence."""
+        with self._lock:
+            self._check_converged()
+            return self._seq, export_records(self._inner)
 
     def _check_converged(self) -> None:
         if self._diverged is not None:
@@ -274,8 +418,11 @@ class PersistentDataStore(datastore_lib.DataStore):
             result = fn()
             try:
                 self._wal.append(opcode, payload)
+                self._seq += 1
                 if self._wal.appended_since_snapshot >= self._snapshot_interval:
-                    self._wal.compact(export_records(self._inner))
+                    self._wal.compact(
+                        export_records(self._inner), seq=self._seq
+                    )
             except BaseException as e:
                 self._diverged = (
                     f"WAL write failed after the mutation was applied "
@@ -283,13 +430,18 @@ class PersistentDataStore(datastore_lib.DataStore):
                     f"— restart the replica to recover to the logged state."
                 )
                 raise
+            if self._on_append is not None:
+                try:
+                    self._on_append.submit(self._seq, opcode, payload)
+                except Exception:  # replication is redundancy, not the
+                    pass  # write path: a streamer fault must not fail RPCs
         return result
 
     def compact_now(self) -> None:
         """Forces a snapshot compaction (tests, graceful shutdown)."""
         with self._lock:
             self._check_converged()
-            self._wal.compact(export_records(self._inner))
+            self._wal.compact(export_records(self._inner), seq=self._seq)
 
     def close(self) -> None:
         self._wal.close()
@@ -526,6 +678,8 @@ def apply_record(
             store.update_metadata(request.name, study_kvs, trial_kvs)
         except datastore_lib.NotFoundError:
             pass
+    elif opcode == SNAPSHOT_META:
+        pass  # bookkeeping record: carries a sequence number, no state
     else:
         raise ValueError(f"Unknown WAL opcode: {opcode}")
 
@@ -538,8 +692,50 @@ def read_directory(
     Read-only: used by failover to lift a dead replica's studies into
     their successor replicas without opening the directory for append.
     """
-    snapshot, _ = WriteAheadLog._read_records(
+    records, torn = read_directory_with_seqs(directory)
+    return [(opcode, payload) for _seq, opcode, payload in records], torn
+
+
+def read_directory_with_seqs(
+    directory: str,
+) -> Tuple[List[Tuple[int, int, bytes]], bool]:
+    """Like :func:`read_directory`, with each record's mutation sequence.
+
+    Snapshot records all carry the snapshot's base sequence (they are a
+    compaction of everything up to it); live log record *i* carries
+    ``base + 1 + i``. Read-only and damage-tolerant: a corrupt or torn
+    suffix in either file is excluded (the longest valid prefix is what a
+    failover can trust), reported via the second element.
+    """
+    snapshot, snapshot_torn = WriteAheadLog._read_records(
         os.path.join(directory, SNAPSHOT_FILE)
     )
-    log, torn = WriteAheadLog._read_records(os.path.join(directory, LOG_FILE))
-    return snapshot + log, torn
+    if snapshot_torn:
+        snapshot = []
+    base_seq, snapshot = split_meta(snapshot)
+    log, log_torn = WriteAheadLog._read_records(
+        os.path.join(directory, LOG_FILE)
+    )
+    records = [(base_seq, opcode, payload) for opcode, payload in snapshot]
+    offset = 0
+    for opcode, payload in log:
+        if opcode == SNAPSHOT_META:
+            continue
+        offset += 1
+        records.append((base_seq + offset, opcode, payload))
+    return records, log_torn or snapshot_torn
+
+
+def group_by_study(
+    records: Iterable[Tuple[int, int, bytes]],
+) -> Dict[str, List[Tuple[int, int, bytes]]]:
+    """``study -> [(seq, opcode, payload)]`` in record order (recovery
+    source selection compares and replays per study)."""
+    out: Dict[str, List[Tuple[int, int, bytes]]] = {}
+    for seq, opcode, payload in records:
+        if opcode == SNAPSHOT_META:
+            continue
+        out.setdefault(study_key_of(opcode, payload), []).append(
+            (seq, opcode, payload)
+        )
+    return out

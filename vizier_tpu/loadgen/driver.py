@@ -16,14 +16,19 @@ errors) are recorded keyed by trace_id into the PR 11 flight recorder and
 returned as :class:`RequestRecord` rows; per-study trajectories and
 best-so-far curves feed the report's regret-parity and bit-identity
 checks. The scripted event track fires at deterministic completed-trial
-counts: replica kill/revive (revive behind a drain gate — the handback
-protocol assumes quiesced traffic), and chaos transport-fault windows via
-``testing/chaos.py``.
+counts: replica kill/revive, simultaneous ``multi_kill``, fleet-wide
+``rolling_restart``, mid-file ``wal_corrupt``, and chaos transport-fault
+windows via ``testing/chaos.py``. With WAL replication armed (the
+default on the replica tier) revives run under LIVE traffic — the
+epoch-fenced cutover + the tier's own failover barrier replace the
+driver's external drain gate, which is kept only for replication-off
+runs (the pre-replication handback contract).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import tempfile
 import threading
 import time
@@ -243,6 +248,7 @@ class _InProcessTarget:
     """One VizierServicer + shared Pythia (the PR 1–5 single-node stack)."""
 
     supports_replicas = False
+    replication_active = False
 
     def __init__(self, scenario: models.Scenario, reliability, factory):
         from vizier_tpu.service import pythia_service, vizier_service
@@ -271,10 +277,16 @@ class _InProcessTarget:
     def owner_of(self, study_name: str) -> Optional[str]:
         return None
 
+    def replica_ids(self) -> List[str]:
+        return []
+
     def kill_replica(self, replica_id: str) -> None:
         raise RuntimeError("kill_replica needs the replicas target.")
 
     revive_replica = kill_replica
+    fail_over = kill_replica
+    is_alive = kill_replica
+    corrupt_wal = kill_replica
 
     def shutdown(self) -> None:
         self._pythia.shutdown()
@@ -308,14 +320,51 @@ class _ReplicaTarget:
     def serving_stats(self) -> dict:
         return self._manager.serving_stats()
 
+    @property
+    def replication_active(self) -> bool:
+        """True when the tier streams WAL appends to standby logs — the
+        regime where kill/revive are safe under live traffic (failover
+        barrier + epoch fence) and the driver needs no external gate."""
+        return self._manager.replication_active
+
     def owner_of(self, study_name: str) -> str:
         return self._manager.router.replica_for(study_name)
+
+    def replica_ids(self) -> List[str]:
+        return self._manager.replica_ids()
+
+    def is_alive(self, replica_id: str) -> bool:
+        return self._manager.replica(replica_id).alive
 
     def kill_replica(self, replica_id: str) -> None:
         self._manager.kill_replica(replica_id)
 
+    def fail_over(self, replica_id: str) -> int:
+        return self._manager.fail_over(replica_id)
+
     def revive_replica(self, replica_id: str) -> None:
         self._manager.revive_replica(replica_id)
+
+    def corrupt_wal(self, replica_id: str) -> Dict[str, object]:
+        """Deterministically flips 16 bytes at the midpoint of the
+        replica's live wal.log (the mid-log corruption a ``wal_corrupt``
+        event injects). A later restart of the replica must quarantine
+        the now-unreadable suffix and recover it from standby logs."""
+        replica = self._manager.replica(replica_id)
+        if not replica.wal_dir:
+            return {"skipped": "no wal dir"}
+        path = os.path.join(replica.wal_dir, "wal.log")
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return {"skipped": "no wal.log"}
+        if size < 64:
+            return {"skipped": f"log too small ({size} bytes)"}
+        offset = size // 2
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            f.write(b"\xff" * 16)
+        return {"log_bytes": size, "corrupted_at": offset}
 
     def shutdown(self) -> None:
         self._manager.shutdown()
@@ -425,6 +474,37 @@ class _EventEngine:
         for event in due:
             self._fire(event, total_completed)
 
+    def _revive(self, replica: str) -> None:
+        """Hands a replica back. With replication armed the cutover is
+        epoch-fenced and fresh RPCs drain through the tier's own failover
+        barrier — live traffic keeps flowing; without it the driver
+        models a production rollout: drain via the external gate, hand
+        back, resume."""
+        if getattr(self._target, "replication_active", False):
+            self._target.revive_replica(replica)
+            return
+        self._gate.quiesce()
+        try:
+            self._target.revive_replica(replica)
+        finally:
+            self._gate.resume()
+
+    def _distinct_owners(self, count: int) -> List[str]:
+        """The first ``count`` distinct LIVE owners in study-index order
+        (deterministic under any concurrency)."""
+        owners: List[str] = []
+        for spec in self._scenario.studies:
+            replica = self._target.owner_of(spec.name)
+            if (
+                replica is not None
+                and replica not in owners
+                and self._target.is_alive(replica)
+            ):
+                owners.append(replica)
+            if len(owners) >= count:
+                break
+        return owners
+
     def _fire(self, event: models.EventSpec, at: int) -> None:
         record: Dict[str, object] = {
             "kind": event.kind,
@@ -450,11 +530,61 @@ class _EventEngine:
                 if replica is None or not self._target.supports_replicas:
                     record["skipped"] = "no replica tier"
                 else:
-                    self._gate.quiesce()
-                    try:
-                        self._target.revive_replica(replica)
-                    finally:
-                        self._gate.resume()
+                    self._revive(replica)
+            elif event.kind == "multi_kill":
+                if not self._target.supports_replicas:
+                    record["skipped"] = "no replica tier"
+                else:
+                    count = int(event.arg or "2")
+                    victims = self._distinct_owners(count)
+                    record["replicas"] = victims
+                    if len(victims) < count:
+                        record["skipped"] = (
+                            f"only {len(victims)} live owners"
+                        )
+                    else:
+                        # SIMULTANEOUS: all victims are dead before any
+                        # failover runs, so the sweep must re-route
+                        # around every corpse (the concurrent
+                        # multi-failure path). One fail_over call sweeps
+                        # them all, deterministically.
+                        for replica in victims:
+                            self._target.kill_replica(replica)
+                        record["restored"] = self._target.fail_over(
+                            victims[0]
+                        )
+            elif event.kind == "rolling_restart":
+                if not self._target.supports_replicas:
+                    record["skipped"] = "no replica tier"
+                else:
+                    # Revive already-dead replicas FIRST (multi_kill
+                    # victims): restarting the last live replica while
+                    # others are still down would leave zero live
+                    # replicas mid-roll.
+                    replicas = self._target.replica_ids()
+                    dead = [
+                        r for r in replicas if not self._target.is_alive(r)
+                    ]
+                    for replica in dead:
+                        self._target.fail_over(replica)  # ensure swept
+                        self._revive(replica)
+                    restarted = []
+                    for replica in replicas:
+                        if replica in dead:
+                            continue  # already cycled above
+                        self._target.kill_replica(replica)
+                        self._target.fail_over(replica)
+                        self._revive(replica)
+                        restarted.append(replica)
+                    record["revived_first"] = dead
+                    record["restarted"] = restarted
+            elif event.kind == "wal_corrupt":
+                replica = self._resolve_replica(event.arg, event.kind)
+                record["replica"] = replica
+                if replica is None or not self._target.supports_replicas:
+                    record["skipped"] = "no replica tier"
+                else:
+                    record["corruption"] = self._target.corrupt_wal(replica)
         except Exception as e:  # a failed event is a finding, not a crash
             record["error"] = f"{type(e).__name__}: {e}"
         self.fired.append(record)

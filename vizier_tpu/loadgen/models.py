@@ -62,7 +62,25 @@ GP_KINDS = ("gp_bandit", "gp_bandit_sparse", "gp_ucb_pe", "gp_ucb_pe_sparse")
 SPARSE_KINDS = ("gp_bandit_sparse", "gp_ucb_pe_sparse")
 
 _TARGETS = ("inprocess", "replicas")
-_EVENT_KINDS = ("kill_replica", "revive_replica", "chaos_on", "chaos_off")
+_EVENT_KINDS = (
+    "kill_replica",
+    "revive_replica",
+    "chaos_on",
+    "chaos_off",
+    # Severity track (replica tiers with >= 3 replicas):
+    # multi_kill — kill N replicas SIMULTANEOUSLY (arg = N, default 2);
+    #   the fleet must fail all of them over in one sweep with zero lost
+    #   studies (the concurrent-multi-failure path).
+    # rolling_restart — kill → fail over → revive every replica in id
+    #   order, one at a time, under live traffic (the epoch-fenced
+    #   handback path); dead replicas are revived in the same sweep.
+    # wal_corrupt — flip bytes mid-file in a replica's live wal.log
+    #   (arg = replica id or owner:<study index>); a later restart must
+    #   quarantine the suffix and recover the tail from standby logs.
+    "multi_kill",
+    "rolling_restart",
+    "wal_corrupt",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -447,9 +465,17 @@ class Scenario:
 def default_event_track(
     config: ScenarioConfig, total_trials: int
 ) -> Tuple[EventSpec, ...]:
-    """The canonical fleet track: kill the owner of study 0 at ~40% of
-    the trial volume, revive it at ~70%, with a chaos fault window over
-    the middle decile. Kill/revive only make sense on the replica tier."""
+    """The canonical fleet track.
+
+    2-replica tiers keep the original shape: kill the owner of study 0 at
+    ~40% of the trial volume, revive it at ~70%, chaos window over the
+    middle decile. Tiers with >= 3 replicas get the SEVERITY track
+    instead: a 2-simultaneous ``multi_kill`` at ~35%, a mid-file
+    ``wal_corrupt`` of study 0's (post-failover) owner at ~45%, and a
+    ``rolling_restart`` of the whole fleet at ~75% — which also revives
+    the multi-kill victims and forces the corrupted replica through
+    quarantine + standby recovery. Kill/revive only make sense on the
+    replica tier."""
     events: List[EventSpec] = []
     if config.chaos_fault_prob > 0:
         events.append(
@@ -458,7 +484,19 @@ def default_event_track(
         events.append(
             EventSpec(max(2, int(total_trials * 0.60)), "chaos_off")
         )
-    if config.target == "replicas" and config.replicas >= 2:
+    if config.target == "replicas" and config.replicas >= 3:
+        events.append(
+            EventSpec(max(1, int(total_trials * 0.35)), "multi_kill", "2")
+        )
+        events.append(
+            EventSpec(
+                max(2, int(total_trials * 0.45)), "wal_corrupt", "owner:0"
+            )
+        )
+        events.append(
+            EventSpec(max(3, int(total_trials * 0.75)), "rolling_restart")
+        )
+    elif config.target == "replicas" and config.replicas >= 2:
         events.append(
             EventSpec(max(1, int(total_trials * 0.40)), "kill_replica", "owner:0")
         )
@@ -621,13 +659,15 @@ def smoke_config(**overrides) -> ScenarioConfig:
 
 def soak_config(**overrides) -> ScenarioConfig:
     """The acceptance-scale scenario: ≥1000 Zipf-sized studies across all
-    registered program kinds on a 2-replica tier, speculation + batching
-    + mesh + SLO armed, with the default kill/revive + chaos track."""
+    registered program kinds on a 3-replica tier, speculation + batching
+    + mesh + SLO armed, with the SEVERITY event track (2-simultaneous
+    multi_kill + mid-file wal_corrupt + rolling_restart) plus the chaos
+    fault window."""
     values: Dict[str, object] = dict(
         name="soak",
         num_studies=1000,
         max_trials=16,
-        replicas=2,
+        replicas=3,
         concurrency=8,
         sparse_threshold=8,
         sparse_inducing=8,

@@ -390,6 +390,166 @@ def build_report(
     return report
 
 
+def diff_reports(
+    a: dict,
+    b: dict,
+    *,
+    hit_rate_drop: float = 0.10,
+    fallback_rise: float = 0.05,
+    latency_ratio: float = 0.0,
+) -> dict:
+    """Compares two SOAK_REPORTs (A = before, B = after).
+
+    The ROADMAP defaults-ON campaign's before/after gate: per-kind
+    suggest-latency deltas, assertion verdict changes, speculative
+    hit-rate and fallback-rate deltas. **Regressions** (what flips
+    ``ok`` to False) are: an assertion that passed in A and fails in B;
+    a GP hit-rate drop > ``hit_rate_drop``; a fallback-rate rise >
+    ``fallback_rise``; and, when ``latency_ratio`` > 0, any per-kind p99
+    that grew by more than that factor (off by default — wall-clock
+    comparisons across machines are advisory, verdicts are the gate).
+    """
+
+    def _assertions(report: dict) -> Dict[str, bool]:
+        return {
+            row["name"]: bool(row["ok"])
+            for row in report.get("assertions", [])
+        }
+
+    regressions: List[str] = []
+    a_asserts, b_asserts = _assertions(a), _assertions(b)
+    verdict_changes: Dict[str, dict] = {}
+    for name in sorted(set(a_asserts) | set(b_asserts)):
+        before, after = a_asserts.get(name), b_asserts.get(name)
+        if before != after:
+            verdict_changes[name] = {"before": before, "after": after}
+        if before is True and after is False:
+            regressions.append(f"assertion {name}: pass -> FAIL")
+
+    per_kind: Dict[str, dict] = {}
+    a_kinds = a.get("outcomes", {}).get("by_kind", {})
+    b_kinds = b.get("outcomes", {}).get("by_kind", {})
+    for kind in sorted(set(a_kinds) | set(b_kinds)):
+        row_a, row_b = a_kinds.get(kind), b_kinds.get(kind)
+        entry: Dict[str, object] = {
+            "present": {"before": row_a is not None, "after": row_b is not None}
+        }
+        if row_a and row_b:
+            for q in ("p50_ms", "p99_ms"):
+                before = row_a.get("latency", {}).get(q)
+                after = row_b.get("latency", {}).get(q)
+                if before is not None and after is not None:
+                    entry[q] = {
+                        "before": before,
+                        "after": after,
+                        "delta": round(after - before, 3),
+                        "ratio": round(after / before, 3)
+                        if before
+                        else None,
+                    }
+            if (
+                latency_ratio > 0
+                and isinstance(entry.get("p99_ms"), dict)
+                and entry["p99_ms"].get("ratio") is not None
+                and entry["p99_ms"]["ratio"] > latency_ratio
+            ):
+                regressions.append(
+                    f"{kind} p99 {entry['p99_ms']['ratio']}x "
+                    f"(> {latency_ratio}x budget)"
+                )
+            entry["fallback_rate"] = {
+                "before": row_a.get("fallback_rate", 0.0),
+                "after": row_b.get("fallback_rate", 0.0),
+            }
+            entry["hit_rate"] = {
+                "before": row_a.get("hit_rate", 0.0),
+                "after": row_b.get("hit_rate", 0.0),
+            }
+        elif row_a and not row_b:
+            regressions.append(f"kind {kind} served in A but absent in B")
+        per_kind[kind] = entry
+
+    spec_a = a.get("speculative", {}) or {}
+    spec_b = b.get("speculative", {}) or {}
+    speculative = {
+        "hits": {"before": spec_a.get("hits"), "after": spec_b.get("hits")},
+        "gp_hit_rate": {
+            "before": spec_a.get("gp_hit_rate"),
+            "after": spec_b.get("gp_hit_rate"),
+        },
+    }
+    if (
+        spec_a.get("armed")
+        and spec_b.get("armed")
+        and spec_a.get("gp_hit_rate") is not None
+        and spec_b.get("gp_hit_rate") is not None
+        and spec_b["gp_hit_rate"] < spec_a["gp_hit_rate"] - hit_rate_drop
+    ):
+        regressions.append(
+            f"gp hit rate {spec_a['gp_hit_rate']} -> "
+            f"{spec_b['gp_hit_rate']} (drop > {hit_rate_drop})"
+        )
+
+    def _fallback_rate(report: dict) -> Optional[float]:
+        kinds = report.get("outcomes", {}).get("by_kind", {})
+        suggests = sum(r.get("suggests", 0) for r in kinds.values())
+        fallbacks = sum(r.get("fallbacks", 0) for r in kinds.values())
+        return round(fallbacks / suggests, 4) if suggests else None
+
+    fb_a, fb_b = _fallback_rate(a), _fallback_rate(b)
+    fallback = {"before": fb_a, "after": fb_b}
+    if fb_a is not None and fb_b is not None and fb_b > fb_a + fallback_rise:
+        regressions.append(
+            f"fallback rate {fb_a} -> {fb_b} (rise > {fallback_rise})"
+        )
+
+    return {
+        "what": "SOAK_REPORT diff (A = before, B = after)",
+        "fingerprints": {
+            "before": (a.get("scenario") or {}).get("fingerprint"),
+            "after": (b.get("scenario") or {}).get("fingerprint"),
+        },
+        "same_scenario": (a.get("scenario") or {}).get("fingerprint")
+        == (b.get("scenario") or {}).get("fingerprint"),
+        "ok_flags": {"before": a.get("ok"), "after": b.get("ok")},
+        "assertion_changes": verdict_changes,
+        "per_kind": per_kind,
+        "speculative": speculative,
+        "fallback_rate": fallback,
+        "regressions": regressions,
+        "ok": not regressions,
+    }
+
+
+def render_diff(diff: dict) -> str:
+    """Human rendering of :func:`diff_reports` (the --diff stdout)."""
+    lines = [
+        f"soak diff: {'OK' if diff['ok'] else 'REGRESSED'} "
+        f"(same scenario: {diff['same_scenario']})"
+    ]
+    for name, change in sorted(diff["assertion_changes"].items()):
+        lines.append(
+            f"  verdict {name}: {change['before']} -> {change['after']}"
+        )
+    for kind, entry in sorted(diff["per_kind"].items()):
+        p99 = entry.get("p99_ms")
+        if isinstance(p99, dict):
+            lines.append(
+                f"  {kind}: p99 {p99['before']} -> {p99['after']} ms "
+                f"({p99['ratio']}x)"
+            )
+    spec = diff["speculative"]["gp_hit_rate"]
+    if spec["before"] is not None or spec["after"] is not None:
+        lines.append(
+            f"  gp hit rate: {spec['before']} -> {spec['after']}"
+        )
+    fb = diff["fallback_rate"]
+    lines.append(f"  fallback rate: {fb['before']} -> {fb['after']}")
+    for regression in diff["regressions"]:
+        lines.append(f"  REGRESSION: {regression}")
+    return "\n".join(lines)
+
+
 def render_verdict(report: dict) -> str:
     """The one-screen human verdict (the CLI's stdout tail)."""
     lines = [

@@ -60,6 +60,7 @@ class ServingStats:
         "speculative_cancelled",  # jobs superseded / dropped busy / shutdown
         "speculative_precomputes",  # speculative designer computations run
         "speculative_errors",  # speculative failures swallowed off-path
+        "speculative_rearms",  # pre-computes re-armed by replica failover
     )
 
     def __init__(self, registry: Optional[metrics_lib.MetricsRegistry] = None):
