@@ -53,16 +53,17 @@ class TestRealTree:
                 )
 
     def test_registry_covers_the_trees_switch_count(self):
-        # 63 in-tree env switches (incl. the 10 VIZIER_DISTRIBUTED* tier
+        # 75 in-tree env switches (incl. the 10 VIZIER_DISTRIBUTED* tier
         # knobs — 6 topology/WAL + 4 replication — the 5 VIZIER_SPARSE*
         # surrogate knobs, the 6 VIZIER_SPECULATIVE* pre-compute knobs,
-        # the 6 VIZIER_MESH* execution-plane knobs, the 7 VIZIER_SLO*
+        # the 6 VIZIER_MESH* execution-plane knobs, the 8 VIZIER_SLO*
         # objectives, the 3 VIZIER_FLIGHT_RECORDER* knobs,
-        # VIZIER_OBS_DUMP_DIR, and the 5 VIZIER_LOADGEN* traffic-engine
-        # knobs) + 3 bench switches + the 2 reserved grpc constants.
+        # VIZIER_OBS_DUMP_DIR, the 5 VIZIER_LOADGEN* traffic-engine
+        # knobs, and the 11 VIZIER_ADMISSION* overload-protection knobs)
+        # + 3 bench switches + the 2 reserved grpc constants.
         # Growing the tree means growing this registry.
-        assert len(registry.SWITCHES) == 68
-        assert len(registry.env_switch_names()) == 66
+        assert len(registry.SWITCHES) == 80
+        assert len(registry.env_switch_names()) == 78
 
     def test_known_switches_declared(self):
         for name in (

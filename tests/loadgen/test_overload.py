@@ -1,0 +1,267 @@
+"""Overload-scenario loadgen pieces: the hot_tenant preset, per-tenant
+kind overrides, real open-loop arrival pacing, the report's per-tenant
+latency/shed tables, and the --diff shed-rate regression gate."""
+
+import dataclasses
+
+from vizier_tpu.loadgen import driver as driver_lib
+from vizier_tpu.loadgen import models
+from vizier_tpu.loadgen import report as report_lib
+
+
+class TestHotTenantPreset:
+    def test_builds_and_is_deterministic(self):
+        a = models.build_scenario(models.hot_tenant_config())
+        b = models.build_scenario(models.hot_tenant_config())
+        assert a.fingerprint() == b.fingerprint()
+        assert a.config.time_scale == 1.0
+        assert a.config.planes.admission
+
+    def test_hot_tenant_has_zipf_head_share_and_gp_only_traffic(self):
+        scenario = models.build_scenario(models.hot_tenant_config())
+        by_tenant = {}
+        for spec in scenario.studies:
+            by_tenant.setdefault(spec.tenant, []).append(spec)
+        hot = by_tenant["hot"]
+        assert len(hot) > len(scenario.studies) / 2  # the Zipf head
+        assert all(s.kind == "gp_bandit" for s in hot)  # tenant override
+        light = [
+            s for s in scenario.studies if s.tenant.startswith("light-")
+        ]
+        assert light
+        assert any(s.kind == "random" for s in light)  # global mix kept
+
+    def test_tenant_kind_override_leaves_base_expansion_unchanged(self):
+        base = models.hot_tenant_config(tenant_kinds=())
+        overridden = models.hot_tenant_config()
+        a = models.build_scenario(base)
+        b = models.build_scenario(overridden)
+        for sa, sb in zip(a.studies, b.studies):
+            assert sa.tenant == sb.tenant
+            assert sa.budget == sb.budget
+            assert sa.arrival_s == sb.arrival_s
+            assert sa.seed == sb.seed
+
+    def test_owner_tenant_round_trip(self):
+        assert models.owner_tenant(models.tenant_owner("hot")) == "hot"
+        assert models.owner_tenant("someone-else") == "someone-else"
+        scenario = models.build_scenario(models.hot_tenant_config())
+        spec = scenario.studies[0]
+        owner = spec.name.split("/")[1]
+        assert models.owner_tenant(owner) == spec.tenant
+
+    def test_admission_env_overlay(self):
+        config = models.hot_tenant_config()
+        env = driver_lib.scenario_env(config)
+        assert env["VIZIER_ADMISSION"] == "1"
+        assert "loadgen-hot:0.5" in env["VIZIER_ADMISSION_WEIGHTS"]
+        assert env["VIZIER_ADMISSION_TENANT_INFLIGHT"] == "3"
+        assert env["VIZIER_ADMISSION_RETRY_AFTER_MS"] == "250.0"
+        off = dataclasses.replace(
+            config, planes=dataclasses.replace(config.planes, admission=False)
+        )
+        env_off = driver_lib.scenario_env(off)
+        assert env_off["VIZIER_ADMISSION"] == "0"
+        assert "VIZIER_ADMISSION_WEIGHTS" not in env_off
+
+
+class TestOpenLoopPacing:
+    def _tiny_open_loop(self, **overrides):
+        values = dict(
+            name="pace",
+            num_studies=6,
+            min_trials=1,
+            max_trials=1,
+            target="inprocess",
+            concurrency=2,
+            time_scale=1.0,
+            arrival_rate_per_s=10.0,
+            kind_mix=(("random", 1.0),),
+            chaos_fault_prob=0.0,
+            parity_cohort=1,
+            planes=models.PlaneConfig.gated_off(),
+            events=(),
+        )
+        values.update(overrides)
+        return models.build_scenario(models.ScenarioConfig(**values))
+
+    def test_arrivals_are_honored_in_real_time(self):
+        """time_scale=1 paces the run: the wall clock covers the arrival
+        schedule even though each random-kind study completes in
+        microseconds (the closed-loop driver would finish instantly)."""
+        scenario = self._tiny_open_loop()
+        result = driver_lib.run(scenario, arm="pace")
+        assert not result.lost_studies()
+        assert not result.errored_studies()
+        last_arrival = scenario.studies[-1].arrival_s
+        assert result.wall_s >= last_arrival * 0.9
+        assert result.open_loop_capped == 0
+
+    def test_arrivals_do_not_wait_for_busy_workers(self):
+        """Open loop means every study gets its own client thread at its
+        release instant: 6 studies with concurrency=2 still all run (the
+        old worker pool would serialize 3-deep)."""
+        scenario = self._tiny_open_loop(concurrency=1)
+        result = driver_lib.run(scenario, arm="pace")
+        assert len(result.outcomes) == 6
+        assert not result.errored_studies()
+
+    def test_runaway_cap_is_recorded(self):
+        # Arrivals far faster than studies drain (multi-trial studies,
+        # sub-ms inter-arrivals) against a 1-client cap: the pacer must
+        # block and record it.
+        scenario = self._tiny_open_loop(
+            open_loop_max_clients=1,
+            min_trials=5,
+            max_trials=5,
+            arrival_rate_per_s=2000.0,
+        )
+        result = driver_lib.run(scenario, arm="pace")
+        assert not result.errored_studies()
+        assert result.open_loop_capped >= 1
+
+    def test_closed_loop_unchanged_when_time_scale_zero(self):
+        scenario = self._tiny_open_loop(time_scale=0.0)
+        result = driver_lib.run(scenario, arm="pace")
+        assert len(result.outcomes) == 6
+        # Arrival ORDER only: drains far faster than the schedule.
+        assert result.wall_s < scenario.studies[-1].arrival_s + 5.0
+
+
+class TestPerTenantReport:
+    def _result(self, **admission):
+        scenario = models.build_scenario(
+            models.ScenarioConfig(
+                name="t",
+                num_studies=2,
+                min_trials=1,
+                max_trials=1,
+                target="inprocess",
+                tenants=(("hot", 1.0), ("light", 1.0)),
+                kind_mix=(("random", 1.0),),
+                chaos_fault_prob=0.0,
+                events=(),
+            )
+        )
+        records = [
+            driver_lib.RequestRecord(0, "random", "hot", "suggest", 0.2),
+            driver_lib.RequestRecord(
+                0, "random", "hot", "suggest", 0.4,
+                error="TRANSIENT: RESOURCE_EXHAUSTED: admission shed",
+            ),
+            driver_lib.RequestRecord(
+                1, "random", "light", "suggest", 0.01
+            ),
+            driver_lib.RequestRecord(
+                1, "random", "light", "suggest", 0.02, degraded=True
+            ),
+        ]
+        outcomes = {
+            i: driver_lib.StudyOutcome(
+                spec=scenario.studies[i], completed=1, expected=1,
+                listed_completed=1,
+            )
+            for i in range(2)
+        }
+        result = driver_lib.SoakResult(
+            arm="engine",
+            scenario_fingerprint=scenario.fingerprint(),
+            records=records,
+            outcomes=outcomes,
+            events_fired=[],
+            serving_stats={},
+            slo={},
+            wall_s=1.0,
+            admission=admission
+            or {
+                "enabled": True,
+                "sheds_by_tenant": {"hot": {"inflight_tenant": 3}},
+                "admits_by_tenant": {"hot": 5, "light": 4},
+                "degraded_by_tenant": {"hot": 1},
+                "state": "shedding",
+            },
+        )
+        return scenario, result
+
+    def test_by_tenant_rows_carry_latency_and_sheds(self):
+        scenario, result = self._result()
+        tables = report_lib._outcome_tables(result)
+        hot = tables["by_tenant"]["hot"]
+        assert hot["sheds"] == 3  # controller view (absorbed sheds too)
+        assert hot["shed_errors"] == 1  # client-visible after retries
+        assert hot["latency"]["samples"] == 1  # errored suggest excluded
+        light = tables["by_tenant"]["light"]
+        assert light["degraded"] == 1
+        assert light["sheds"] == 0
+        assert light["latency"]["p99_ms"] > 0
+
+    def test_admission_section_and_shed_rate(self):
+        scenario, result = self._result()
+        config = dataclasses.replace(
+            scenario.config,
+            planes=dataclasses.replace(scenario.config.planes, admission=True),
+        )
+        section = report_lib._admission_section(config, result)
+        assert section["armed"]
+        assert section["sheds"] == 3
+        assert section["degraded_serves"] == 1
+        # 3 sheds / (3 sheds + 9 admits + 1 degraded)
+        assert section["shed_rate"] == round(3 / 13, 4)
+
+
+class TestDiffShedGate:
+    def _report(self, shed_rate, armed=True, tenant_p99=100.0):
+        return {
+            "ok": True,
+            "assertions": [],
+            "outcomes": {
+                "by_kind": {},
+                "by_tenant": {
+                    "light": {
+                        "sheds": 0,
+                        "latency": {"p50_ms": 50.0, "p99_ms": tenant_p99},
+                    }
+                },
+            },
+            "admission": {"armed": armed, "shed_rate": shed_rate},
+            "speculative": {},
+            "scenario": {"fingerprint": "f"},
+        }
+
+    def test_shed_rise_with_plane_unchanged_regresses(self):
+        diff = report_lib.diff_reports(
+            self._report(0.01), self._report(0.10)
+        )
+        assert not diff["ok"]
+        assert any("shed rate" in r for r in diff["regressions"])
+
+    def test_shed_rise_within_budget_passes(self):
+        diff = report_lib.diff_reports(
+            self._report(0.01), self._report(0.05)
+        )
+        assert diff["ok"]
+
+    def test_arming_the_plane_is_not_a_regression(self):
+        diff = report_lib.diff_reports(
+            self._report(0.0, armed=False), self._report(0.2, armed=True)
+        )
+        assert diff["ok"]
+        assert diff["admission"]["armed"] == {"before": False, "after": True}
+
+    def test_per_tenant_p99_deltas_reported_and_gated(self):
+        advisory = report_lib.diff_reports(
+            self._report(0.0, tenant_p99=100.0),
+            self._report(0.0, tenant_p99=900.0),
+        )
+        assert advisory["ok"]  # advisory without a latency budget
+        assert advisory["per_tenant"]["light"]["p99_ms"]["ratio"] == 9.0
+        gated = report_lib.diff_reports(
+            self._report(0.0, tenant_p99=100.0),
+            self._report(0.0, tenant_p99=900.0),
+            latency_ratio=3.0,
+        )
+        assert not gated["ok"]
+        assert any("tenant light p99" in r for r in gated["regressions"])
+        rendered = report_lib.render_diff(gated)
+        assert "tenant light" in rendered
+        assert "admission shed rate" in rendered

@@ -510,7 +510,10 @@ class TestSpeculativeLane:
         Deterministic via a fake clock: nothing becomes due until the
         clock advances, so the speculative bucket cannot sneak an
         idle-window flush in before the live slot is even submitted (a
-        real-time race on a loaded machine)."""
+        real-time race on a loaded machine). Flush ORDER is observed at
+        the scheduler's _execute (sequential on its thread) — the
+        waiter-side suggest/finalize calls run on racing client threads
+        and cannot order-assert reliably."""
         clock = [0.0]
         executor = BatchExecutor(
             max_batch_size=8,
@@ -519,22 +522,20 @@ class TestSpeculativeLane:
             time_fn=lambda: clock[0],
         )
         flush_order = []
-        flush_lock = threading.Lock()
+        original_execute = executor._execute
+
+        def recording_execute(key, slots, reason, placement=None):
+            flush_order.append(
+                "spec" if all(s.speculative for s in slots) else "live"
+            )
+            return original_execute(key, slots, reason, placement)
+
+        executor._execute = recording_execute
 
         class Recording(StubDesigner):
             def __init__(self, value, group, tag):
                 super().__init__(value, group=group)
                 self.tag = tag
-
-            def suggest(self, count=1):
-                with flush_lock:
-                    flush_order.append(self.tag)
-                return super().suggest(count)
-
-            def batch_finalize(self, item, output):
-                with flush_lock:
-                    flush_order.append(self.tag)
-                return super().batch_finalize(item, output)
 
         try:
             results = {}

@@ -1,0 +1,321 @@
+"""Lane-fairness invariants for the N-lane batch-executor scheduler.
+
+Pins the PR's fairness guarantees at the selection layer (no device
+programs needed): the deficit-round-robin starvation bound (a
+continuously-hot tenant cannot delay a light tenant's first slot by more
+than its configured quantum), weighted long-run shares, FIFO bit-identity
+with the admission plane off, the N-lane generalization of the old
+two-lane live/speculative scheduler, and cross-bucket ordering by
+weighted served-slot credit.
+"""
+
+import threading
+
+import pytest
+
+from vizier_tpu.compute import ir as compute_ir
+from vizier_tpu.parallel import batch_executor as be
+from vizier_tpu.serving import admission as adm
+
+
+def controller(weights=()):
+    return adm.AdmissionController(
+        adm.AdmissionConfig(enabled=True, weights=tuple(weights))
+    )
+
+
+def slot(tenant=None, at=0.0, lane=be.LANE_LIVE):
+    return be._Slot(None, None, 1, at, None, lane=lane, tenant=tenant)
+
+
+def bucket_key(tag):
+    return compute_ir.BucketKey(
+        kind="t", pad_trials=8, cont_width=2, cat_width=0, metric_count=1,
+        count=1, statics=(("tag", tag),),
+    )
+
+
+class TestFairOrder:
+    def test_starvation_bound_light_within_one_round(self):
+        """A continuously-hot tenant (weight w) cannot push a light
+        tenant's queued slot past position w: one DRR round serves it."""
+        ex = be.BatchExecutor(
+            max_batch_size=4, admission=controller([("hot", 4.0)])
+        )
+        slots = [slot("hot", i) for i in range(12)] + [slot("light", 99)]
+        with ex._cond:
+            ordered = ex._fair_order(list(slots))
+        position = [s.tenant for s in ordered].index("light")
+        assert position <= 4
+        ex.close()
+
+    def test_weighted_shares(self):
+        ex = be.BatchExecutor(
+            max_batch_size=8,
+            admission=controller([("a", 3.0), ("b", 1.0)]),
+        )
+        slots = [slot("a", i) for i in range(12)] + [
+            slot("b", 100 + i) for i in range(12)
+        ]
+        with ex._cond:
+            ordered = ex._fair_order(list(slots))
+        first8 = [s.tenant for s in ordered[:8]]
+        assert first8.count("a") == 6
+        assert first8.count("b") == 2
+        ex.close()
+
+    def test_fifo_within_tenant(self):
+        ex = be.BatchExecutor(
+            max_batch_size=4, admission=controller([("a", 2.0)])
+        )
+        slots = [slot("a", i) for i in range(4)] + [slot("b", 10)]
+        with ex._cond:
+            ordered = ex._fair_order(list(slots))
+        a_times = [s.enqueued_at for s in ordered if s.tenant == "a"]
+        assert a_times == sorted(a_times)
+        ex.close()
+
+    def test_single_tenant_is_fifo(self):
+        ex = be.BatchExecutor(max_batch_size=4, admission=controller())
+        slots = [slot("a", i) for i in range(6)]
+        with ex._cond:
+            assert ex._fair_order(list(slots)) == slots
+        ex.close()
+
+    def test_ring_remembers_tenants_across_flushes(self):
+        """DRR state is persistent: the ring keeps every tenant ever
+        seen and the cursor advances, so rotation is fair across flushes
+        rather than restarting at the same tenant when rounds end
+        mid-ring."""
+        ex = be.BatchExecutor(max_batch_size=2, admission=controller())
+        with ex._cond:
+            ex._fair_order([slot("a", 0), slot("b", 1)])
+            assert ex._drr_ring == ["a", "b"]
+            # An uneven round (only c present) advances the cursor past
+            # the absent tenants without banking them credit.
+            ex._fair_order([slot("c", 0), slot("a", 1)])
+            assert set(ex._drr_ring) == {"a", "b", "c"}
+            # An absent tenant banks no credit for later rounds.
+            assert ex._drr_deficit.get("b", 0.0) == 0.0
+        ex.close()
+
+
+class TestTakeDueFairness:
+    def _executor(self, weights=(), admission="on"):
+        ctl = controller(weights) if admission == "on" else None
+        clock = [1000.0]
+        ex = be.BatchExecutor(
+            max_batch_size=4,
+            max_wait_ms=4.0,
+            admission=ctl,
+            time_fn=lambda: clock[0],
+        )
+        ex._clock = clock
+        return ex
+
+    def test_full_bucket_chunks_follow_drr(self):
+        ex = self._executor(weights=[("hot", 2.0)])
+        key = bucket_key("x")
+        with ex._cond:
+            ex._queues[key] = [slot("hot", i) for i in range(7)] + [
+                slot("light", 50)
+            ]
+            due = ex._take_due()
+        assert len(due) == 2  # one "full" chunk + the timeout remainder
+        first_chunk = [s.tenant for s in due[0][1]]
+        # DRR (hot quantum 2): light rides the FIRST flush despite seven
+        # hot slots queued ahead of it in FIFO order.
+        assert "light" in first_chunk
+        ex.close()
+
+    def test_fifo_bit_identity_with_admission_off(self):
+        """No controller -> selection is exactly the seed FIFO prefix."""
+        ex = self._executor(admission="off")
+        key = bucket_key("x")
+        ordered_in = [slot("hot", i) for i in range(7)] + [slot("light", 50)]
+        with ex._cond:
+            ex._queues[key] = list(ordered_in)
+            due = ex._take_due()
+        assert due[0][1] == ordered_in[:4]
+        assert due[0][2] == "full"
+        ex.close()
+
+    def test_cross_bucket_order_prefers_underserved_tenant(self):
+        ex = self._executor(weights=[("hot", 1.0), ("light", 1.0)])
+        hot_key, light_key = bucket_key("hot"), bucket_key("light")
+        with ex._cond:
+            # Bill the hot tenant with prior served slots.
+            ex._tenant_served["hot"] = 50.0
+            ex._queues[hot_key] = [slot("hot", i) for i in range(4)]
+            ex._queues[light_key] = [slot("light", i) for i in range(4)]
+            due = ex._take_due()
+        assert [slots[0].tenant for _k, slots, _r in due] == ["light", "hot"]
+        ex.close()
+
+    def test_timeout_uses_true_oldest_after_reorder(self):
+        """A DRR-reordered remainder still times out by its OLDEST slot's
+        enqueue time, not whatever landed at position 0."""
+        ex = self._executor(weights=[("hot", 4.0)])
+        key = bucket_key("x")
+        ex._clock[0] = 1000.002
+        with ex._cond:
+            # 6 slots: the full chunk takes hot0..3 (quantum 4); the DRR
+            # remainder is [light (newest), hot4 (older)] — no longer FIFO.
+            ex._queues[key] = [
+                slot("hot", 1000.0 + i * 0.0001) for i in range(5)
+            ] + [slot("light", 1000.001)]
+            due = ex._take_due()
+            assert due and due[0][2] == "full"
+            remainder = list(ex._queues[key])
+        assert [s.tenant for s in remainder] == ["light", "hot"]
+        assert remainder[0].enqueued_at > remainder[1].enqueued_at
+        # Position 0 (light) is NOT yet past the window, but the true
+        # oldest (hot4) is: the bucket must flush.
+        ex._clock[0] = 1000.0049
+        with ex._cond:
+            due = ex._take_due()
+        assert due and due[0][2] == "timeout"
+        ex.close()
+
+
+class TestLanes:
+    def test_default_lane_table_matches_two_lane_contract(self):
+        lanes = be.default_lanes(250.0)
+        by_name = {lane.name: lane for lane in lanes}
+        assert by_name[be.LANE_LIVE].priority < by_name[
+            be.LANE_SPECULATIVE
+        ].priority
+        assert not by_name[be.LANE_LIVE].deferrable
+        assert by_name[be.LANE_SPECULATIVE].deferrable
+        assert by_name[be.LANE_SPECULATIVE].starvation_cap_ms == 250.0
+
+    def test_slot_lane_back_compat(self):
+        live = slot()
+        spec = slot(lane=be.LANE_SPECULATIVE)
+        assert not live.speculative
+        assert spec.speculative
+
+    def test_deferrable_lane_waits_for_idle_window(self):
+        clock = [0.0]
+        ex = be.BatchExecutor(
+            max_batch_size=4,
+            max_wait_ms=4.0,
+            speculative_max_wait_ms=250.0,
+            time_fn=lambda: clock[0],
+        )
+        live_key, spec_key = bucket_key("live"), bucket_key("spec")
+        with ex._cond:
+            ex._queues[spec_key] = [slot(lane=be.LANE_SPECULATIVE, at=0.0)]
+            ex._queues[live_key] = [slot(at=0.0)]
+            clock[0] = 0.01  # past the live window, not the starvation cap
+            due = ex._take_due()
+            names = [key for key, _s, _r in due]
+            assert names == [live_key]  # spec bucket deferred
+            # Fresh live traffic keeps the spec bucket deferring until the
+            # starvation cap fires...
+            ex._queues[live_key] = [slot(at=0.299)]
+            clock[0] = 0.3  # past the cap for the spec slot
+            due = ex._take_due()
+            assert [r for _k, _s, r in due] == ["spec_starved"]
+            # ... while with NO priority traffic queued, the idle window
+            # opens and the ordinary flush rules apply (reason timeout).
+            ex._queues[spec_key] = [slot(lane=be.LANE_SPECULATIVE, at=0.3)]
+            ex._queues.pop(live_key, None)
+            clock[0] = 0.31
+            due = ex._take_due()
+        assert [r for _k, _s, r in due] == ["timeout"]
+        ex.close()
+
+    def test_third_lane_slots_order_after_live(self):
+        """The N-lane generalization: a custom lane between live and
+        speculative orders by priority with no scheduler edits."""
+        lanes = (
+            be.LaneSpec("live", priority=0),
+            be.LaneSpec("batchwork", priority=1, deferrable=True,
+                        starvation_cap_ms=100.0),
+            be.LaneSpec("speculative", priority=2, deferrable=True,
+                        starvation_cap_ms=250.0),
+        )
+        clock = [0.0]
+        ex = be.BatchExecutor(
+            max_batch_size=4, max_wait_ms=4.0, lanes=lanes,
+            time_fn=lambda: clock[0],
+        )
+        keys = {name: bucket_key(name) for name in ("live", "mid", "spec")}
+        with ex._cond:
+            ex._queues[keys["spec"]] = [slot(lane="speculative", at=0.0)]
+            ex._queues[keys["mid"]] = [slot(lane="batchwork", at=0.0)]
+            ex._queues[keys["live"]] = [slot(at=0.0)]
+            clock[0] = 0.5  # everything past every cap
+            due = ex._take_due()
+        assert [key for key, _s, _r in due] == [
+            keys["live"], keys["mid"], keys["spec"]
+        ]
+        ex.close()
+
+    def test_queue_depth_reports_all_lanes(self):
+        lanes = (
+            be.LaneSpec("live", priority=0),
+            be.LaneSpec("bulk", priority=1, deferrable=True),
+        )
+        ex = be.BatchExecutor(max_batch_size=4, lanes=lanes)
+        with ex._cond:
+            ex._queues[bucket_key("a")] = [slot(), slot(lane="bulk")]
+        assert ex.queue_depth() == {"live": 1, "bulk": 1}
+        assert ex.live_pending() == 1
+        ex.close()
+
+
+class TestEndToEndFairness:
+    def test_concurrent_submissions_carry_admission_tenant(self):
+        """suggest() reads the admission contextvar on the submitting
+        thread: slots carry the tenant the gate admitted."""
+
+        class FakeProgram:
+            def prepare(self, designer, count):
+                return {}
+
+        class FakeDesigner:
+            def suggest(self, count):
+                return [object() for _ in range(count)]
+
+        ctl = controller([("a", 2.0)])
+        ex = be.BatchExecutor(max_batch_size=4, admission=ctl)
+        seen = {}
+        original = be.compute_registry.resolve
+
+        def fake_resolve(designer, count):
+            return FakeProgram(), bucket_key("e2e")
+
+        be.compute_registry.resolve = fake_resolve
+        try:
+            barrier = threading.Barrier(2)
+
+            def submit(tenant):
+                decision = ctl.decide(tenant)
+                with ctl.in_flight(decision):
+                    barrier.wait(timeout=5)
+                    ex.suggest(FakeDesigner(), 1)
+
+            threads = [
+                threading.Thread(target=submit, args=(t,))
+                for t in ("a", "b")
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            # The flush ran; DRR billed both tenants.
+            with ex._cond:
+                seen = dict(ex._tenant_served)
+        finally:
+            be.compute_registry.resolve = original
+            ex.close()
+        assert set(seen) == {"a", "b"}
+
+    def test_no_admission_no_tenant_lookup(self):
+        ex = be.BatchExecutor(max_batch_size=4)
+        with adm.tenant_scope("ambient"):
+            s = slot()
+        assert s.tenant is None  # _Slot default; suggest() skips the read
+        ex.close()

@@ -1,0 +1,481 @@
+"""Multi-tenant overload protection: admission, shedding, degradation.
+
+Pins the PR's hard guarantees: a shed is typed ``TRANSIENT:
+RESOURCE_EXHAUSTED`` with a retry-after hint the retry layer honors, a
+shed NEVER counts against the study's circuit breaker and never reaches a
+designer, degraded mode serves stamped quasi-random to low-priority
+tenants only, expired-deadline requests never reach a designer
+computation, and ``VIZIER_ADMISSION=0`` builds no controller at all (the
+bit-identical pre-admission path).
+"""
+
+import sys
+import unittest.mock
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from reliability import harness  # noqa: E402
+
+from vizier_tpu import pyvizier as vz
+from vizier_tpu.pythia import policy as policy_lib
+from vizier_tpu.reliability import breaker as breaker_lib
+from vizier_tpu.reliability import errors as errors_lib
+from vizier_tpu.reliability import fallback as fallback_lib
+from vizier_tpu.reliability import retry as retry_lib
+from vizier_tpu.serving import admission as adm
+from vizier_tpu.serving import runtime as runtime_lib
+from vizier_tpu.service import proto_converters as pc
+from vizier_tpu.service import pythia_service, vizier_service
+from vizier_tpu.service.protos import vizier_service_pb2
+
+
+class CountingPolicyFactory:
+    """Counts designer computations; the no-compute assertions' probe."""
+
+    def __init__(self):
+        self.computations = 0
+
+    def __call__(self, problem, algorithm, supporter, study_name):
+        outer = self
+
+        class _P(policy_lib.Policy):
+            def suggest(self, request):
+                outer.computations += 1
+                return policy_lib.SuggestDecision(
+                    suggestions=[
+                        vz.TrialSuggestion(parameters={"x": 0.5, "y": 0.0})
+                        for _ in range(request.count)
+                    ]
+                )
+
+        return _P()
+
+
+def make_admission_stack(admission_config, factory=None):
+    factory = factory or CountingPolicyFactory()
+    servicer = vizier_service.VizierServicer()
+    pythia = pythia_service.PythiaServicer(
+        servicer, factory, admission_config=admission_config
+    )
+    servicer.set_pythia(pythia)
+    servicer.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(
+            parent="owners/o",
+            study=pc.study_to_proto(harness.study_config(), harness.STUDY),
+        )
+    )
+    return servicer, pythia, factory
+
+
+def suggest_op(servicer, client_id="c1", deadline_secs=0.0):
+    return servicer.SuggestTrials(
+        vizier_service_pb2.SuggestTrialsRequest(
+            parent=harness.STUDY,
+            suggestion_count=1,
+            client_id=client_id,
+            deadline_secs=deadline_secs,
+        )
+    )
+
+
+class TestConfig:
+    def test_off_by_default(self):
+        assert not adm.AdmissionConfig.from_env().enabled
+        runtime = runtime_lib.ServingRuntime()
+        assert runtime.admission is None
+        assert runtime.admission_snapshot() == {"enabled": False}
+        runtime.shutdown()
+
+    def test_env_arming(self):
+        with unittest.mock.patch.dict(
+            "os.environ",
+            {
+                "VIZIER_ADMISSION": "1",
+                "VIZIER_ADMISSION_MAX_INFLIGHT": "5",
+                "VIZIER_ADMISSION_WEIGHTS": "prod:8,dev:0.5,junk,bad:x",
+            },
+        ):
+            config = adm.AdmissionConfig.from_env()
+        assert config.enabled
+        assert config.max_inflight == 5
+        assert config.weight("prod") == 8.0
+        assert config.weight("dev") == 0.5
+        assert config.weight("unlisted") == 1.0
+        assert config.low_priority("dev")
+        assert not config.low_priority("prod")
+
+    def test_tenant_of(self):
+        assert adm.tenant_of("owners/prod/studies/s") == "prod"
+        assert adm.tenant_of("owners/a/studies/s/trials/3") == "a"
+        assert adm.tenant_of("not-a-resource") == adm.DEFAULT_TENANT
+        assert adm.tenant_of("owners/") == adm.DEFAULT_TENANT
+
+
+class TestController:
+    def _controller(self, **kw):
+        self.clock = [0.0]
+        defaults = dict(
+            enabled=True, max_inflight=4, tenant_inflight=2, window_s=5.0
+        )
+        defaults.update(kw)
+        config = adm.AdmissionConfig(**defaults)
+        return adm.AdmissionController(
+            config, time_fn=lambda: self.clock[0]
+        )
+
+    def test_tenant_and_total_bounds(self):
+        ctl = self._controller()
+        held = [ctl.decide("a"), ctl.decide("a")]
+        shed = ctl.decide("a")
+        assert shed.outcome == adm.SHED
+        assert shed.reason == adm.REASON_TENANT
+        held.append(ctl.decide("b"))
+        held.append(ctl.decide("b"))
+        total = ctl.decide("c")
+        assert total.outcome == adm.SHED
+        assert total.reason == adm.REASON_TOTAL
+        for d in held:
+            ctl.release(d)
+        assert ctl.inflight() == {}
+        assert ctl.decide("c").admitted
+
+    def test_shed_error_is_typed_with_retry_after(self):
+        ctl = self._controller(retry_after_ms=125.0)
+        hold = ctl.decide("a")
+        hold2 = ctl.decide("a")
+        shed = ctl.decide("a")
+        err = shed.error()
+        assert errors_lib.is_transient_exception(err)
+        assert errors_lib.is_resource_exhausted(str(err))
+        assert errors_lib.retry_after_secs(err) == pytest.approx(0.125)
+        # The marker survives the op-error stringification round trip.
+        text = errors_lib.format_op_error(err)
+        assert errors_lib.has_transient_marker(text)
+        assert errors_lib.retry_after_secs(text) == pytest.approx(0.125)
+        ctl.release(hold)
+        ctl.release(hold2)
+
+    def test_deadline_infeasible_shed(self):
+        config = adm.AdmissionConfig(
+            enabled=True, max_inflight=8, tenant_inflight=8
+        )
+        ctl = adm.AdmissionController(
+            config,
+            compute_p50_fn=lambda: 2.0,  # 2 s computes
+            queue_depth_fn=lambda: 16,  # 2 flushes queued ahead
+        )
+        # Estimate = 2s * (1 + 16/8) = 6s > 1s remaining -> shed.
+        shed = ctl.decide("a", deadline_secs=1.0)
+        assert shed.outcome == adm.SHED
+        assert shed.reason == adm.REASON_DEADLINE
+        # Plenty of budget -> admit.
+        ok = ctl.decide("a", deadline_secs=30.0)
+        assert ok.admitted
+        ctl.release(ok)
+        # No deadline on the wire -> never deadline-shed.
+        ok2 = ctl.decide("a", deadline_secs=0.0)
+        assert ok2.admitted
+        ctl.release(ok2)
+
+    def test_deadline_shed_disabled_without_latency_data(self):
+        ctl = adm.AdmissionController(
+            adm.AdmissionConfig(enabled=True),
+            compute_p50_fn=lambda: None,
+            queue_depth_fn=lambda: 1000,
+        )
+        decision = ctl.decide("a", deadline_secs=0.001)
+        assert decision.admitted  # conservative: no data, no deadline shed
+        ctl.release(decision)
+
+    def test_state_machine_escalates_and_recovers_hysteretically(self):
+        ctl = self._controller(
+            max_inflight=2,
+            tenant_inflight=1,
+            weights=(("low", 0.5),),
+            degrade_rate=0.5,
+            recover_rate=0.1,
+            min_decisions=4,
+            window_s=5.0,
+        )
+        assert ctl.state == adm.HEALTHY
+        hold = ctl.decide("low")
+        assert ctl.decide("low").outcome == adm.SHED
+        assert ctl.state == adm.SHEDDING
+        for _ in range(10):
+            ctl.decide("low")
+        assert ctl.state == adm.DEGRADED
+        # Low-priority tenant degrades, default-weight tenant computes.
+        assert ctl.decide("low").outcome == adm.DEGRADE
+        other = ctl.decide("other")
+        assert other.admitted
+        ctl.release(other)
+        ctl.release(hold)
+        # Recovery needs a FULL calm window: not immediately...
+        self.clock[0] += 2.0
+        d = ctl.decide("other")
+        ctl.release(d)
+        assert ctl.state == adm.DEGRADED
+        # ... but after window_s of calm it steps down one level at a time.
+        self.clock[0] += 6.0
+        d = ctl.decide("other")
+        ctl.release(d)
+        assert ctl.state == adm.SHEDDING
+        self.clock[0] += 6.0
+        d = ctl.decide("other")
+        ctl.release(d)
+        assert ctl.state == adm.HEALTHY
+        transitions = ctl.snapshot()["transitions"]
+        assert [t["to"] for t in transitions] == [
+            adm.SHEDDING, adm.DEGRADED, adm.SHEDDING, adm.HEALTHY,
+        ]
+
+    def test_snapshot_accounting(self):
+        ctl = self._controller()
+        a = ctl.decide("a")
+        b = ctl.decide("b")
+        hold = ctl.decide("a")
+        ctl.decide("a")  # tenant shed
+        snap = ctl.snapshot()
+        assert snap["state"] == adm.SHEDDING
+        assert snap["inflight"] == {"a": 2, "b": 1}
+        assert snap["admits_by_tenant"] == {"a": 2, "b": 1}
+        assert snap["sheds_by_tenant"] == {"a": {adm.REASON_TENANT: 1}}
+        assert snap["total_sheds"] == 1
+        for d in (a, b, hold):
+            ctl.release(d)
+
+    def test_in_flight_scope_sets_tenant_contextvar(self):
+        ctl = self._controller()
+        decision = ctl.decide("a")
+        assert adm.current_tenant() is None
+        with ctl.in_flight(decision):
+            assert adm.current_tenant() == "a"
+        assert adm.current_tenant() is None
+        assert ctl.inflight() == {}
+
+
+class TestPythiaBoundary:
+    def test_shed_is_typed_and_never_trips_breaker(self):
+        config = adm.AdmissionConfig(
+            enabled=True, max_inflight=1, tenant_inflight=1
+        )
+        servicer, pythia, factory = make_admission_stack(config)
+        runtime = pythia.serving_runtime
+        hold = runtime.admission.decide("o")
+        assert hold.admitted
+        for _ in range(5):
+            op = suggest_op(servicer)
+            assert op.done
+            assert "RESOURCE_EXHAUSTED" in op.error
+            assert errors_lib.has_transient_marker(op.error)
+            assert errors_lib.retry_after_secs(op.error) is not None
+        # No designer ran, no breaker state moved, no fallback stamped.
+        assert factory.computations == 0
+        snap = runtime.snapshot()
+        assert snap["admission_sheds"] == 5
+        assert snap["designer_failures"] == 0
+        assert snap["breaker_open_transitions"] == 0
+        assert snap["breaker_short_circuits"] == 0
+        assert snap["fallbacks"] == 0
+        assert runtime.breakers.get(harness.STUDY).state == breaker_lib.CLOSED
+        runtime.admission.release(hold)
+        op = suggest_op(servicer)
+        assert not op.error
+        assert factory.computations == 1
+        pythia.shutdown()
+
+    def test_degraded_serves_stamped_quasi_random_to_low_priority_only(self):
+        config = adm.AdmissionConfig(
+            enabled=True,
+            max_inflight=4,
+            tenant_inflight=4,
+            weights=(("o", 0.5),),
+            degraded_floor=1.0,
+            min_decisions=2,
+            degrade_rate=0.3,
+        )
+        servicer, pythia, factory = make_admission_stack(config)
+        ctl = pythia.serving_runtime.admission
+        holds = [ctl.decide("x") for _ in range(4)]
+        for _ in range(10):
+            ctl.decide("x")
+        assert ctl.state == adm.DEGRADED
+        op = suggest_op(servicer)
+        assert not op.error
+        assert factory.computations == 0  # no GP compute burned
+        trial = pc.trial_from_proto(op.response.trials[0])
+        assert fallback_lib.is_fallback_suggestion(trial.metadata)
+        assert (
+            trial.metadata.ns(adm.ADMISSION_NAMESPACE).get(adm.ADMISSION_KEY)
+            == adm.ADMISSION_VALUE
+        )
+        snap = pythia.serving_runtime.snapshot()
+        assert snap["admission_degraded"] == 1
+        for h in holds:
+            ctl.release(h)
+        pythia.shutdown()
+
+    def test_admission_off_builds_no_controller(self):
+        servicer, pythia, factory = make_admission_stack(
+            adm.AdmissionConfig.disabled()
+        )
+        assert pythia.serving_runtime.admission is None
+        op = suggest_op(servicer)
+        assert not op.error
+        assert factory.computations == 1
+        snap = pythia.serving_runtime.snapshot()
+        assert snap["admission_sheds"] == 0
+        pythia.shutdown()
+
+
+class TestExpiredDeadline:
+    def test_ingress_short_circuit_no_compute(self):
+        factory = CountingPolicyFactory()
+        servicer, pythia, _ = harness.make_stack(factory)
+        op = suggest_op(servicer, deadline_secs=-2.0)
+        assert op.done
+        assert "DEADLINE_EXCEEDED" in op.error
+        assert errors_lib.has_transient_marker(op.error)
+        assert factory.computations == 0
+        # Nothing persisted: the synthetic op is not in the datastore and
+        # consumed no operation number.
+        assert not servicer.datastore.list_suggestion_operations(
+            harness.STUDY, "c1"
+        )
+        stats = pythia.serving_stats()
+        assert stats["deadline_exceeded"] == 1
+        pythia.shutdown()
+
+    def test_pythia_expired_wire_budget_never_reaches_designer(self):
+        from vizier_tpu.service.protos import pythia_service_pb2
+
+        factory = CountingPolicyFactory()
+        servicer, pythia, _ = harness.make_stack(factory)
+        study = servicer.GetStudy(
+            vizier_service_pb2.GetStudyRequest(name=harness.STUDY)
+        )
+        preq = pythia_service_pb2.PythiaSuggestRequest(
+            count=1,
+            algorithm=study.study_spec.algorithm,
+            study_name=harness.STUDY,
+            deadline_secs=-0.25,
+        )
+        preq.study_descriptor.config.CopyFrom(study.study_spec)
+        preq.study_descriptor.guid = harness.STUDY
+        response = pythia.Suggest(preq)
+        assert "DEADLINE_EXCEEDED" in response.error
+        assert factory.computations == 0
+        pythia.shutdown()
+
+    def test_client_sends_expired_marker_when_budget_gone(self):
+        captured = {}
+
+        class CapturingStub:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                method = getattr(self._inner, name)
+                if name == "SuggestTrials":
+                    def wrapped(request):
+                        captured["deadline_secs"] = request.deadline_secs
+                        return method(request)
+
+                    return wrapped
+                return method
+
+        factory = CountingPolicyFactory()
+        servicer, pythia, _ = harness.make_stack(factory)
+        from vizier_tpu.service import vizier_client as client_lib
+
+        client = client_lib.VizierClient(
+            CapturingStub(servicer), harness.STUDY, "c1"
+        )
+        with pytest.raises(RuntimeError, match="DEADLINE_EXCEEDED"):
+            client.get_suggestions(1, deadline_secs=-1.0)
+        assert captured["deadline_secs"] < 0
+        assert factory.computations == 0
+        pythia.shutdown()
+
+
+class TestRetryAfterHonored:
+    def test_retry_policy_floors_backoff_at_hint(self):
+        slept = []
+        policy = retry_lib.RetryPolicy(
+            max_attempts=3,
+            base_delay_secs=1e-4,
+            max_delay_secs=2e-4,
+            sleep_fn=slept.append,
+        )
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise adm.shed_error("t", adm.REASON_TOTAL, 150.0)
+            return "served"
+
+        assert policy.call(flaky) == "served"
+        assert len(slept) == 2
+        assert all(delay >= 0.15 for delay in slept)
+
+    def test_plain_transient_keeps_jittered_schedule(self):
+        slept = []
+        policy = retry_lib.RetryPolicy(
+            max_attempts=2,
+            base_delay_secs=1e-4,
+            max_delay_secs=2e-4,
+            sleep_fn=slept.append,
+        )
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise errors_lib.TransientError("TRANSIENT: plain")
+            return "ok"
+
+        assert policy.call(flaky) == "ok"
+        assert slept and slept[0] <= 2e-4
+
+    def test_client_shed_retries_do_not_burn_attempt_budget(self):
+        """A shed with retry-after is backpressure: the client keeps
+        honoring the pacing hint past its fixed attempts and succeeds once
+        the gate reopens."""
+        from vizier_tpu.reliability import config as rcfg
+        from vizier_tpu.service import vizier_client as client_lib
+
+        config = adm.AdmissionConfig(
+            enabled=True, max_inflight=1, tenant_inflight=1,
+            retry_after_ms=1.0,
+        )
+        servicer, pythia, factory = make_admission_stack(config)
+        ctl = pythia.serving_runtime.admission
+        hold = ctl.decide("o")
+        releases = {"left": 8}
+        original = ctl.decide
+
+        def releasing_decide(*args, **kwargs):
+            # Reopen the gate only after MORE sheds than the client's
+            # fixed attempt budget (3) would survive.
+            if releases["left"] > 0:
+                releases["left"] -= 1
+                if releases["left"] == 0:
+                    ctl.release(hold)
+            return original(*args, **kwargs)
+
+        ctl.decide = releasing_decide
+        client = client_lib.VizierClient(
+            servicer, harness.STUDY, "c1",
+            reliability=rcfg.ReliabilityConfig(
+                retry_max_attempts=3,
+                retry_base_delay_secs=1e-4,
+                retry_max_delay_secs=1e-3,
+            ),
+        )
+        trials = client.get_suggestions(1)
+        assert len(trials) == 1
+        assert factory.computations == 1
+        pythia.shutdown()

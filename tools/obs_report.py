@@ -366,6 +366,29 @@ def soak_activity(report: dict) -> dict:
             "p50_ms": latency.get("p50_ms", 0.0),
             "p99_ms": latency.get("p99_ms", 0.0),
         }
+    # Per-tenant table (report v2): the fairness view next to the
+    # per-kind one — sheds/degraded serves are the admission plane's.
+    out["by_tenant"] = {}
+    tenants = (report.get("outcomes") or {}).get("by_tenant") or {}
+    for tenant, row in sorted(tenants.items()):
+        latency = row.get("latency") or {}
+        out["by_tenant"][tenant] = {
+            "studies": row.get("studies", 0),
+            "suggests": row.get("suggests", 0),
+            "errors": row.get("errors", 0),
+            "sheds": row.get("sheds", 0),
+            "degraded": row.get("degraded", 0),
+            "p50_ms": latency.get("p50_ms", 0.0),
+            "p99_ms": latency.get("p99_ms", 0.0),
+        }
+    admission = report.get("admission") or {}
+    out["admission"] = {
+        "armed": bool(admission.get("armed")),
+        "shed_rate": admission.get("shed_rate", 0.0),
+        "sheds": admission.get("sheds", 0),
+        "degraded_serves": admission.get("degraded_serves", 0),
+        "state": (admission.get("snapshot") or {}).get("state"),
+    }
     slo = report.get("slo") or {}
     out["slo_breaching"] = sorted(slo.get("breaching", []))
     out["slo_armed"] = bool(slo.get("armed"))
@@ -426,6 +449,27 @@ def render_soak(soak: dict) -> str:
                 f"{row['hit_rate']:>8.3f} {row['p50_ms']:>9.2f} "
                 f"{row['p99_ms']:>9.2f}"
             )
+    by_tenant = soak.get("by_tenant") or {}
+    if by_tenant:
+        lines.append(
+            f"  {'tenant':<20} {'studies':>7} {'suggests':>8} {'err':>4} "
+            f"{'sheds':>6} {'degr':>5} {'p50 ms':>9} {'p99 ms':>9}"
+        )
+        for tenant, row in sorted(by_tenant.items()):
+            lines.append(
+                f"  {tenant:<20} {row['studies']:>7d} {row['suggests']:>8d} "
+                f"{row['errors']:>4d} {row['sheds']:>6d} "
+                f"{row['degraded']:>5d} {row['p50_ms']:>9.2f} "
+                f"{row['p99_ms']:>9.2f}"
+            )
+    admission = soak.get("admission") or {}
+    if admission.get("armed"):
+        lines.append(
+            f"  admission: state {admission.get('state')}, shed rate "
+            f"{admission.get('shed_rate', 0.0)} "
+            f"({admission.get('sheds', 0)} sheds, "
+            f"{admission.get('degraded_serves', 0)} degraded serves)"
+        )
     if soak.get("slo_armed"):
         breaching = soak.get("slo_breaching") or []
         lines.append(

@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Hot-tenant overload A/B: admission plane ON vs OFF → OVERLOAD_AB.json.
+
+Drives the loadgen ``hot_tenant`` scenario — one Zipf-head tenant floods
+the fleet with GP compute at a saturating OPEN-LOOP rate (``time_scale=1``,
+real arrival pacing: studies arrive whether or not the fleet keeps up)
+while three light tenants run occasional GP studies — through the REAL
+serving stack twice:
+
+- **ON** — ``VIZIER_ADMISSION=1``: per-tenant in-flight caps, weighted
+  deficit-round-robin flush selection, deadline-aware shedding, and the
+  healthy→shedding→degraded state machine (the hot tenant's sub-floor
+  weight routes it to stamped quasi-random under sustained saturation);
+- **OFF** — the identical workload with the plane gated off: FIFO
+  everything, no caps — the collapse arm.
+
+Assertions (exit nonzero on any failure):
+
+- ON: zero lost/errored studies; light tenants' suggest p99 within the
+  scenario's SLO budget; sheds NONZERO and confined to the hot tenant;
+  sheds never trip a circuit breaker (breaker transition counters stay 0).
+- OFF: the light tenants' p99 collapses past the SLO budget (the damage
+  the plane exists to prevent).
+- ``VIZIER_ADMISSION=0`` bit-identity: the gated-off engine arm replays
+  the parity cohort trajectory-identical to the sequential reference —
+  the off switch is the pre-admission tree.
+
+Usage:
+    python tools/overload_ab.py                # full A/B -> OVERLOAD_AB.json
+    python tools/overload_ab.py --studies 16 --budget-ms 1500
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import pathlib
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("VIZIER_DISABLE_MESH", "1")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from vizier_tpu.loadgen import driver as driver_lib  # noqa: E402
+from vizier_tpu.loadgen import models  # noqa: E402
+from vizier_tpu.loadgen import report as report_lib  # noqa: E402
+
+LIGHT = ("light-a", "light-b", "light-c")
+
+
+def _suggest_latencies_ms(result, tenants):
+    return sorted(
+        r.latency_s * 1e3
+        for r in result.records
+        if r.op == "suggest" and r.error is None and r.tenant in tenants
+    )
+
+
+def _p99_ms(values):
+    if not values:
+        return 0.0
+    rank = 0.99 * (len(values) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(values) - 1)
+    frac = rank - lo
+    return round(values[lo] * (1 - frac) + values[hi] * frac, 3)
+
+
+def _arm_summary(result, config):
+    outcomes = report_lib._outcome_tables(result)
+    light = _suggest_latencies_ms(result, set(LIGHT))
+    hot = _suggest_latencies_ms(result, {"hot"})
+    stats = {
+        k: v
+        for k, v in sorted(result.serving_stats.items())
+        if isinstance(v, int) and v
+    }
+    return {
+        "wall_s": result.wall_s,
+        "lost_studies": result.lost_studies(),
+        "errored_studies": result.errored_studies(),
+        "light_suggest_p99_ms": _p99_ms(light),
+        "light_suggests": len(light),
+        "hot_suggest_p99_ms": _p99_ms(hot),
+        "hot_suggests": len(hot),
+        "by_tenant": outcomes["by_tenant"],
+        "admission": result.admission,
+        "open_loop_capped": result.open_loop_capped,
+        "breaker_transitions": stats.get("breaker_open_transitions", 0),
+        "serving_stats": stats,
+        "slo_breaching": sorted(result.slo.get("breaching", []))
+        if result.slo.get("armed")
+        else [],
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--studies", type=int, default=0,
+                        help="override the scenario study count")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--budget-ms", type=float, default=0.0,
+                        help="override the light-tenant p99 SLO budget")
+    parser.add_argument(
+        "--out",
+        default=str(
+            pathlib.Path(__file__).resolve().parent.parent
+            / "OVERLOAD_AB.json"
+        ),
+    )
+    args = parser.parse_args()
+
+    from vizier_tpu.service import vizier_client
+
+    vizier_client.environment_variables.polling_delay_secs = 0.005
+
+    overrides = {"seed": args.seed}
+    if args.studies:
+        overrides["num_studies"] = args.studies
+    if args.budget_ms:
+        overrides["p99_budget_ms"] = args.budget_ms
+    config = models.hot_tenant_config(**overrides)
+    scenario = models.build_scenario(config)
+    budget_ms = config.p99_budget_ms
+    print(
+        f"[overload_ab] hot_tenant scenario: {len(scenario.studies)} studies "
+        f"/ {scenario.total_trials} trials, open-loop time_scale="
+        f"{config.time_scale}, light-p99 budget {budget_ms} ms",
+        flush=True,
+    )
+
+    t0 = time.time()
+    # Warmup arm (unmeasured): the same workload once, closed-loop, to
+    # pay every XLA compile the padding-bucket grid needs — jit caches
+    # are process-wide, so the measured arms then compare pure serving
+    # behavior, not who compiled first.
+    warm_config = dataclasses.replace(
+        config,
+        time_scale=0.0,
+        planes=dataclasses.replace(config.planes, admission=False, slo=False),
+    )
+    warm = driver_lib.run(models.build_scenario(warm_config), arm="warmup")
+    print(f"[overload_ab] warmup arm done in {warm.wall_s}s", flush=True)
+
+    on = driver_lib.run(scenario, arm="admission_on")
+    print(f"[overload_ab] ON arm done in {on.wall_s}s", flush=True)
+
+    off_config = dataclasses.replace(
+        config,
+        planes=dataclasses.replace(config.planes, admission=False),
+    )
+    off_scenario = models.build_scenario(off_config)
+    off = driver_lib.run(off_scenario, arm="admission_off")
+    print(f"[overload_ab] OFF arm done in {off.wall_s}s", flush=True)
+
+    # VIZIER_ADMISSION=0 bit-identity vs HEAD: the gated-off engine arm
+    # must replay the cohort exactly as the sequential reference does —
+    # the off switch leaves the pre-admission tree untouched.
+    reference = driver_lib.run_reference(scenario)
+    gated = driver_lib.run_gated_off(scenario)
+    bit = report_lib._bit_identity_section(gated, reference)
+    print(
+        f"[overload_ab] bit-identity cohort: {bit['studies_compared']} "
+        f"studies, identical={bit['identical']}",
+        flush=True,
+    )
+
+    on_summary = _arm_summary(on, config)
+    off_summary = _arm_summary(off, off_config)
+    on_sheds = (on.admission or {}).get("sheds_by_tenant", {})
+    shed_tenants = sorted(t for t, r in on_sheds.items() if sum(r.values()))
+    total_sheds = sum(sum(r.values()) for r in on_sheds.values())
+
+    assertions = []
+
+    def check(name, ok, detail):
+        assertions.append({"name": name, "ok": bool(ok), "detail": detail})
+
+    check(
+        "on_zero_lost_studies",
+        not on_summary["lost_studies"] and not on_summary["errored_studies"],
+        f"lost={on_summary['lost_studies']} "
+        f"errored={on_summary['errored_studies']}",
+    )
+    check(
+        "on_light_p99_within_slo",
+        0 < on_summary["light_suggest_p99_ms"] <= budget_ms,
+        f"light p99 {on_summary['light_suggest_p99_ms']} ms "
+        f"(budget {budget_ms} ms, {on_summary['light_suggests']} suggests)",
+    )
+    check(
+        "on_sheds_nonzero_confined_to_hot",
+        total_sheds > 0 and shed_tenants == ["hot"],
+        f"sheds={total_sheds} by tenant {on_sheds}",
+    )
+    check(
+        "on_sheds_never_trip_breaker",
+        on_summary["breaker_transitions"] == 0,
+        f"breaker_open_transitions={on_summary['breaker_transitions']} "
+        f"with {total_sheds} sheds",
+    )
+    check(
+        "off_light_p99_collapses",
+        off_summary["light_suggest_p99_ms"] > budget_ms,
+        f"light p99 {off_summary['light_suggest_p99_ms']} ms OFF vs "
+        f"{on_summary['light_suggest_p99_ms']} ms ON (budget {budget_ms})",
+    )
+    check(
+        "admission_off_bit_identical",
+        bit["identical"],
+        f"compared={bit['studies_compared']} mismatched={bit['mismatched']}",
+    )
+
+    ratio = (
+        round(
+            off_summary["light_suggest_p99_ms"]
+            / on_summary["light_suggest_p99_ms"],
+            2,
+        )
+        if on_summary["light_suggest_p99_ms"]
+        else None
+    )
+    report = {
+        "version": 1,
+        "what": (
+            "hot-tenant overload A/B: saturating open-loop loadgen "
+            "scenario through the real serving stack, admission plane "
+            "ON vs OFF; light-tenant p99 + zero lost studies + sheds "
+            "confined to the hot tenant with the plane ON, collapse "
+            "with it OFF, VIZIER_ADMISSION=0 bit-identical to HEAD"
+        ),
+        "scenario": {
+            "config": config.as_dict(),
+            "fingerprint": on.scenario_fingerprint,
+        },
+        "slo_budget_ms": budget_ms,
+        "light_p99_off_over_on": ratio,
+        "arms": {"admission_on": on_summary, "admission_off": off_summary},
+        "bit_identity": bit,
+        "assertions": assertions,
+        "ok": all(a["ok"] for a in assertions),
+        "wall_seconds_total": round(time.time() - t0, 1),
+    }
+    out_path = pathlib.Path(args.out)
+    out_path.write_text(json.dumps(report, indent=2) + "\n")
+    for a in assertions:
+        print(f"  [{'ok' if a['ok'] else 'FAIL'}] {a['name']}: {a['detail']}")
+    print(f"[overload_ab] wrote {out_path} (ok={report['ok']})")
+    if not report["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,17 @@ echo "== 3b3. SLO-armed observability soak (~2 min) =="
 JAX_PLATFORMS=cpu python tools/chaos_ab.py --trials 50 --slo-soak \
   --out /tmp/chaos_slo.json
 
+echo "== 3b5. hot-tenant overload A/B (~3 min) =="
+#    -> OVERLOAD_AB.json: the loadgen hot_tenant scenario (one Zipf-head
+#    tenant flooding GP compute at a saturating OPEN-LOOP rate,
+#    time_scale=1 real arrival pacing) with the admission plane ON vs
+#    OFF; asserts light-tenant suggest p99 within the SLO budget + zero
+#    lost studies + sheds nonzero and confined to the hot tenant + sheds
+#    never trip a breaker with the plane ON, the p99 collapse with it
+#    OFF, and VIZIER_ADMISSION=0 bit-identity vs the sequential
+#    reference (docs/guides/reliability.md "Overload protection")
+JAX_PLATFORMS=cpu python tools/overload_ab.py
+
 echo "== 3b4. full-stack loadgen soak (slow arm, ~20 min) =="
 #    -> SOAK_REPORT.json: >=1000 Zipf-sized studies across every
 #    registered program kind on a 2-replica WAL-backed tier, speculation

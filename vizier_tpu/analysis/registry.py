@@ -99,6 +99,9 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             "only when the window saw speculative traffic).", "0.8"),
     _switch("VIZIER_SLO_FALLBACK_RATE", "float", "SloConfig", _OBS_DOC,
             "Objective: maximum quasi-random fallback fraction.", "0.05"),
+    _switch("VIZIER_SLO_SHED_RATE", "float", "SloConfig", _OBS_DOC,
+            "Objective: maximum admission-shed fraction of suggests.",
+            "0.05"),
     _switch("VIZIER_SLO_DUMP_DIR", "str", "SloConfig", _OBS_DOC,
             "Black-box dump directory for SLO breaches ('' = no dumps)."),
     # -- flight recorder (FlightRecorderConfig) ----------------------------
@@ -125,6 +128,46 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             _REL_DOC, "Per-study circuit breaker.", "1"),
     _switch("VIZIER_RELIABILITY_FALLBACK", "flag", "ReliabilityConfig",
             _REL_DOC, "Quasi-random fallback on designer failure.", "1"),
+    # -- multi-tenant admission (serving.admission.AdmissionConfig) --------
+    _switch("VIZIER_ADMISSION", "flag", "AdmissionConfig", _REL_DOC,
+            "Multi-tenant overload protection: fair-share admission, "
+            "load shedding, deadline-aware rejection, graceful "
+            "degradation (opt-in; unset/0 = the bit-identical "
+            "pre-admission path).", "0"),
+    _switch("VIZIER_ADMISSION_MAX_INFLIGHT", "int", "AdmissionConfig",
+            _REL_DOC,
+            "Fleet-wide cap on concurrent designer computations.", "16"),
+    _switch("VIZIER_ADMISSION_TENANT_INFLIGHT", "int", "AdmissionConfig",
+            _REL_DOC,
+            "Per-tenant cap on concurrent designer computations.", "8"),
+    _switch("VIZIER_ADMISSION_WEIGHTS", "str", "AdmissionConfig", _REL_DOC,
+            "Fair-share weights, 'tenant:w,...' (unlisted tenants = 1.0); "
+            "drives the DRR quantum and the degraded-mode priority split."),
+    _switch("VIZIER_ADMISSION_RETRY_AFTER_MS", "float", "AdmissionConfig",
+            _REL_DOC,
+            "Backoff-floor hint stamped into shed errors.", "50"),
+    _switch("VIZIER_ADMISSION_DEADLINE", "flag", "AdmissionConfig", _REL_DOC,
+            "Deadline-aware rejection: shed when the remaining budget "
+            "cannot cover estimated queue wait + compute p50.", "1"),
+    _switch("VIZIER_ADMISSION_DEGRADED", "flag", "AdmissionConfig", _REL_DOC,
+            "Graceful degradation under sustained saturation (the "
+            "healthy/shedding/degraded state machine's last stage).", "1"),
+    _switch("VIZIER_ADMISSION_DEGRADED_FLOOR", "float", "AdmissionConfig",
+            _REL_DOC,
+            "Tenants with weight below this serve quasi-random in "
+            "degraded mode; others keep GP compute.", "1.0"),
+    _switch("VIZIER_ADMISSION_DEGRADE_RATE", "float", "AdmissionConfig",
+            _REL_DOC,
+            "Windowed shed rate at which SHEDDING escalates to DEGRADED.",
+            "0.5"),
+    _switch("VIZIER_ADMISSION_RECOVER_RATE", "float", "AdmissionConfig",
+            _REL_DOC,
+            "Windowed shed rate below which DEGRADED may recover "
+            "(hysteretic: must hold for a full window).", "0.1"),
+    _switch("VIZIER_ADMISSION_WINDOW_S", "float", "AdmissionConfig",
+            _REL_DOC,
+            "Sliding decision window for the overload state machine.",
+            "5.0"),
     # -- serving (ServingConfig) -------------------------------------------
     _switch("VIZIER_SERVING_CACHE", "flag", "ServingConfig", _SRV_DOC,
             "Per-study designer-state cache.", "1"),

@@ -39,8 +39,10 @@ from vizier_tpu.loadgen import models
 from vizier_tpu.observability import flight_recorder as recorder_lib
 from vizier_tpu.observability import tracing as tracing_lib
 from vizier_tpu.reliability import config as reliability_config_lib
+from vizier_tpu.reliability import errors as errors_lib
 from vizier_tpu.reliability import fallback as fallback_lib
 from vizier_tpu.reliability import retry as retry_lib
+from vizier_tpu.serving import admission as admission_lib
 from vizier_tpu.serving import speculative as speculative_lib
 from vizier_tpu.service import proto_converters as pc
 from vizier_tpu.service import vizier_client
@@ -60,7 +62,19 @@ class RequestRecord:
     trace_id: Optional[str] = None
     speculative_hit: bool = False
     fallback: bool = False
+    # The admission plane served this request quasi-random (degraded-mode
+    # stamp in trial metadata).
+    degraded: bool = False
     error: Optional[str] = None
+
+    @property
+    def shed(self) -> bool:
+        """Client-visible shed: the request failed with the admission
+        plane's RESOURCE_EXHAUSTED marker after retries were exhausted
+        (absorbed sheds surface in the controller snapshot instead)."""
+        return self.error is not None and errors_lib.is_resource_exhausted(
+            self.error
+        )
 
     def as_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -103,6 +117,12 @@ class SoakResult:
     wall_s: float
     wal_root: Optional[str] = None
     recorder_event_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # The admission controller's snapshot (per-tenant sheds/admits,
+    # overload state, transitions); {"enabled": False} with the plane off.
+    admission: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # Open-loop releases delayed by the runaway client cap (0 = the run
+    # was truly open-loop end to end).
+    open_loop_capped: int = 0
 
     def lost_studies(self) -> List[int]:
         return sorted(i for i, o in self.outcomes.items() if o.lost)
@@ -136,6 +156,33 @@ def scenario_env(config: models.ScenarioConfig) -> Dict[str, str]:
         env["VIZIER_SLO_SUGGEST_P99_MS"] = str(config.p99_budget_ms)
     if planes.speculative:
         env["VIZIER_SPECULATIVE_WORKERS"] = "2"
+    env["VIZIER_ADMISSION"] = "1" if planes.admission else "0"
+    if planes.admission:
+        if config.admission_weights:
+            # The controller keys tenants by study OWNER id: map the
+            # scenario tenant names through the loadgen owner prefix.
+            env["VIZIER_ADMISSION_WEIGHTS"] = ",".join(
+                f"{models.tenant_owner(tenant)}:{weight:g}"
+                for tenant, weight in config.admission_weights
+            )
+        if config.admission_max_inflight:
+            env["VIZIER_ADMISSION_MAX_INFLIGHT"] = str(
+                config.admission_max_inflight
+            )
+        if config.admission_tenant_inflight:
+            env["VIZIER_ADMISSION_TENANT_INFLIGHT"] = str(
+                config.admission_tenant_inflight
+            )
+        if config.admission_degraded_floor:
+            env["VIZIER_ADMISSION_DEGRADED_FLOOR"] = str(
+                config.admission_degraded_floor
+            )
+        if config.admission_window_s:
+            env["VIZIER_ADMISSION_WINDOW_S"] = str(config.admission_window_s)
+        if config.admission_retry_after_ms:
+            env["VIZIER_ADMISSION_RETRY_AFTER_MS"] = str(
+                config.admission_retry_after_ms
+            )
     return env
 
 
@@ -628,6 +675,9 @@ class _Run:
         self.lock = threading.Lock()
         self.start = time.perf_counter()
         self.next_index = 0
+        # Open-loop releases that hit the runaway client cap (the report
+        # surfaces this: a capped run is no longer purely open-loop).
+        self.open_loop_capped = 0
 
     def record(self, row: RequestRecord) -> None:
         with self.lock:
@@ -647,17 +697,16 @@ class _Run:
         return total
 
     def pop_spec(self) -> Optional[models.StudySpec]:
+        """Closed-loop dispatch (``time_scale=0``): workers pull the next
+        study in arrival ORDER as soon as they free up. Real arrival
+        pacing (``time_scale>0``) runs through the open-loop pacer in
+        :func:`run` instead — a busy worker pool must not delay an
+        arrival."""
         with self.lock:
             if self.next_index >= len(self.scenario.studies):
                 return None
             spec = self.scenario.studies[self.next_index]
             self.next_index += 1
-        scale = self.scenario.config.time_scale
-        if scale > 0:
-            release = self.start + spec.arrival_s * scale
-            delay = release - time.perf_counter()
-            if delay > 0:
-                time.sleep(delay)
         return spec
 
 
@@ -731,6 +780,12 @@ def _run_study(run: _Run, spec: models.StudySpec, reliability) -> StudyOutcome:
                 latency = time.perf_counter() - t0
                 hit = _is_speculative_hit(trial.metadata)
                 fellback = fallback_lib.is_fallback_suggestion(trial.metadata)
+                degraded = (
+                    trial.metadata.ns(admission_lib.ADMISSION_NAMESPACE).get(
+                        admission_lib.ADMISSION_KEY
+                    )
+                    == admission_lib.ADMISSION_VALUE
+                )
                 run.record(
                     RequestRecord(
                         spec.index,
@@ -741,6 +796,7 @@ def _run_study(run: _Run, spec: models.StudySpec, reliability) -> StudyOutcome:
                         trace_id=trace_id,
                         speculative_hit=hit,
                         fallback=fellback,
+                        degraded=degraded,
                     )
                 )
                 run.recorder.record(
@@ -800,6 +856,25 @@ def _run_study(run: _Run, spec: models.StudySpec, reliability) -> StudyOutcome:
     return outcome
 
 
+def _normalize_admission(snapshot: Dict[str, object]) -> Dict[str, object]:
+    """Maps the controller's owner-keyed per-tenant dicts back to scenario
+    tenant names (``loadgen-hot`` → ``hot``) so report tables join."""
+    out = dict(snapshot)
+    for field in (
+        "inflight",
+        "admits_by_tenant",
+        "sheds_by_tenant",
+        "degraded_by_tenant",
+    ):
+        table = out.get(field)
+        if isinstance(table, dict):
+            out[field] = {
+                models.owner_tenant(owner): value
+                for owner, value in table.items()
+            }
+    return out
+
+
 def _verification_sweep(run: _Run, reliability) -> None:
     """Post-run completeness check: every study's trials must all be
     accounted for through the (possibly failed-over) serving tier."""
@@ -820,6 +895,44 @@ def _verification_sweep(run: _Run, reliability) -> None:
             outcome.listed_completed = -1
             if outcome.error is None:
                 outcome.error = f"verify: {type(e).__name__}: {e}"
+
+
+def _paced_release(run_state: "_Run", scenario, run_one, start) -> List[threading.Thread]:
+    """The open-loop pacer: sleeps to each study's scheduled arrival and
+    starts it on a fresh client thread. Returns the started threads.
+
+    The only backpressure is ``open_loop_max_clients`` — a pure runaway
+    cap (default 128): when it binds, the release blocks until a study
+    finishes, which is recorded in the run's event log so a saturated
+    report can't silently pass as open-loop.
+    """
+    config = scenario.config
+    cap = max(1, config.open_loop_max_clients)
+    slots = threading.Semaphore(cap)
+    threads: List[threading.Thread] = []
+    capped = 0
+
+    def paced(spec):
+        try:
+            run_one(spec)
+        finally:
+            slots.release()
+
+    for spec in scenario.studies:
+        release = start + spec.arrival_s * config.time_scale
+        delay = release - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        if not slots.acquire(blocking=False):
+            capped += 1
+            slots.acquire()
+        thread = threading.Thread(
+            target=paced, args=(spec,), name=f"loadgen-open-{spec.index}"
+        )
+        threads.append(thread)
+        thread.start()
+    run_state.open_loop_capped = capped
+    return threads
 
 
 def run(
@@ -861,22 +974,35 @@ def run(
         target = _build_target(scenario, reliability, factory)
         run_state = _Run(scenario, target, monkey, recorder)
 
+        def run_one(spec: models.StudySpec) -> None:
+            outcome = _run_study(run_state, spec, reliability)
+            with run_state.lock:
+                run_state.outcomes[spec.index] = outcome
+
         def worker():
             while True:
                 spec = run_state.pop_spec()
                 if spec is None:
                     return
-                outcome = _run_study(run_state, spec, reliability)
-                with run_state.lock:
-                    run_state.outcomes[spec.index] = outcome
+                run_one(spec)
 
-        threads = [
-            threading.Thread(target=worker, name=f"loadgen-client-{i}")
-            for i in range(max(1, config.concurrency))
-        ]
         start = time.perf_counter()
-        for t in threads:
-            t.start()
+        if config.time_scale > 0:
+            # OPEN LOOP: release each study at its scheduled arrival
+            # instant on its own client thread, whether or not the fleet
+            # is keeping up — a busy pool never delays an arrival, so
+            # suggest latency under saturation measures real queueing
+            # (the MLPerf-loadgen "server" shape). ``concurrency`` does
+            # not gate dispatch here; ``open_loop_max_clients`` is only a
+            # runaway safety cap.
+            threads = _paced_release(run_state, scenario, run_one, start)
+        else:
+            threads = [
+                threading.Thread(target=worker, name=f"loadgen-client-{i}")
+                for i in range(max(1, config.concurrency))
+            ]
+            for t in threads:
+                t.start()
         for t in threads:
             t.join()
         # Any events still pending at drain (trial volume fell short of a
@@ -903,6 +1029,10 @@ def run(
             wall_s=round(wall, 3),
             wal_root=target.wal_root,
             recorder_event_kinds=dict(sorted(recorder_kinds.items())),
+            admission=_normalize_admission(
+                target.runtime.admission_snapshot()
+            ),
+            open_loop_capped=run_state.open_loop_capped,
         )
     finally:
         if target is not None:

@@ -61,6 +61,25 @@ KIND_TO_ALGORITHM: Dict[str, str] = {
 GP_KINDS = ("gp_bandit", "gp_bandit_sparse", "gp_ucb_pe", "gp_ucb_pe_sparse")
 SPARSE_KINDS = ("gp_bandit_sparse", "gp_ucb_pe_sparse")
 
+# Study owner segment per scenario tenant: owners/loadgen-{tenant}/... —
+# ALSO the tenant id the admission plane sees (serving.admission.tenant_of
+# reads the owner segment), so the driver maps scenario tenant names
+# through this prefix when arming per-tenant weights and normalizes them
+# back in controller snapshots.
+TENANT_OWNER_PREFIX = "loadgen-"
+
+
+def tenant_owner(tenant: str) -> str:
+    return f"{TENANT_OWNER_PREFIX}{tenant}"
+
+
+def owner_tenant(owner: str) -> str:
+    """The scenario tenant for a study owner id (unknown owners pass
+    through unchanged)."""
+    if owner.startswith(TENANT_OWNER_PREFIX):
+        return owner[len(TENANT_OWNER_PREFIX):]
+    return owner
+
 _TARGETS = ("inprocess", "replicas")
 _EVENT_KINDS = (
     "kill_replica",
@@ -94,6 +113,10 @@ class PlaneConfig:
     mesh: bool = False
     slo: bool = True
     recorder: bool = True
+    # Multi-tenant overload protection (serving.admission): fair-share
+    # admission + shedding + degradation. Off by default — it is the
+    # plane the OVERLOAD_AB scenario A/Bs.
+    admission: bool = False
 
     @classmethod
     def all_on(cls) -> "PlaneConfig":
@@ -107,6 +130,7 @@ class PlaneConfig:
             mesh=False,
             slo=False,
             recorder=False,
+            admission=False,
         )
 
     def as_dict(self) -> Dict[str, bool]:
@@ -177,8 +201,15 @@ class ScenarioConfig:
     burst_fraction: float = 0.25  # fraction of each period spent bursting
     burst_period_s: float = 20.0
     # 0 = arrival ORDER only (as fast as the fleet can drain); 1 = real-
-    # time pacing; in between scales the schedule.
+    # time pacing; in between scales the schedule. With a nonzero scale
+    # the driver runs OPEN-LOOP: a dedicated pacer releases each study at
+    # its scheduled arrival instant on its own client thread, whether or
+    # not the fleet is keeping up (the MLPerf-loadgen "server" shape) —
+    # arrivals are never gated on a free worker.
     time_scale: float = 0.0
+    # Safety cap on concurrently-running open-loop studies; a release that
+    # would exceed it queues until one finishes (logged, not silent).
+    open_loop_max_clients: int = 128
     # -- study sizes (bounded Zipf) ---------------------------------------
     zipf_alpha: float = 1.1
     min_trials: int = 1
@@ -197,6 +228,12 @@ class ScenarioConfig:
         ("gp_ucb_pe", 1.0),
         ("gp_ucb_pe_sparse", 1.0),
     )
+    # Per-tenant kind-mix overrides ((tenant, kind_mix) pairs): studies of
+    # an overridden tenant redraw their kind from that tenant's own mix
+    # (seeded separately so the base expansion stream is undisturbed) —
+    # how the hot-tenant preset makes one tenant compute-heavy while the
+    # light tenants stay cheap.
+    tenant_kinds: Tuple[Tuple[str, Tuple[Tuple[str, float], ...]], ...] = ()
     # -- surrogate boundary (scenario-scoped VIZIER_SPARSE_* overrides) ----
     sparse_threshold: int = 8
     sparse_inducing: int = 8
@@ -216,6 +253,14 @@ class ScenarioConfig:
     # tracks come from VIZIER_LOADGEN_EVENTS / --events.
     events: Tuple[EventSpec, ...] = ()
     chaos_fault_prob: float = 0.1  # transport-fault rate inside windows
+    # -- admission plane (scenario-scoped VIZIER_ADMISSION* overrides) -----
+    # Applied only when ``planes.admission``; 0/empty = the switch default.
+    admission_weights: Tuple[Tuple[str, float], ...] = ()
+    admission_max_inflight: int = 0
+    admission_tenant_inflight: int = 0
+    admission_degraded_floor: float = 0.0
+    admission_window_s: float = 0.0
+    admission_retry_after_ms: float = 0.0
     # -- assertions --------------------------------------------------------
     parity_cohort: int = 8  # studies re-run on the sequential reference
     min_speculative_hits: int = 1
@@ -237,6 +282,8 @@ class ScenarioConfig:
         if not self.kind_mix:
             raise ValueError("kind_mix must not be empty.")
         unknown = [k for k, _ in self.kind_mix if k not in KIND_TO_ALGORITHM]
+        for _tenant, mix in self.tenant_kinds:
+            unknown.extend(k for k, _ in mix if k not in KIND_TO_ALGORITHM)
         if unknown:
             raise ValueError(
                 f"Unknown traffic kinds {unknown}; known kinds: "
@@ -568,6 +615,16 @@ def build_scenario(config: ScenarioConfig) -> Scenario:
     for offset, kind in enumerate(missing):
         kinds[count - 1 - offset] = kind
     tenants = [weighted_choice(rng, config.tenants) for _ in range(count)]
+    if config.tenant_kinds:
+        # Per-tenant kind overrides redraw from a DERIVED stream so the
+        # base expansion (budgets/kinds/tenants/arrivals/seeds) is
+        # byte-identical with the override absent.
+        override = {tenant: mix for tenant, mix in config.tenant_kinds}
+        kind_rng = random.Random((config.seed << 1) ^ 0x7E4A47)
+        for i in range(count):
+            mix = override.get(tenants[i])
+            if mix is not None:
+                kinds[i] = weighted_choice(kind_rng, mix)
     arrivals = arrival_times(rng, config, count)
     study_seeds = [rng.randrange(1 << 31) for _ in range(count)]
 
@@ -584,7 +641,7 @@ def build_scenario(config: ScenarioConfig) -> Scenario:
             # keeps them cheap and in one padding bucket.
             preseed = min(2, max(0, config.sparse_threshold - 1))
         name = (
-            f"owners/loadgen-{tenants[i]}/studies/"
+            f"owners/{tenant_owner(tenants[i])}/studies/"
             f"{config.name}-{i:05d}-{kind}"
         )
         studies.append(
@@ -652,6 +709,93 @@ def smoke_config(**overrides) -> ScenarioConfig:
         planes=PlaneConfig(
             batching=True, speculative=False, mesh=False, slo=True
         ),
+    )
+    values.update(overrides)
+    return ScenarioConfig(**values)
+
+
+def hot_tenant_config(**overrides) -> ScenarioConfig:
+    """The overload scenario: one tenant with Zipf-head weight floods the
+    fleet with GP compute at a saturating open-loop rate while three
+    light tenants run occasional GP studies — the traffic shape where a
+    serving tier without admission control collapses for everyone.
+
+    Open-loop on purpose (``time_scale=1`` + real arrival pacing): the
+    hot tenant's studies keep arriving whether or not the fleet drains,
+    so suggest p99 measures queueing truthfully. The admission knobs
+    (weights, caps, floor) describe the plane the ON arm arms; the OFF
+    arm runs the identical workload with ``planes.admission=False``
+    (``tools/overload_ab.py`` drives both).
+    """
+    values: Dict[str, object] = dict(
+        name="hot_tenant",
+        num_studies=28,
+        min_trials=3,
+        max_trials=3,
+        target="inprocess",
+        replicas=1,
+        dim=2,
+        concurrency=8,
+        # Saturating open-loop arrivals: everything lands inside a few
+        # seconds of real time, faster than the ~80 ms default-sweep GP
+        # computes drain on one core (load ≈ 3).
+        arrival_rate_per_s=12.0,
+        burst_factor=1.0,
+        time_scale=1.0,
+        # One Zipf-head tenant, three light ones: ~4/5 of studies are hot.
+        tenants=(
+            ("hot", 12.0),
+            ("light-a", 1.0),
+            ("light-b", 1.0),
+            ("light-c", 1.0),
+        ),
+        # The hot tenant is compute-heavy (all GP); light tenants mix one
+        # GP study into cheap baseline traffic.
+        kind_mix=(("random", 2.0), ("gp_bandit", 1.0)),
+        tenant_kinds=(("hot", (("gp_bandit", 1.0),)),),
+        sparse_threshold=64,  # stay exact: the A/B is about admission
+        # Designer DEFAULTS (the production 75k-candidate sweep + full
+        # ARD budget): the realistic heavy compute the hot tenant floods
+        # the fleet with (~80 ms warm on 1-core CPU).
+        acquisition_evals=0,
+        ard_restarts=0,
+        ard_maxiter=0,
+        chaos_fault_prob=0.0,
+        parity_cohort=4,
+        max_fallback_rate=1.0,  # degraded-mode serves ARE the mechanism
+        planes=PlaneConfig(
+            batching=True,
+            speculative=False,
+            mesh=False,
+            slo=True,
+            recorder=True,
+            admission=True,
+        ),
+        events=(),
+        # The plane under test: light tenants outrank the hot one, whose
+        # sub-floor weight routes it to quasi-random under degradation.
+        admission_weights=(
+            ("hot", 0.5),
+            ("light-a", 4.0),
+            ("light-b", 4.0),
+            ("light-c", 4.0),
+        ),
+        # Headroom above the sum of plausible light-tenant concurrency so
+        # the TOTAL cap never sheds a light tenant; the hot tenant's own
+        # cap binds long before it.
+        admission_max_inflight=12,
+        admission_tenant_inflight=3,
+        admission_degraded_floor=1.0,
+        # Fast decisions under a seconds-scale flood: degrade within ~1 s
+        # of sustained sheds, and pace shed retries widely enough
+        # (6 attempts x >= 250 ms) that hot studies survive into the
+        # degraded serve instead of exhausting their retry budget.
+        admission_window_s=1.0,
+        admission_retry_after_ms=250.0,
+        # Between the two arms' measured light-tenant p99 (ON ~150 ms,
+        # OFF ~1.4-1.7 s on the 1-core container): the plane keeps light
+        # tenants inside it, the collapse arm breaches it.
+        p99_budget_ms=1000.0,
     )
     values.update(overrides)
     return ScenarioConfig(**values)

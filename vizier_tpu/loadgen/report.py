@@ -33,7 +33,7 @@ from typing import Dict, List, Optional
 from vizier_tpu.loadgen import driver as driver_lib
 from vizier_tpu.loadgen import models
 
-REPORT_VERSION = 1
+REPORT_VERSION = 2  # v2: admission section + per-tenant latency/sheds
 
 
 def ranksum_p(a, b) -> float:
@@ -84,6 +84,7 @@ def _outcome_tables(result: driver_lib.SoakResult) -> Dict[str, dict]:
     by_kind: Dict[str, dict] = {}
     by_tenant: Dict[str, dict] = {}
     latencies: Dict[str, List[float]] = {}
+    tenant_latencies: Dict[str, List[float]] = {}
     for record in result.records:
         if record.op != "suggest":
             continue
@@ -95,6 +96,8 @@ def _outcome_tables(result: driver_lib.SoakResult) -> Dict[str, dict]:
                     "errors": 0,
                     "fallbacks": 0,
                     "speculative_hits": 0,
+                    "degraded": 0,
+                    "shed_errors": 0,
                 },
             )
             row["suggests"] += 1
@@ -104,8 +107,15 @@ def _outcome_tables(result: driver_lib.SoakResult) -> Dict[str, dict]:
                 row["fallbacks"] += 1
             if record.speculative_hit:
                 row["speculative_hits"] += 1
+            if record.degraded:
+                row["degraded"] += 1
+            if record.shed:
+                row["shed_errors"] += 1
         if record.error is None:
             latencies.setdefault(record.kind, []).append(record.latency_s)
+            tenant_latencies.setdefault(record.tenant, []).append(
+                record.latency_s
+            )
     for kind, row in by_kind.items():
         row["studies"] = sum(
             1 for o in result.outcomes.values() if o.spec.kind == kind
@@ -114,6 +124,17 @@ def _outcome_tables(result: driver_lib.SoakResult) -> Dict[str, dict]:
         row["fallback_rate"] = round(row["fallbacks"] / served, 4)
         row["hit_rate"] = round(row["speculative_hits"] / served, 4)
         row["latency"] = _latency_ms(latencies.get(kind, []))
+    # Per-tenant sheds seen by the controller (retried-and-absorbed sheds
+    # included, unlike the client-visible shed_errors) + latency — the
+    # fairness view: one hot tenant's collapse must be visible as ITS
+    # numbers, not smeared across the fleet aggregate.
+    controller_sheds = (result.admission or {}).get("sheds_by_tenant", {})
+    for tenant, row in by_tenant.items():
+        row["studies"] = sum(
+            1 for o in result.outcomes.values() if o.spec.tenant == tenant
+        )
+        row["sheds"] = sum(controller_sheds.get(tenant, {}).values())
+        row["latency"] = _latency_ms(tenant_latencies.get(tenant, []))
     return {
         "by_kind": dict(sorted(by_kind.items())),
         "by_tenant": dict(sorted(by_tenant.items())),
@@ -184,6 +205,32 @@ def _traffic_section(
         ),
         "wall_s": engine.wall_s,
         "achieved_trials_per_s": round(driven / max(engine.wall_s, 1e-9), 2),
+        "open_loop": scenario.config.time_scale > 0,
+        "open_loop_capped": engine.open_loop_capped,
+    }
+
+
+def _admission_section(
+    config: models.ScenarioConfig, engine: driver_lib.SoakResult
+) -> dict:
+    """The overload-protection rollup: the controller's own snapshot plus
+    the fleet shed rate (controller sheds over controller decisions) the
+    --diff regression gate compares."""
+    snapshot = dict(engine.admission or {"enabled": False})
+    sheds = sum(
+        count
+        for reasons in snapshot.get("sheds_by_tenant", {}).values()
+        for count in reasons.values()
+    )
+    admits = sum(snapshot.get("admits_by_tenant", {}).values())
+    degraded = sum(snapshot.get("degraded_by_tenant", {}).values())
+    decisions = sheds + admits + degraded
+    return {
+        "armed": bool(config.planes.admission),
+        "sheds": sheds,
+        "degraded_serves": degraded,
+        "shed_rate": round(sheds / decisions, 4) if decisions else 0.0,
+        "snapshot": snapshot,
     }
 
 
@@ -358,6 +405,7 @@ def build_report(
         },
         "traffic": _traffic_section(scenario, engine),
         "outcomes": outcomes,
+        "admission": _admission_section(config, engine),
         "speculative": speculative_section,
         "slo": engine.slo,
         "failover": {
@@ -396,18 +444,24 @@ def diff_reports(
     *,
     hit_rate_drop: float = 0.10,
     fallback_rise: float = 0.05,
+    shed_rise: float = 0.05,
     latency_ratio: float = 0.0,
 ) -> dict:
     """Compares two SOAK_REPORTs (A = before, B = after).
 
-    The ROADMAP defaults-ON campaign's before/after gate: per-kind
-    suggest-latency deltas, assertion verdict changes, speculative
-    hit-rate and fallback-rate deltas. **Regressions** (what flips
-    ``ok`` to False) are: an assertion that passed in A and fails in B;
-    a GP hit-rate drop > ``hit_rate_drop``; a fallback-rate rise >
-    ``fallback_rise``; and, when ``latency_ratio`` > 0, any per-kind p99
-    that grew by more than that factor (off by default — wall-clock
-    comparisons across machines are advisory, verdicts are the gate).
+    The ROADMAP defaults-ON campaign's before/after gate: per-kind AND
+    per-tenant suggest-latency deltas, assertion verdict changes,
+    speculative hit-rate, fallback-rate, and admission shed-rate deltas.
+    **Regressions** (what flips ``ok`` to False) are: an assertion that
+    passed in A and fails in B; a GP hit-rate drop > ``hit_rate_drop``;
+    a fallback-rate rise > ``fallback_rise``; an admission shed-rate
+    rise > ``shed_rise`` while the plane's armed state is UNCHANGED
+    (arming the plane on a saturating scenario legitimately introduces
+    sheds — that is not a regression); and, when ``latency_ratio`` > 0,
+    any per-kind p99 that grew by more than that factor (off by default
+    — wall-clock comparisons across machines are advisory, verdicts are
+    the gate). Per-tenant p99 deltas are always reported, and gated by
+    the same ``latency_ratio`` knob.
     """
 
     def _assertions(report: dict) -> Dict[str, bool]:
@@ -469,6 +523,65 @@ def diff_reports(
             regressions.append(f"kind {kind} served in A but absent in B")
         per_kind[kind] = entry
 
+    # Per-tenant p99 deltas + controller-shed deltas (the fair-share
+    # regression view: a hot-tenant fix must not quietly cost a light
+    # tenant its p99).
+    per_tenant: Dict[str, dict] = {}
+    a_tenants = a.get("outcomes", {}).get("by_tenant", {})
+    b_tenants = b.get("outcomes", {}).get("by_tenant", {})
+    for tenant in sorted(set(a_tenants) | set(b_tenants)):
+        row_a, row_b = a_tenants.get(tenant), b_tenants.get(tenant)
+        entry: Dict[str, object] = {
+            "present": {"before": row_a is not None, "after": row_b is not None}
+        }
+        if row_a and row_b:
+            for q in ("p50_ms", "p99_ms"):
+                before = (row_a.get("latency") or {}).get(q)
+                after = (row_b.get("latency") or {}).get(q)
+                if before is not None and after is not None:
+                    entry[q] = {
+                        "before": before,
+                        "after": after,
+                        "delta": round(after - before, 3),
+                        "ratio": round(after / before, 3) if before else None,
+                    }
+            entry["sheds"] = {
+                "before": row_a.get("sheds", 0),
+                "after": row_b.get("sheds", 0),
+            }
+            if (
+                latency_ratio > 0
+                and isinstance(entry.get("p99_ms"), dict)
+                and entry["p99_ms"].get("ratio") is not None
+                and entry["p99_ms"]["ratio"] > latency_ratio
+            ):
+                regressions.append(
+                    f"tenant {tenant} p99 {entry['p99_ms']['ratio']}x "
+                    f"(> {latency_ratio}x budget)"
+                )
+        per_tenant[tenant] = entry
+
+    adm_a = a.get("admission", {}) or {}
+    adm_b = b.get("admission", {}) or {}
+    shed_section = {
+        "armed": {"before": adm_a.get("armed"), "after": adm_b.get("armed")},
+        "shed_rate": {
+            "before": adm_a.get("shed_rate"),
+            "after": adm_b.get("shed_rate"),
+        },
+    }
+    if (
+        adm_a.get("armed") == adm_b.get("armed")
+        and adm_a.get("shed_rate") is not None
+        and adm_b.get("shed_rate") is not None
+        and adm_b["shed_rate"] > adm_a["shed_rate"] + shed_rise
+    ):
+        regressions.append(
+            f"admission shed rate {adm_a['shed_rate']} -> "
+            f"{adm_b['shed_rate']} (rise > {shed_rise} with the plane "
+            "unchanged)"
+        )
+
     spec_a = a.get("speculative", {}) or {}
     spec_b = b.get("speculative", {}) or {}
     speculative = {
@@ -514,6 +627,8 @@ def diff_reports(
         "ok_flags": {"before": a.get("ok"), "after": b.get("ok")},
         "assertion_changes": verdict_changes,
         "per_kind": per_kind,
+        "per_tenant": per_tenant,
+        "admission": shed_section,
         "speculative": speculative,
         "fallback_rate": fallback,
         "regressions": regressions,
@@ -538,6 +653,15 @@ def render_diff(diff: dict) -> str:
                 f"  {kind}: p99 {p99['before']} -> {p99['after']} ms "
                 f"({p99['ratio']}x)"
             )
+    for tenant, entry in sorted(diff.get("per_tenant", {}).items()):
+        p99 = entry.get("p99_ms")
+        sheds = entry.get("sheds", {})
+        if isinstance(p99, dict):
+            lines.append(
+                f"  tenant {tenant}: p99 {p99['before']} -> {p99['after']} "
+                f"ms ({p99['ratio']}x), sheds {sheds.get('before')} -> "
+                f"{sheds.get('after')}"
+            )
     spec = diff["speculative"]["gp_hit_rate"]
     if spec["before"] is not None or spec["after"] is not None:
         lines.append(
@@ -545,6 +669,12 @@ def render_diff(diff: dict) -> str:
         )
     fb = diff["fallback_rate"]
     lines.append(f"  fallback rate: {fb['before']} -> {fb['after']}")
+    shed = diff.get("admission", {}).get("shed_rate", {})
+    if shed.get("before") is not None or shed.get("after") is not None:
+        lines.append(
+            f"  admission shed rate: {shed.get('before')} -> "
+            f"{shed.get('after')}"
+        )
     for regression in diff["regressions"]:
         lines.append(f"  REGRESSION: {regression}")
     return "\n".join(lines)

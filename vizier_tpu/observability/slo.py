@@ -83,6 +83,10 @@ class SloConfig:
     # Objective: at most this fraction of suggests served by the
     # quasi-random reliability fallback.
     fallback_rate: float = 0.05
+    # Objective: at most this fraction of suggests shed by the admission
+    # controller (vizier_tpu.serving.admission; evaluated only when the
+    # window saw any admission traffic).
+    shed_rate: float = 0.05
     # Objective: mean batch-flush occupancy at least this many real slots
     # (padding-waste proxy; 1.0 = always satisfied, raise to enforce).
     occupancy_min: float = 1.0
@@ -116,6 +120,7 @@ class SloConfig:
                 "VIZIER_SLO_SPECULATIVE_HIT_RATE", 0.8
             ),
             fallback_rate=_registry.env_float("VIZIER_SLO_FALLBACK_RATE", 0.05),
+            shed_rate=_registry.env_float("VIZIER_SLO_SHED_RATE", 0.05),
             dump_dir=_registry.env_str("VIZIER_SLO_DUMP_DIR"),
         )
 
@@ -260,6 +265,7 @@ class SloEngine:
             "vizier_serving_speculative_misses",
             "vizier_serving_speculative_stale",
             "vizier_serving_fallbacks",
+            "vizier_serving_admission_sheds",
             _FLUSH_COUNTER,
         )
         self._hist_names = (_SUGGEST_HIST, _OCCUPANCY_HIST)
@@ -359,6 +365,7 @@ class SloEngine:
             statuses.extend(self._latency_slos(sample, base, window))
             statuses.append(self._hit_rate_slo(sample, base, window))
             statuses.append(self._fallback_slo(sample, base, window))
+            statuses.append(self._shed_slo(sample, base, window))
             statuses.append(self._occupancy_slo(sample, base, window))
             statuses.append(self._mesh_slo(sample, base, window))
         return statuses
@@ -402,12 +409,18 @@ class SloEngine:
         deltas = _delta_hist(sample, base, _SUGGEST_HIST)
         out = []
         for key, (counts, count, _sum) in sorted(deltas.items()):
-            hop = dict(key).get("hop", "")
+            labels = dict(key)
+            hop = labels.get("hop", "")
+            # The admission plane splits the service hop per tenant: each
+            # tenant series becomes its own p99 objective, so one hot
+            # tenant's collapse cannot hide inside the fleet aggregate.
+            tenant = labels.get("tenant")
+            name = f"suggest_p99:{hop}" + (f":{tenant}" if tenant else "")
             p99 = _hist_quantile(buckets, counts, 99) if count else None
             bad = _count_above(buckets, counts, threshold) if count else 0.0
             out.append(
                 self._status(
-                    f"suggest_p99:{hop}", window, p99, threshold, count, bad,
+                    name, window, p99, threshold, count, bad,
                     allowed_bad_fraction=0.01,
                 )
             )
@@ -456,6 +469,29 @@ class SloEngine:
             "reliability_fallback_rate", window, rate,
             self.config.fallback_rate, suggests, fallbacks,
             allowed_bad_fraction=self.config.fallback_rate,
+        )
+
+    def _shed_slo(
+        self, sample: _Sample, base: Optional[_Sample], window: float
+    ) -> SloStatus:
+        """Admission shed fraction: sheds over (sheds + served pythia
+        suggests) in the window — the overload plane's own error budget."""
+        sheds = sum(
+            _delta_counter(
+                sample, base, "vizier_serving_admission_sheds"
+            ).values()
+        )
+        suggests = 0
+        for key, (_counts, count, _sum) in _delta_hist(
+            sample, base, _SUGGEST_HIST
+        ).items():
+            if dict(key).get("hop") == "pythia":
+                suggests += count
+        total = suggests + sheds
+        rate = sheds / total if total else None
+        return self._status(
+            "admission_shed_rate", window, rate, self.config.shed_rate,
+            total, sheds, allowed_bad_fraction=self.config.shed_rate,
         )
 
     def _occupancy_slo(

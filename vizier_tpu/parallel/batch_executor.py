@@ -42,15 +42,32 @@ flat pad-to-``max_batch_size`` — a low-occupancy flush no longer computes
 ``max_batch_size`` padded slots. ``VIZIER_MESH=0`` (default) never builds
 placements: single scheduler thread, one device, bit-identical seed path.
 
-Priority lanes: slots submitted with ``speculative=True`` (the serving
-tier's background pre-compute, ``vizier_tpu.serving.speculative``) ride a
-live flush that is forming anyway, but a bucket holding ONLY speculative
-slots waits for the idle window — it never becomes due while a live slot
-is queued in any bucket (bounded by ``speculative_max_wait_ms`` so a live
-request coalesced onto an in-flight speculative compute cannot starve),
-and due live batches always execute first. ``queue_depth()`` /
-``live_pending()`` expose per-lane occupancy so the speculative admission
-gate can refuse to enqueue under live saturation.
+Priority lanes (N-lane): every slot rides a named :class:`LaneSpec` lane.
+The default table has two — ``live`` (priority 0) and ``speculative``
+(priority 1, deferrable): slots submitted with ``speculative=True`` (the
+serving tier's background pre-compute, ``vizier_tpu.serving.speculative``)
+ride a live flush that is forming anyway, but a bucket holding ONLY
+deferrable-lane slots waits for the idle window — it never becomes due
+while a lower-priority-number slot is queued in any bucket (bounded by the
+lane's ``starvation_cap_ms`` so a live request coalesced onto an in-flight
+speculative compute cannot starve), and due batches execute in lane-
+priority order. New QoS classes are one more LaneSpec, not a scheduler
+rewrite. ``queue_depth()`` / ``live_pending()`` expose per-lane occupancy
+so the speculative admission gate can refuse to enqueue under live
+saturation.
+
+Weighted fair share (opt-in via the admission controller,
+``VIZIER_ADMISSION=1``): inside the live lane, slots carry the tenant the
+admission gate admitted (``serving.admission.current_tenant()``), and when
+a bucket holds more queued work than one flush, deficit-round-robin
+selection across tenants — quantum = the tenant's configured weight —
+decides who flushes first instead of FIFO, so a hot tenant cannot
+monopolize flush slots: a continuously-hot tenant can delay a light
+tenant's first slot by at most one DRR round (the sum of the other
+tenants' quanta). Due same-priority batches are likewise ordered by
+weighted served-slot counts across buckets. With admission off (the
+default) no tenant is attached and selection is exactly the seed FIFO —
+bit-identical scheduling.
 
 Batchable designers implement ONE :class:`~vizier_tpu.compute.ir.
 DesignerProgram` (bucket_key / prepare / device_program / finalize),
@@ -66,6 +83,7 @@ sequentially.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import math
 import threading
 import time
@@ -87,6 +105,43 @@ class BatchSlotError(errors_lib.TransientError):
     """A batched slot produced an invalid result (isolated to its study)."""
 
 
+@dataclasses.dataclass(frozen=True)
+class LaneSpec:
+    """One QoS lane in the executor's N-lane scheduler.
+
+    ``priority`` orders execution (lower number first). A ``deferrable``
+    lane's buckets wait for the idle window — they only become due while
+    no strictly-lower-priority slot is queued anywhere — except after
+    ``starvation_cap_ms``, the bounded-starvation escape hatch (0 = the
+    normal flush window applies even while deferring, i.e. never extend
+    the wait).
+    """
+
+    name: str
+    priority: int
+    deferrable: bool = False
+    starvation_cap_ms: float = 0.0
+
+
+LANE_LIVE = "live"
+LANE_SPECULATIVE = "speculative"
+
+
+def default_lanes(speculative_max_wait_ms: float) -> Tuple[LaneSpec, ...]:
+    """The seed two-lane table: live traffic plus the deferrable
+    speculative pre-compute lane (its starvation cap bounds how long a
+    live request coalesced onto an in-flight speculative compute waits)."""
+    return (
+        LaneSpec(LANE_LIVE, priority=0),
+        LaneSpec(
+            LANE_SPECULATIVE,
+            priority=1,
+            deferrable=True,
+            starvation_cap_ms=speculative_max_wait_ms,
+        ),
+    )
+
+
 class _Slot:
     """One study's pending computation inside a bucket queue.
 
@@ -101,12 +156,12 @@ class _Slot:
 
     __slots__ = (
         "designer", "program", "count", "enqueued_at", "event", "error",
-        "item", "output", "action", "span", "speculative",
+        "item", "output", "action", "span", "lane", "tenant",
     )
 
     def __init__(
         self, designer: Any, program: Any, count: int, now: float, span,
-        speculative: bool = False,
+        lane: str = LANE_LIVE, tenant: Optional[str] = None,
     ) -> None:
         self.designer = designer
         self.program = program  # the resolved compute-IR DesignerProgram
@@ -118,10 +173,17 @@ class _Slot:
         self.output: Any = None
         self.action: str = "sequential"
         self.span = span  # the submitter's active span (may be None)
-        # Low-priority lane (serving.speculative): a speculative slot may
-        # ride a live flush that is forming anyway, but a bucket holding
-        # ONLY speculative slots defers to queued live traffic.
-        self.speculative = speculative
+        # QoS lane (LaneSpec.name): a deferrable-lane slot may ride a
+        # higher-priority flush that is forming anyway, but a bucket
+        # holding ONLY deferrable slots defers to queued priority traffic.
+        self.lane = lane
+        # Fair-share identity (admission on only): who this computation
+        # bills to inside the live lane's deficit-round-robin.
+        self.tenant = tenant
+
+    @property
+    def speculative(self) -> bool:
+        return self.lane == LANE_SPECULATIVE
 
 
 def stack_pytrees(trees: Sequence[Any], pad_to: Optional[int] = None) -> Any:
@@ -213,6 +275,8 @@ class BatchExecutor:
         time_fn: Callable[[], float] = time.monotonic,
         speculative_max_wait_ms: float = 250.0,
         mesh: Optional[Any] = None,  # parallel.mesh.MeshConfig
+        lanes: Optional[Sequence[LaneSpec]] = None,
+        admission: Optional[Any] = None,  # serving.admission.AdmissionController
     ):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
@@ -224,6 +288,24 @@ class BatchExecutor:
         # in-flight speculative compute is waiting on it, so the hold is
         # bounded — after this long the speculative flush runs regardless.
         self.speculative_max_wait_secs = max(speculative_max_wait_ms, 0.0) / 1000.0
+        # The N-lane QoS table, keyed by lane name; unknown lane names on
+        # a slot fall back to the live lane's rules.
+        lane_table = tuple(lanes) if lanes else default_lanes(
+            speculative_max_wait_ms
+        )
+        self._lanes: Dict[str, LaneSpec] = {l.name: l for l in lane_table}
+        self._live_lane = min(self._lanes.values(), key=lambda l: l.priority)
+        # Weighted fair share across tenants (serving.admission): with a
+        # controller attached, live-lane selection is deficit-round-robin
+        # by tenant; None (the default) keeps the seed FIFO bit-identical.
+        self._admission = admission
+        # DRR state, guarded by _cond: per-tenant deficit credits, the
+        # stable round-robin ring + cursor, and weighted served-slot
+        # totals (the cross-bucket ordering key).
+        self._drr_deficit: Dict[str, float] = {}
+        self._drr_ring: List[str] = []
+        self._drr_cursor = 0
+        self._tenant_served: Dict[str, float] = {}
         self.pad_partial = pad_partial
         self._stats = stats
         self._time = time_fn
@@ -279,14 +361,16 @@ class BatchExecutor:
         count: Optional[int] = None,
         *,
         speculative: bool = False,
+        lane: Optional[str] = None,
     ) -> List[Any]:
         """Routes one study's suggest through the batching engine.
 
         Unbatchable paths (no resolvable compute-IR program, seeding
         stage, multi-objective, priors, …) run inline on the caller's
-        thread — identical to batching off. ``speculative`` marks the slot
-        for the low-priority lane: it never makes a bucket flush while
-        live slots are queued (see :meth:`_take_due`).
+        thread — identical to batching off. ``speculative`` (or an
+        explicit ``lane`` name) marks the slot's QoS lane: a deferrable
+        lane's bucket never flushes while higher-priority slots are
+        queued (see :meth:`_take_due`).
         """
         count = count or 1
         resolved = compute_registry.resolve(designer, count)
@@ -294,9 +378,15 @@ class BatchExecutor:
             return designer.suggest(count)
         program, key = resolved
         tracer = tracing_lib.get_tracer()
+        tenant = None
+        if self._admission is not None:
+            from vizier_tpu.serving import admission as admission_lib
+
+            tenant = admission_lib.current_tenant()
         slot = _Slot(
             designer, program, count, self._time(), tracer.current_span(),
-            speculative=speculative,
+            lane=lane or (LANE_SPECULATIVE if speculative else LANE_LIVE),
+            tenant=tenant,
         )
         # Joining a non-empty bucket ⇒ this slot will (very likely) ride a
         # batched flush: run its host-side prepare HERE, on the caller's
@@ -421,15 +511,13 @@ class BatchExecutor:
     def queue_depth(self) -> Dict[str, int]:
         """Queued slots by lane — the speculative admission gate's view of
         whether live traffic is saturating the flush buckets."""
-        live = spec = 0
+        out = {name: 0 for name in self._lanes}
         with self._cond:
             for slots in self._queues.values():
                 for slot in slots:
-                    if slot.speculative:
-                        spec += 1
-                    else:
-                        live += 1
-        return {"live": live, "speculative": spec}
+                    name = slot.lane if slot.lane in out else self._live_lane.name
+                    out[name] += 1
+        return out
 
     def live_pending(self) -> int:
         """Queued LIVE (non-speculative) slots across all buckets."""
@@ -458,82 +546,191 @@ class BatchExecutor:
             for worker in self._workers:
                 worker.start()
 
+    def _lane_for(self, slot: _Slot) -> LaneSpec:
+        return self._lanes.get(slot.lane, self._live_lane)
+
+    def _bucket_lane(self, slots: List[_Slot]) -> LaneSpec:
+        """A bucket's effective lane: the lowest-priority-number lane
+        among its slots (a deferrable slot rides a priority flush that is
+        forming anyway — the seed's spec-slot-on-live-bucket behavior)."""
+        return min(
+            (self._lane_for(s) for s in slots), key=lambda l: l.priority
+        )
+
+    def _fair_order(self, slots: List[_Slot]) -> List[_Slot]:
+        """Deficit-round-robin across tenants, FIFO within a tenant.
+
+        Quantum = the tenant's admission weight. Persistent ring/cursor/
+        deficit state (caller holds ``_cond``) makes the rotation fair
+        across flushes, not just within one. Starvation bound: a light
+        tenant's first queued slot is selected within one DRR round, i.e.
+        it can be delayed by at most the sum of the OTHER tenants'
+        quanta — a continuously-hot tenant cannot push it further back.
+        Single-tenant (or tenantless, admission off) input returns FIFO
+        unchanged.
+        """
+        by_tenant: Dict[str, Deque[_Slot]] = collections.OrderedDict()
+        for slot in slots:
+            by_tenant.setdefault(slot.tenant or "", collections.deque()).append(
+                slot
+            )
+        if len(by_tenant) <= 1:
+            return slots
+        for tenant in by_tenant:
+            if tenant not in self._drr_ring:
+                self._drr_ring.append(tenant)
+        weight = self._admission.weight
+        out: List[_Slot] = []
+        remaining = len(slots)
+        ring = self._drr_ring
+        while remaining:
+            self._drr_cursor %= len(ring)
+            tenant = ring[self._drr_cursor]
+            self._drr_cursor += 1
+            queue = by_tenant.get(tenant)
+            if not queue:
+                # Classic DRR: an idle tenant banks no credit.
+                self._drr_deficit.pop(tenant, None)
+                continue
+            quantum = max(1.0, float(weight(tenant)))
+            credit = self._drr_deficit.get(tenant, 0.0) + quantum
+            while credit >= 1.0 and queue:
+                out.append(queue.popleft())
+                remaining -= 1
+                credit -= 1.0
+            self._drr_deficit[tenant] = credit if queue else 0.0
+        return out
+
+    def _order_due(
+        self, due: List[Tuple[BucketKey, List[_Slot], str]]
+    ) -> List[Tuple[BucketKey, List[_Slot], str]]:
+        """Cross-bucket fairness: stable-sort same-priority due batches by
+        their tenants' weighted served-slot totals (least-served first),
+        then bill the selection — every flush is billed, even a lone one,
+        so the credit stays honest across flush cycles. No-op without an
+        admission controller."""
+        if self._admission is None:
+            return due
+        weight = self._admission.weight
+        if len(due) > 1:
+
+            def served_key(batch):
+                _key, slots, _reason = batch
+                return min(
+                    self._tenant_served.get(s.tenant or "", 0.0)
+                    / max(1.0, float(weight(s.tenant)))
+                    for s in slots
+                )
+
+            due = sorted(due, key=served_key)
+        for _key, slots, _reason in due:
+            for slot in slots:
+                self._tenant_served[slot.tenant or ""] = (
+                    self._tenant_served.get(slot.tenant or "", 0.0) + 1.0
+                )
+        return due
+
     def _take_due(self) -> List[Tuple[BucketKey, List[_Slot], str]]:
         """Pops every due (key, slots, reason) batch. Caller holds the lock.
 
-        Two lanes: a bucket containing at least one LIVE slot flushes on
-        the ordinary full/timeout rules. A speculative-only bucket defers
-        while any live slot is queued anywhere (live traffic owns the
-        device; the idle window is speculation's admission), flushing only
-        once the queues are live-free — or after ``speculative_max_wait``,
-        the bounded-starvation escape for live requests that coalesced
-        onto an in-flight speculative compute. Due live batches always
-        execute before due speculative ones.
+        Lane rules: a bucket whose effective lane is non-deferrable
+        flushes on the ordinary full/timeout rules. A deferrable-lane
+        bucket defers while any strictly-lower-priority slot is queued
+        anywhere (priority traffic owns the device; the idle window is
+        its admission), flushing only once the queues are clear of
+        priority work — or after the lane's ``starvation_cap_ms``, the
+        bounded-starvation escape for priority requests that coalesced
+        onto an in-flight deferred compute. Due batches come back in
+        lane-priority order; same-priority batches are ordered by the
+        weighted fair-share credit when admission is on.
         """
         now = self._time()
-        live_due: List[Tuple[BucketKey, List[_Slot], str]] = []
-        spec_candidates: List[Tuple[BucketKey, List[_Slot]]] = []
+        due_by_priority: Dict[int, List[Tuple[BucketKey, List[_Slot], str]]] = {}
+        deferred: List[Tuple[BucketKey, List[_Slot], LaneSpec]] = []
+        min_queued_priority = min(
+            (
+                self._lane_for(s).priority
+                for slots in self._queues.values()
+                for s in slots
+            ),
+            default=0,
+        )
         for key, slots in self._queues.items():
             if not slots:
                 continue
             if self._closed:
-                live_due.append((key, slots[:], "drain"))
+                due_by_priority.setdefault(0, []).append(
+                    (key, slots[:], "drain")
+                )
                 slots.clear()
                 continue
-            if any(not s.speculative for s in slots):
-                while len(slots) >= self.max_batch_size:
-                    live_due.append((key, slots[: self.max_batch_size], "full"))
-                    del slots[: self.max_batch_size]
-                if slots and now - slots[0].enqueued_at >= self.max_wait_secs:
-                    live_due.append((key, slots[:], "timeout"))
-                    slots.clear()
-            else:
-                spec_candidates.append((key, slots))
-        live_queued = any(
-            not s.speculative
-            for slots in self._queues.values()
-            for s in slots
-        )
-        spec_due: List[Tuple[BucketKey, List[_Slot], str]] = []
-        for key, slots in spec_candidates:
+            lane = self._bucket_lane(slots)
+            if lane.deferrable and min_queued_priority < lane.priority:
+                deferred.append((key, slots, lane))
+                continue
+            bucket_due = due_by_priority.setdefault(lane.priority, [])
+            if len(slots) >= self.max_batch_size:
+                ordered = (
+                    self._fair_order(slots)
+                    if self._admission is not None
+                    and not lane.deferrable
+                    else slots
+                )
+                while len(ordered) >= self.max_batch_size:
+                    bucket_due.append(
+                        (key, ordered[: self.max_batch_size], "full")
+                    )
+                    del ordered[: self.max_batch_size]
+                slots[:] = ordered
+            # Oldest by enqueue time, not position: a DRR-reordered
+            # remainder is no longer FIFO (identical for FIFO queues).
+            if slots and now - min(
+                s.enqueued_at for s in slots
+            ) >= self.max_wait_secs:
+                bucket_due.append((key, slots[:], "timeout"))
+                slots.clear()
+        for key, slots, lane in deferred:
             if not slots:
                 continue
             waited = now - slots[0].enqueued_at
-            if not live_queued and (
-                len(slots) >= self.max_batch_size or waited >= self.max_wait_secs
-            ):
-                reason = "full" if len(slots) >= self.max_batch_size else "timeout"
-            elif waited >= self.speculative_max_wait_secs:
+            cap = max(lane.starvation_cap_ms, 0.0) / 1000.0
+            if waited >= cap:
                 reason = "spec_starved"
             else:
                 continue
             # A deferred bucket may have grown past the batch size: flush
             # in max-size chunks so the compiled shape stays the bucket's.
+            bucket_due = due_by_priority.setdefault(lane.priority, [])
             while len(slots) > self.max_batch_size:
-                spec_due.append((key, slots[: self.max_batch_size], "full"))
+                bucket_due.append((key, slots[: self.max_batch_size], "full"))
                 del slots[: self.max_batch_size]
-            spec_due.append((key, slots[:], reason))
+            bucket_due.append((key, slots[:], reason))
             slots.clear()
-        return live_due + spec_due
+        out: List[Tuple[BucketKey, List[_Slot], str]] = []
+        for priority in sorted(due_by_priority):
+            out.extend(self._order_due(due_by_priority[priority]))
+        return out
 
     def _next_deadline(self) -> Optional[float]:
         """Seconds until the next queued bucket becomes due (lock held)."""
-        live_queued = any(
-            not s.speculative
-            for slots in self._queues.values()
-            for s in slots
+        min_queued_priority = min(
+            (
+                self._lane_for(s).priority
+                for slots in self._queues.values()
+                for s in slots
+            ),
+            default=0,
         )
         deadline = None
         for slots in self._queues.values():
             if not slots:
                 continue
-            if any(not s.speculative for s in slots):
-                window = self.max_wait_secs
-            elif live_queued:
-                window = self.speculative_max_wait_secs
+            lane = self._bucket_lane(slots)
+            if lane.deferrable and min_queued_priority < lane.priority:
+                window = max(lane.starvation_cap_ms, 0.0) / 1000.0
             else:
                 window = self.max_wait_secs
-            due_at = slots[0].enqueued_at + window
+            due_at = min(s.enqueued_at for s in slots) + window
             if deadline is None or due_at < deadline:
                 deadline = due_at
         if deadline is None:

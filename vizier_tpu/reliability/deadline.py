@@ -36,6 +36,22 @@ class Deadline:
         return cls(clock() + budget_secs, clock)
 
     @classmethod
+    def from_wire(
+        cls, budget_secs: float, clock: Callable[[], float] = time.monotonic
+    ) -> "Deadline":
+        """A deadline from a wire ``deadline_secs`` field.
+
+        Wire semantics: positive = remaining budget, 0 = no deadline
+        (back-compat), **negative = already expired at the sender** — the
+        resulting deadline is born expired so the receiver's existing
+        ``check()`` sheds the request before any computation starts,
+        instead of conflating "caller gave up" with "no deadline".
+        """
+        if budget_secs == 0:
+            return cls(None, clock)
+        return cls(clock() + budget_secs, clock)
+
+    @classmethod
     def none(cls) -> "Deadline":
         """No deadline: infinite remaining budget, never expired."""
         return cls(None)

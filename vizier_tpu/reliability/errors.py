@@ -14,7 +14,18 @@ quasi-random points to a misconfigured study forever.
 
 from __future__ import annotations
 
+import re
+from typing import Optional, Union
+
 TRANSIENT_MARKER = "TRANSIENT:"
+
+# Admission-control shed vocabulary: the marker names the condition
+# (capacity, not failure) and the retry-after key carries the service's
+# backoff hint in milliseconds. Both survive stringification across the
+# op-error round trip, like the transient marker itself.
+RESOURCE_EXHAUSTED_MARKER = "RESOURCE_EXHAUSTED"
+RETRY_AFTER_KEY = "retry_after_ms="
+_RETRY_AFTER_RE = re.compile(re.escape(RETRY_AFTER_KEY) + r"([0-9]*\.?[0-9]+)")
 
 
 class TransientError(RuntimeError):
@@ -44,6 +55,31 @@ def has_transient_marker(text: str) -> bool:
     must survive that nesting.
     """
     return TRANSIENT_MARKER in text
+
+
+def is_resource_exhausted(text: str) -> bool:
+    """True when error text carries the admission-shed marker (substring:
+    service layers wrap each other's error text, like the transient
+    marker)."""
+    return RESOURCE_EXHAUSTED_MARKER in text
+
+
+def retry_after_secs(error: Union[BaseException, str]) -> Optional[float]:
+    """The ``retry_after_ms=`` hint in an error (or its text), in seconds.
+
+    Admission sheds stamp the hint so client retry logic can honor the
+    service's backoff floor instead of hammering a saturated fleet with
+    its own (possibly tiny) jittered schedule. None when absent.
+    """
+    match = _RETRY_AFTER_RE.search(
+        error if isinstance(error, str) else str(error)
+    )
+    if match is None:
+        return None
+    try:
+        return float(match.group(1)) / 1e3
+    except ValueError:  # pragma: no cover - regex admits only numbers
+        return None
 
 
 def is_transient_exception(error: BaseException) -> bool:

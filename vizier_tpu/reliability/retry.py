@@ -71,7 +71,11 @@ class RetryPolicy:
         ``on_retry(error, attempt)`` fires before each backoff (counter
         hooks). A ``deadline`` (reliability.Deadline) bounds the whole
         attempt loop: no retry is started that the remaining budget cannot
-        cover, and the last error is re-raised instead.
+        cover, and the last error is re-raised instead. An error carrying
+        a ``retry_after_ms=`` hint (an admission shed) raises the backoff
+        to at least the service's floor — shed retries must not hammer a
+        saturated fleet on the client's own (jittered, possibly tiny)
+        schedule.
         """
         attempts = max(1, self.max_attempts)
         for attempt in range(attempts):
@@ -82,6 +86,9 @@ class RetryPolicy:
                 if last_attempt or not self.is_retryable(e):
                     raise
                 delay = self.delay_for_attempt(attempt)
+                hint = errors_lib.retry_after_secs(e)
+                if hint is not None:
+                    delay = max(delay, hint)
                 if deadline is not None and deadline.remaining() <= delay:
                     raise
                 if on_retry is not None:

@@ -26,6 +26,7 @@ from vizier_tpu.service import proto_converters as pc
 from vizier_tpu.service import service_policy_supporter
 from vizier_tpu.service.protos import pythia_service_pb2, study_pb2
 from vizier_tpu.service.protos import vizier_service_pb2
+from vizier_tpu.serving import admission as admission_lib
 from vizier_tpu.serving import speculative as speculative_lib
 
 _logger = logging.getLogger(__name__)
@@ -40,6 +41,7 @@ class PythiaServicer:
         reliability_config=None,
         surrogate_config=None,
         mesh_config=None,
+        admission_config=None,
     ):
         from vizier_tpu.serving import runtime as serving_runtime_lib
 
@@ -52,13 +54,17 @@ class PythiaServicer:
         # sets the exact↔sparse auto-switch every GP designer shares;
         # ``mesh_config`` (a vizier_tpu.parallel.mesh.MeshConfig) carves
         # the devices into batch-executor placements (VIZIER_MESH*; off =
-        # the single-device seed path). None -> defaults with env-var
-        # overrides.
+        # the single-device seed path); ``admission_config`` (a
+        # vizier_tpu.serving.admission.AdmissionConfig) arms the
+        # multi-tenant overload-protection plane (VIZIER_ADMISSION*; off =
+        # the bit-identical pre-admission path). None -> defaults with
+        # env-var overrides.
         self._serving = serving_runtime_lib.ServingRuntime(
             serving_config,
             reliability=reliability_config,
             surrogates=surrogate_config,
             mesh=mesh_config,
+            admission=admission_config,
         )
         self._policy_factory = policy_factory or policy_factory_lib.DefaultPolicyFactory(
             serving_runtime=self._serving
@@ -397,13 +403,13 @@ class PythiaServicer:
             or not engine.bound
             or speculative_lib.in_speculative_compute()
         ):
-            return self._suggest_compute_live(request)
+            return self._suggest_compute_admitted(request)
         t0 = time.perf_counter()
         served = self._try_speculative_serve(engine, request)
         if served is not None:
             engine.observe_suggest_latency("hit", time.perf_counter() - t0)
             return served
-        response = self._suggest_compute_live(request)
+        response = self._suggest_compute_admitted(request)
         engine.observe_suggest_latency("miss", time.perf_counter() - t0)
         if not response.error:
             # "Cache fill" trigger (opt-in): the live compute just
@@ -411,6 +417,74 @@ class PythiaServicer:
             # client at the post-suggest frontier would receive.
             engine.notify_fill(request.study_name)
         return response
+
+    # -- multi-tenant admission (vizier_tpu.serving.admission) ---------------
+
+    def _suggest_compute_admitted(
+        self, request: pythia_service_pb2.PythiaSuggestRequest
+    ) -> pythia_service_pb2.PythiaSuggestResponse:
+        """The admission gate around the live designer computation.
+
+        With no controller (VIZIER_ADMISSION=0, the default) this is a
+        direct tail call — bit-identical to the pre-admission tree.
+        Speculative jobs bypass it too: the speculative engine has its own
+        executor-backed admission gate, and a background pre-compute must
+        never consume a live in-flight slot.
+
+        A SHED verdict returns the typed ``TRANSIENT: RESOURCE_EXHAUSTED``
+        error (retry-after hint included) WITHOUT touching the study's
+        circuit breaker — shed is a capacity condition, not a designer
+        failure. A DEGRADE verdict (sustained-overload state machine,
+        low-priority tenant) serves the seeded quasi-random fallback,
+        stamped in metadata, so the remaining compute budget goes to
+        in-SLO tenants.
+        """
+        admission = self._serving.admission
+        if admission is None or speculative_lib.in_speculative_compute():
+            return self._suggest_compute_live(request)
+        tenant = admission_lib.tenant_of(request.study_name)
+        decision = admission.decide(
+            tenant,
+            deadline_secs=float(request.deadline_secs),
+            study=request.study_name,
+        )
+        if decision.outcome == admission_lib.SHED:
+            tracing_lib.add_current_event(
+                "admission.shed", tenant=tenant, reason=decision.reason
+            )
+            response = pythia_service_pb2.PythiaSuggestResponse()
+            response.error = errors_lib.format_op_error(decision.error())
+            return response
+        if decision.outcome == admission_lib.DEGRADE:
+            tracing_lib.add_current_event("admission.degraded", tenant=tenant)
+            try:
+                config = self._parsed_study_config(request)
+            except Exception as e:  # permanent, same contract as setup
+                response = pythia_service_pb2.PythiaSuggestResponse()
+                response.error = errors_lib.format_op_error(e)
+                return response
+            response = self._fallback_response(
+                config, request, "admission_degraded"
+            )
+            self._stamp_degraded(response)
+            return response
+        with admission.in_flight(decision):
+            return self._suggest_compute_live(request)
+
+    @staticmethod
+    def _stamp_degraded(
+        response: pythia_service_pb2.PythiaSuggestResponse,
+    ) -> None:
+        """``ns "admission": degraded=quasi_random`` on every suggestion,
+        next to the reliability fallback stamp — degraded-mode serves stay
+        auditable in trial metadata."""
+        stamp = vz.Metadata()
+        stamp.ns(admission_lib.ADMISSION_NAMESPACE)[
+            admission_lib.ADMISSION_KEY
+        ] = admission_lib.ADMISSION_VALUE
+        key_values = pc.metadata_to_key_values(stamp)
+        for suggestion in response.suggestions:
+            suggestion.metadata.extend(key_values)
 
     def _suggest_compute_live(
         self, request: pythia_service_pb2.PythiaSuggestRequest
@@ -441,8 +515,12 @@ class PythiaServicer:
             response.error = errors_lib.format_op_error(e)
             return response
 
+        # from_wire, not from_budget: a NEGATIVE wire budget means the
+        # caller's deadline already expired at the sender — the dispatch
+        # check below then sheds before any designer computation runs,
+        # instead of reading "expired" as "no deadline".
         deadline = (
-            deadline_lib.Deadline.from_budget(request.deadline_secs)
+            deadline_lib.Deadline.from_wire(request.deadline_secs)
             if reliability.deadlines_on
             else deadline_lib.Deadline.none()
         )

@@ -25,6 +25,11 @@ from vizier_tpu.service.protos import study_pb2, vizier_service_pb2
 
 NO_ENDPOINT = "NO_ENDPOINT"
 
+# Hard ceiling on retry-after-paced shed retries per get_suggestions call
+# (the overall polling deadline is the real bound; this stops a pathological
+# zero-hint loop from spinning).
+_MAX_SHED_RETRIES = 100
+
 
 @dataclasses.dataclass
 class EnvironmentVariables:
@@ -239,21 +244,45 @@ class VizierClient:
             client_id=self._client_id,
             count=int(suggestion_count),
         ) as span:
-            for attempt in range(attempts):
+            attempt = 0
+            shed_retries = 0
+            while True:
                 op = self._poll_suggest_op(
                     suggestion_count, overall, deadline_secs
                 )
                 if not op.error:
                     return [pc.trial_from_proto(t) for t in op.response.trials]
-                transient = errors_lib.has_transient_marker(op.error)
-                last_attempt = attempt == attempts - 1
-                if not transient or last_attempt:
+                if not errors_lib.has_transient_marker(op.error):
                     break
-                delay = self._retry.delay_for_attempt(attempt)
+                # An admission shed carrying a retry-after hint is
+                # BACKPRESSURE, not failure: the service is pacing this
+                # client, so honoring the hint must not burn the fixed
+                # retry budget (a saturated-but-recovering fleet would
+                # otherwise fail exactly the clients it asked to wait).
+                # Shed retries are bounded by the overall polling deadline
+                # and a hard ceiling instead.
+                hint = (
+                    errors_lib.retry_after_secs(op.error)
+                    if cfg.retries_on
+                    else None
+                )
+                if hint is not None and shed_retries < _MAX_SHED_RETRIES:
+                    shed_retries += 1
+                    delay = max(self._retry.delay_for_attempt(attempt), hint)
+                    if overall.remaining() <= delay:
+                        break
+                    self._count_retry(RuntimeError(op.error), attempt)
+                    span.add_event("shed_retry", shed=shed_retries)
+                    self._retry.sleep_fn(delay)
+                    continue
+                attempt += 1
+                if attempt >= attempts:
+                    break
+                delay = self._retry.delay_for_attempt(attempt - 1)
                 if overall.remaining() <= delay:
                     break
-                self._count_retry(RuntimeError(op.error), attempt)
-                span.add_event("transient_retry", attempt=attempt)
+                self._count_retry(RuntimeError(op.error), attempt - 1)
+                span.add_event("transient_retry", attempt=attempt - 1)
                 self._retry.sleep_fn(delay)
             span.set_attribute("error", op.error.splitlines()[0][:200])
         raise RuntimeError(f"SuggestTrials failed: {op.error}")
@@ -274,7 +303,13 @@ class VizierClient:
             )
             # Never promise the service more budget than this client will
             # actually wait.
-            budget = min(budget, max(0.0, overall.remaining()))
+            budget = min(budget, overall.remaining())
+            if budget <= 0.0:
+                # The budget is already gone at send time. 0 on the wire
+                # means "no deadline", so an expired budget travels as a
+                # NEGATIVE value — the service ingress sheds it with the
+                # typed deadline error instead of computing unbounded.
+                budget = min(budget, -1e-3)
         op = self._call(
             "SuggestTrials",
             vizier_service_pb2.SuggestTrialsRequest(

@@ -243,7 +243,17 @@ class VizierServicer:
         )
         runtime = getattr(self._pythia, "serving_runtime", None)
         if runtime is not None:
-            runtime.observe_suggest_latency("service", elapsed, trace_id=trace_id)
+            tenant = None
+            if getattr(runtime, "admission", None) is not None:
+                # Per-tenant latency series (admission armed only, so the
+                # seed metric series stay byte-identical with it off):
+                # feeds the SLO engine's per-tenant p99 objective.
+                from vizier_tpu.serving import admission as admission_lib
+
+                tenant = admission_lib.tenant_of(request.parent)
+            runtime.observe_suggest_latency(
+                "service", elapsed, trace_id=trace_id, tenant=tenant
+            )
         return op
 
     def _suggest_trials(
@@ -251,6 +261,40 @@ class VizierServicer:
     ) -> vizier_service_pb2.Operation:
         study_name = request.parent
         client_id = request.client_id or "default_client_id"
+
+        # Ingress deadline check: a request whose wire budget is already
+        # expired (negative ``deadline_secs`` — the client's remaining
+        # budget at send time) must never reach Pythia: the caller has
+        # given up, so a designer computation would complete work nobody
+        # reads. Short-circuit with the typed error on a synthetic done
+        # op — no op number is consumed, nothing is persisted.
+        if self._reliability.deadlines_on and request.deadline_secs < 0:
+            stats = self._serving_stats_sink()
+            if stats is not None:
+                stats.increment("deadline_exceeded")
+            tracing_lib.add_current_event(
+                "deadline.exceeded", at="service_ingress"
+            )
+            recorder_lib.get_recorder().record(
+                study_name, "deadline_expired_at_ingress",
+                budget_secs=float(request.deadline_secs),
+            )
+            op = vizier_service_pb2.Operation(
+                name=(
+                    f"{study_name}/clients/{client_id}/operations/expired"
+                ),
+                done=True,
+            )
+            op.error = errors_lib.format_op_error(
+                errors_lib.DeadlineExceededError(
+                    errors_lib.mark_transient(
+                        "DEADLINE_EXCEEDED: request budget expired "
+                        f"{-request.deadline_secs:.3f}s before dispatch; "
+                        "designer computation skipped."
+                    )
+                )
+            )
+            return op
         with self._study_locks[study_name]:
             study = self.datastore.load_study(study_name)
             if study.state != study_pb2.Study.ACTIVE:

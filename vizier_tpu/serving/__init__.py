@@ -16,7 +16,11 @@ between requests instead:
 - :class:`ServingConfig` — the knobs (all on by default; env-overridable);
 - :class:`SpeculativeEngine` — opt-in background pre-compute of the next
   suggestion batch after each completion, served from the cache entry
-  when the frontier fingerprint still matches (``VIZIER_SPECULATIVE=1``).
+  when the frontier fingerprint still matches (``VIZIER_SPECULATIVE=1``);
+- :class:`AdmissionController` — opt-in multi-tenant overload protection
+  (fair-share admission, load shedding, deadline-aware backpressure,
+  graceful degradation) at the Pythia dispatch boundary
+  (``VIZIER_ADMISSION=1``; docs/guides/reliability.md).
 
 The runtime also owns the cross-study batch executor
 (``vizier_tpu.parallel.batch_executor``): concurrent designer computations
@@ -27,6 +31,8 @@ See ``docs/guides/serving.md`` for semantics and the intentional deviation
 from the reference's per-request cold train (PARITY.md).
 """
 
+from vizier_tpu.serving.admission import AdmissionConfig
+from vizier_tpu.serving.admission import AdmissionController
 from vizier_tpu.serving.config import ServingConfig
 from vizier_tpu.serving.coalescer import RequestCoalescer
 from vizier_tpu.serving.designer_cache import CachedDesignerEntry
@@ -38,6 +44,8 @@ from vizier_tpu.serving.speculative import SpeculativeEngine
 from vizier_tpu.serving.stats import ServingStats
 
 __all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
     "CachedDesignerEntry",
     "CachedDesignerStatePolicy",
     "DesignerStateCache",

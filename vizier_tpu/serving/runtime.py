@@ -23,6 +23,7 @@ from vizier_tpu.observability import metrics as metrics_lib
 from vizier_tpu.observability import slo as slo_lib
 from vizier_tpu.reliability import breaker as breaker_lib
 from vizier_tpu.reliability import config as reliability_config_lib
+from vizier_tpu.serving import admission as admission_lib
 from vizier_tpu.serving import coalescer as coalescer_lib
 from vizier_tpu.serving import config as config_lib
 from vizier_tpu.serving import designer_cache as cache_lib
@@ -69,6 +70,7 @@ class ServingRuntime:
         speculative: Optional[speculative_lib.SpeculativeConfig] = None,
         mesh: Optional[Any] = None,  # parallel.mesh.MeshConfig
         slo: Optional[slo_lib.SloConfig] = None,
+        admission: Optional[admission_lib.AdmissionConfig] = None,
     ):
         self.config = config or config_lib.ServingConfig.from_env()
         self.observability = (
@@ -110,6 +112,26 @@ class ServingRuntime:
             "vizier_suggest_latency_seconds",
             help="SuggestTrials wall time per hop (service, pythia).",
         )
+        # Multi-tenant overload protection (vizier_tpu.serving.admission):
+        # bounded in-flight admission + deadline-aware shedding + the
+        # healthy→shedding→degraded state machine at the Pythia dispatch
+        # boundary, and the weighted fair-share plane inside the batch
+        # executor. Off by default (VIZIER_ADMISSION=0): no controller,
+        # the bit-identical pre-admission path.
+        self.flight_recorder = recorder_lib.get_recorder()
+        admission_config = admission or admission_lib.AdmissionConfig.from_env()
+        self.admission = None
+        if admission_config.enabled:
+            self.admission = admission_lib.AdmissionController(
+                admission_config,
+                stats=self.stats,
+                metrics=(self.metrics if self.observability.metrics_on else None),
+                recorder=self.flight_recorder,
+                compute_p50_fn=lambda: self._suggest_latency.percentile(
+                    50, hop="pythia"
+                ),
+                queue_depth_fn=self._live_queue_depth,
+            )
         # JAX persistent compilation cache: survive process restarts so a
         # restarted server pays zero XLA compiles for known buckets.
         self.compilation_cache_active = False
@@ -138,6 +160,7 @@ class ServingRuntime:
                     self.metrics if self.observability.metrics_on else None
                 ),
                 mesh=self.mesh,
+                admission=self.admission,
             )
         else:
             self.mesh = mesh
@@ -159,11 +182,11 @@ class ServingRuntime:
                 executor=self.batch_executor,
             )
         # Fleet observability plane: the process-global flight recorder
-        # (no-op unless VIZIER_FLIGHT_RECORDER=1) and the SLO engine
-        # (VIZIER_SLO=1) evaluating declarative objectives over sliding
-        # windows of this runtime's metrics registry, with breach-triggered
-        # black-box dumps. Both off by default = today's behavior.
-        self.flight_recorder = recorder_lib.get_recorder()
+        # (grabbed above, no-op unless VIZIER_FLIGHT_RECORDER=1) and the
+        # SLO engine (VIZIER_SLO=1) evaluating declarative objectives over
+        # sliding windows of this runtime's metrics registry, with
+        # breach-triggered black-box dumps. Both off by default = today's
+        # behavior.
         self.slo = slo or slo_lib.SloConfig.from_env()
         self.slo_engine = None
         if self.slo.enabled:
@@ -241,15 +264,33 @@ class ServingRuntime:
         if self.batch_executor is not None:
             self.batch_executor.close()
 
+    def _live_queue_depth(self) -> int:
+        """Queued live executor slots (0 with batching off) — the
+        admission controller's deadline-shed wait estimator input."""
+        executor = self.batch_executor
+        if executor is None:
+            return 0
+        return executor.live_pending()
+
     def observe_suggest_latency(
-        self, hop: str, seconds: float, trace_id: Optional[str] = None
+        self,
+        hop: str,
+        seconds: float,
+        trace_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> None:
         """Records one suggest's wall time at a hop (no-op when metrics are
         off — the off switch must cost nothing). ``trace_id`` makes the
         observation an exemplar candidate: the hop's top-latency samples
-        keep their trace ids so an SLO breach links to real traces."""
+        keep their trace ids so an SLO breach links to real traces.
+        ``tenant`` (set by the service hop only while admission is armed)
+        splits the series per tenant so the SLO engine can hold a
+        per-tenant p99 objective; None keeps the seed series unchanged."""
         if self.observability.metrics_on:
-            self._suggest_latency.observe(seconds, trace_id=trace_id, hop=hop)
+            labels = {"hop": hop}
+            if tenant is not None:
+                labels["tenant"] = tenant
+            self._suggest_latency.observe(seconds, trace_id=trace_id, **labels)
 
     def slo_report(self) -> Dict[str, Any]:
         """Evaluates the armed SLOs now and returns the JSON-ready report
@@ -282,6 +323,14 @@ class ServingRuntime:
         out["cached_studies"] = len(self.designer_cache)
         out["open_breakers"] = self.breakers.open_count()
         return out
+
+    def admission_snapshot(self) -> Dict[str, Any]:
+        """The admission controller's JSON-ready state (per-tenant
+        sheds/admits, overload state, transitions); ``{"enabled": False}``
+        with the plane off."""
+        if self.admission is None:
+            return {"enabled": False}
+        return self.admission.snapshot()
 
     def prometheus_text(self) -> str:
         """Every serving counter + latency histogram, Prometheus format."""

@@ -44,6 +44,11 @@ class ServingStats:
         "breaker_close_transitions",
         "breaker_short_circuits",  # suggests skipped because a circuit was open
         "deadline_exceeded",  # ops completed with TRANSIENT: DEADLINE_EXCEEDED
+        # Multi-tenant overload protection (vizier_tpu.serving.admission).
+        "admission_sheds",  # requests shed with TRANSIENT: RESOURCE_EXHAUSTED
+        "admission_deadline_sheds",  # sheds because the deadline was infeasible
+        "admission_degraded",  # degraded-mode quasi-random serves
+        "admission_transitions",  # overload state-machine transitions
         # Cross-study batching (vizier_tpu.parallel.batch_executor).
         "batch_flushes",  # bucket flushes (full / timeout / drain)
         "batched_suggests",  # slots served from a shared vmapped program
