@@ -1,0 +1,212 @@
+"""testing/netchaos.py: seeded per-link drop/delay/duplicate/partition."""
+
+import pytest
+
+from vizier_tpu.testing import chaos as chaos_lib
+from vizier_tpu.testing import netchaos
+
+
+class TestLinkSchedule:
+    def test_same_seed_same_fault_sequence(self):
+        outcomes = []
+        for _ in range(2):
+            net = netchaos.NetChaos(seed=7)
+            net.set_link("a", "b", drop_prob=0.4)
+            fn = net.wrap(lambda: "ok", "a", "b")
+            run = []
+            for _ in range(40):
+                try:
+                    run.append(fn())
+                except netchaos.LinkDroppedError:
+                    run.append("drop")
+            outcomes.append(run)
+        assert outcomes[0] == outcomes[1]
+        assert "drop" in outcomes[0] and "ok" in outcomes[0]
+
+    def test_drop_raises_transport_shaped(self):
+        net = netchaos.NetChaos(seed=0)
+        net.set_link("a", "b", drop_prob=1.0)
+        fn = net.wrap(lambda: "ok", "a", "b")
+        with pytest.raises(ConnectionError):
+            fn()
+
+    def test_delay_sleeps_through_injected_fn(self):
+        slept = []
+        net = netchaos.NetChaos(seed=0, sleep_fn=slept.append)
+        net.set_link("a", "b", delay_prob=1.0, delay_secs=0.25)
+        fn = net.wrap(lambda: "ok", "a", "b")
+        assert fn() == "ok"
+        assert slept == [0.25]
+
+    def test_duplicate_runs_delegate_twice(self):
+        calls = []
+        net = netchaos.NetChaos(seed=0)
+        net.set_link("a", "b", duplicate_prob=1.0)
+        fn = net.wrap(lambda: calls.append(1) or len(calls), "a", "b")
+        assert fn() == 2  # second copy's outcome is what the caller sees
+        assert len(calls) == 2
+
+    def test_wildcard_rules_match_any_node(self):
+        net = netchaos.NetChaos(seed=0)
+        net.set_link("a", "*", drop_prob=1.0)
+        with pytest.raises(netchaos.LinkDroppedError):
+            net.strike("a", "anything")
+        net.strike("b", "anything")  # other sources unaffected
+
+    def test_exact_rule_beats_wildcard(self):
+        net = netchaos.NetChaos(seed=0)
+        net.set_link("*", "*", drop_prob=1.0)
+        net.set_link("a", "b", drop_prob=0.0)
+        net.strike("a", "b")  # exact rule: clean link
+        with pytest.raises(netchaos.LinkDroppedError):
+            net.strike("a", "c")
+
+    def test_counts_account_every_site(self):
+        net = netchaos.NetChaos(seed=3)
+        net.set_link("a", "b", drop_prob=1.0)
+        for _ in range(3):
+            with pytest.raises(netchaos.LinkDroppedError):
+                net.strike("a", "b")
+        net.strike("b", "a")
+        counts = net.counts()
+        assert counts["a>b"] == {
+            "calls": 3,
+            "drops": 3,
+            "delays": 0,
+            "duplicates": 0,
+            "partitioned": 0,
+        }
+        assert counts["b>a"]["calls"] == 1
+        assert net.total("drops") == 3
+
+
+class TestPartitions:
+    def test_node_partition_isolates_both_directions(self):
+        net = netchaos.NetChaos(seed=0)
+        net.partition("b")
+        with pytest.raises(netchaos.PartitionedError):
+            net.strike("a", "b")
+        with pytest.raises(netchaos.PartitionedError):
+            net.strike("b", "a")
+        net.heal("b")
+        net.strike("a", "b")
+        net.strike("b", "a")
+
+    def test_directional_link_partition_is_asymmetric(self):
+        net = netchaos.NetChaos(seed=0)
+        net.partition_link("a", "b")
+        with pytest.raises(netchaos.PartitionedError):
+            net.strike("a", "b")
+        net.strike("b", "a")  # reverse direction unaffected
+        net.heal_link("a", "b")
+        net.strike("a", "b")
+
+    def test_heal_node_clears_directional_links_touching_it(self):
+        net = netchaos.NetChaos(seed=0)
+        net.partition_link("a", "b")
+        net.heal("b")
+        assert not net.is_partitioned("a", "b")
+
+    def test_partition_draws_keep_rng_stream_aligned(self):
+        # A partition window must not consume a different number of RNG
+        # variates than a clean call: the post-heal fault sequence stays
+        # a pure function of (seed, call index).
+        def run(partition_first: bool):
+            net = netchaos.NetChaos(seed=9)
+            net.set_link("a", "b", drop_prob=0.5)
+            if partition_first:
+                net.partition("b")
+                for _ in range(5):
+                    with pytest.raises(netchaos.PartitionedError):
+                        net.strike("a", "b")
+                net.heal("b")
+            else:
+                for _ in range(5):
+                    try:
+                        net.strike("a", "b")
+                    except netchaos.LinkDroppedError:
+                        pass
+            out = []
+            for _ in range(10):
+                try:
+                    net.strike("a", "b")
+                    out.append("ok")
+                except netchaos.LinkDroppedError:
+                    out.append("drop")
+            return out
+
+        assert run(True) == run(False)
+
+
+class TestStubWrapping:
+    class _Stub:
+        def Suggest(self, request):
+            return ("served", request)
+
+        def Other(self, request):
+            return "other"
+
+    def test_wrap_stub_strikes_listed_methods_only(self):
+        net = netchaos.NetChaos(seed=0)
+        net.partition("replica-0")
+        stub = net.wrap_stub(
+            self._Stub(), "client", "replica-0", methods=["Suggest"]
+        )
+        with pytest.raises(netchaos.PartitionedError):
+            stub.Suggest("r")
+        assert stub.Other("r") == "other"  # unlisted: clean passthrough
+
+    def test_wrap_stub_default_wraps_all_public_callables(self):
+        net = netchaos.NetChaos(seed=0)
+        net.partition("replica-0")
+        stub = net.wrap_stub(self._Stub(), "client", "replica-0")
+        with pytest.raises(netchaos.PartitionedError):
+            stub.Other("r")
+
+    def test_composes_with_chaos_monkey(self):
+        # Both injectors wrap the same call and draw from independent
+        # seeded streams: netchaos partitions the link while ChaosMonkey
+        # would have struck the RPC — the outer wrapper wins first.
+        monkey = chaos_lib.ChaosMonkey(seed=1, failure_prob=1.0)
+        chaos_stub = chaos_lib.ChaosServiceStub(
+            self._Stub(), monkey, methods=("Suggest",)
+        )
+        net = netchaos.NetChaos(seed=2)
+        stub = net.wrap_stub(chaos_stub, "client", "replica-0")
+        net.partition("replica-0")
+        with pytest.raises(netchaos.PartitionedError):
+            stub.Suggest("r")
+        net.heal("replica-0")
+        with pytest.raises(chaos_lib.InjectedFaultError):
+            stub.Suggest("r")
+
+
+class TestSpecParsing:
+    def test_full_spec_round_trip(self):
+        net = netchaos.NetChaos.from_spec(
+            "seed=9;drop=a>b:0.25;delay=a>*:0.05@0.3;dup=x>y:0.1;"
+            "partition=c;partition=m>n"
+        )
+        assert net.seed == 9
+        rule = net._rule_for("a", "b")
+        assert rule.drop_prob == 0.25
+        assert net._rule_for("a", "z").delay_secs == 0.05
+        assert net._rule_for("x", "y").duplicate_prob == 0.1
+        assert net.is_partitioned("c", "anything")
+        assert net.is_partitioned("m", "n")
+        assert not net.is_partitioned("n", "m")
+
+    def test_delay_prob_defaults_to_one(self):
+        net = netchaos.NetChaos.from_spec("delay=a>b:0.5")
+        rule = net._rule_for("a", "b")
+        assert rule.delay_secs == 0.5 and rule.delay_prob == 1.0
+
+    def test_bad_directives_raise(self):
+        with pytest.raises(ValueError):
+            netchaos.NetChaos.from_spec("drop=a:0.5")  # no '>'
+        with pytest.raises(ValueError):
+            netchaos.NetChaos.from_spec("frobnicate=a>b:1")
+
+    def test_probabilities_validated(self):
+        with pytest.raises(ValueError):
+            netchaos.NetChaos(seed=0).set_link("a", "b", drop_prob=1.5)

@@ -18,7 +18,26 @@ injected fault that reaches the client.
 
 Usage:  python tools/chaos_ab.py [--trials 50] [--seed 11] [--fault-prob 0.1]
         [--distributed N] [--kill-at K] [--no-shared-fs]
+        [--replica-mode subprocess] [--partition]
         [--instrument-locks] [--mesh-devices N]
+
+``--replica-mode subprocess`` (with ``--distributed``) adds the
+**subprocess_partition** arm: an N-replica fleet of REAL ``replica_main``
+processes managed by the lease-based ``SubprocessReplicaManager`` —
+standby logs stream between processes over the ``ReplicationService``
+gRPC surface, heartbeat leases detect death, and failover replays from
+standby logs collected over the wire. The schedule SIGKILLs the owner
+mid-run and (with ``--partition``) later partitions the next owner away
+from the driver via ``testing/netchaos.py`` (heartbeats and client RPCs
+drop; the replica itself keeps running), heals the partition, and drives
+one stale append directly at the zombie. The verdict asserts all trials
+completed, zero lost studies (every driven trial accounted through the
+failed-over tier, the zombie's stale trial NOT among them), >= 1 standby
+recovery, and >= 1 fenced stale-append rejection observed via heartbeat.
+The same invocation also runs the **replication_off_identity** check:
+the in-process kill-the-owner arm under ``VIZIER_DISTRIBUTED_REPLICATION
+=0`` must produce a bit-identical suggestion trajectory to the
+replication-on arm (the off switch IS the PR 12 legacy path).
 
 ``--no-shared-fs`` (with ``--distributed``) adds the **replicated_failover**
 arm: same kill-the-owner schedule, but the dead replica's WAL directory is
@@ -251,6 +270,7 @@ def run_distributed_arm(
     completed = fallback_trials = 0
     error = None
     killed = False
+    trajectory = []  # per-trial suggested parameters (bit-identity checks)
     start = time.perf_counter()
     try:
         for i in range(trials):
@@ -269,6 +289,14 @@ def run_distributed_arm(
             t0 = time.perf_counter()
             (trial,) = client.get_suggestions(1)
             suggest_hist.observe(time.perf_counter() - t0)
+            trajectory.append(
+                tuple(
+                    sorted(
+                        (name, round(float(value), 12))
+                        for name, value in trial.parameters.as_dict().items()
+                    )
+                )
+            )
             if is_fallback_suggestion(trial.metadata):
                 fallback_trials += 1
             client.complete_trial(
@@ -286,7 +314,13 @@ def run_distributed_arm(
     stats = manager.serving_stats()
     owner_after = manager.router.replica_for(study_name)
     manager.shutdown()
+    import hashlib
+
     return {
+        "trajectory_sha256": hashlib.sha256(
+            repr(trajectory).encode("utf-8")
+        ).hexdigest(),
+        "_trajectory": trajectory,  # popped before JSON (identity checks)
         "completed_trials": completed,
         "target_trials": trials,
         "failed": error is not None,
@@ -312,6 +346,232 @@ def run_distributed_arm(
             if isinstance(v, int) and v
         },
         "injected": monkey.counts(),
+    }
+
+
+def run_replication_off_identity(
+    *,
+    trials: int,
+    seed: int,
+    fault_prob: float,
+    reliability: ReliabilityConfig,
+    num_replicas: int,
+    kill_at: int,
+) -> dict:
+    """``VIZIER_DISTRIBUTED_REPLICATION=0`` must BE the legacy path.
+
+    Runs the in-process kill-the-owner arm twice — replication on (the
+    default) and off (the PR 12 local-disk failover) — on the same seeded
+    schedule and asserts the suggestion trajectories are bit-identical:
+    the replication plane is pure redundancy, invisible to what clients
+    are served, and the off switch reproduces the legacy path exactly.
+    """
+    import unittest.mock
+
+    arms = {}
+    trajectories = {}
+    for name, value in (("replication_on", "1"), ("replication_off", "0")):
+        with unittest.mock.patch.dict(
+            os.environ, {"VIZIER_DISTRIBUTED_REPLICATION": value}
+        ):
+            result = run_distributed_arm(
+                trials=trials,
+                seed=seed,
+                fault_prob=fault_prob,
+                reliability=reliability,
+                num_replicas=num_replicas,
+                kill_at=kill_at,
+            )
+        trajectories[name] = result.pop("_trajectory")
+        arms[name] = {
+            "completed_trials": result["completed_trials"],
+            "failed": result["failed"],
+            "recovery_sources": result["recovery_sources"],
+            "replication_armed": bool(result["replication"]),
+            "trajectory_sha256": result["trajectory_sha256"],
+        }
+    return {
+        "arms": arms,
+        "bit_identical": trajectories["replication_on"]
+        == trajectories["replication_off"],
+    }
+
+
+def run_subprocess_partition_arm(
+    *,
+    trials: int,
+    seed: int,
+    num_replicas: int,
+    kill_at: int,
+    partition: bool,
+    lease_timeout_s: float = 1.0,
+    heartbeat_interval_s: float = 0.1,
+) -> dict:
+    """Kill-the-owner + partition-then-heal against REAL replica processes.
+
+    The schedule: at ``kill_at`` the owning ``replica_main`` process is
+    SIGKILLed (lease expiry / the routed stub's dead-process check detects
+    it; failover replays from standby logs collected over gRPC); with
+    ``partition`` armed, at ``kill_at + (trials - kill_at) // 3`` the NEXT
+    owner is partitioned away from the driver (netchaos severs heartbeats
+    and client RPCs; the process keeps running), the lease expires, the
+    manager fences the zombie's epoch everywhere reachable and fails its
+    studies over; the window heals two-thirds in, and one stale append is
+    driven directly at the zombie — its delivery must be REJECTED by the
+    fenced standby stores (counted via heartbeat) and must NOT surface in
+    the routed tier's final listing (no split-brain write wins).
+    """
+    import tempfile
+
+    from vizier_tpu.distributed import subprocess_fleet
+    from vizier_tpu.service import grpc_stubs
+    from vizier_tpu.service.protos import (
+        replication_service_pb2 as rpb,
+        study_pb2,
+    )
+    from vizier_tpu.testing import netchaos as netchaos_lib
+
+    wal_root = tempfile.mkdtemp(prefix="vizier-chaos-subproc-")
+    net = netchaos_lib.NetChaos(seed=seed)
+    fleet = subprocess_fleet.SubprocessReplicaManager(
+        num_replicas,
+        wal_root=wal_root,
+        netchaos=net,
+        lease_timeout_s=lease_timeout_s,
+        heartbeat_interval_s=heartbeat_interval_s,
+    )
+    study_name = "owners/chaos/studies/subproc-ab"
+    partition_at = kill_at + max(1, (trials - kill_at) // 3)
+    heal_at = kill_at + max(2, 2 * (trials - kill_at) // 3)
+    # Client-side reliability must ride out a full lease expiry plus the
+    # failover replay before its attempts run dry.
+    reliability = ReliabilityConfig(
+        retry_max_attempts=16,
+        retry_base_delay_secs=0.1,
+        retry_max_delay_secs=0.5,
+        breaker_window_secs=0.5,
+        breaker_cooldown_secs=0.2,
+    )
+    owners: list = []
+    partitioned_replica = None
+    stale_trial_id = 10_000 + trials
+    error = None
+    completed = 0
+    fenced_rejections = 0
+    suggest_hist = MetricsRegistry().histogram(
+        "chaos_suggest_latency_seconds", help="chaos_ab per-suggest wall time"
+    )
+    start = time.perf_counter()
+    try:
+        fleet.stub.CreateStudy(
+            vizier_service_pb2.CreateStudyRequest(
+                parent="owners/chaos",
+                study=pc.study_to_proto(_study_config(), study_name),
+            )
+        )
+        client = vizier_client.VizierClient(
+            fleet.stub, study_name, "chaos-worker", reliability=reliability
+        )
+        owners.append(fleet.owner_of(study_name))
+        for i in range(trials):
+            if i == kill_at:
+                fleet.kill_replica(owners[-1])
+            if partition and i == partition_at:
+                owner_now = fleet.owner_of(study_name)
+                # Pin the partition to an acked-replication boundary (the
+                # client is sequential, so this is exact): replication is
+                # asynchronous, and the partition must test FENCING, not
+                # whether an arbitrary in-flight batch won a race.
+                fleet._control.call_once(
+                    owner_now,
+                    "FlushStream",
+                    rpb.FlushStreamRequest(timeout_secs=5.0),
+                )
+                fleet.partition_replica(owner_now)
+                partitioned_replica = owner_now
+            if partition and i == heal_at and partitioned_replica is not None:
+                fleet.heal_partition(partitioned_replica)
+                # The zombie still serves its (stale) study copy: a
+                # client with stale routing writes one trial directly at
+                # it. The append lands in the zombie's local WAL, its
+                # streamer delivers — and every fenced standby store
+                # rejects the dead generation.
+                zombie_stub = grpc_stubs.create_vizier_stub(
+                    fleet.endpoint_of(partitioned_replica)
+                )
+                zombie_stub.CreateTrial(
+                    vizier_service_pb2.CreateTrialRequest(
+                        parent=study_name,
+                        trial=study_pb2.Trial(
+                            name=f"{study_name}/trials/{stale_trial_id}"
+                        ),
+                    )
+                )
+            t0 = time.perf_counter()
+            (trial,) = client.get_suggestions(1)
+            suggest_hist.observe(time.perf_counter() - t0)
+            client.complete_trial(
+                trial.id, vz.Measurement(metrics={"obj": 0.01 * i})
+            )
+            completed += 1
+            current = fleet.owner_of(study_name)
+            if current != owners[-1]:
+                owners.append(current)
+        # The fenced rejection is observed via heartbeat from whichever
+        # live replica the zombie's delivery reached; give the zombie's
+        # streamer a bounded window to drain and be fenced.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            fleet.check_health()
+            fenced_rejections = fleet.serving_stats()["replication"][
+                "fenced_rejections"
+            ]
+            if not partition or fenced_rejections >= 1:
+                break
+            time.sleep(0.25)
+        listed = client.list_trials()
+        listed_ids = sorted(t.id for t in listed)
+    except Exception as e:
+        error = f"{type(e).__name__}: {e}"
+        listed, listed_ids = [], []
+    elapsed = time.perf_counter() - start
+    stats = fleet.serving_stats()
+    fleet.shutdown()
+
+    def _ms(q: float):
+        value = suggest_hist.percentile(q)
+        return round(value * 1000.0, 2) if value is not None else None
+
+    return {
+        "completed_trials": completed,
+        "target_trials": trials,
+        "failed": error is not None,
+        "error": error,
+        "replicas": num_replicas,
+        "replica_mode": "subprocess",
+        "wal_root": wal_root,
+        "owner_chain": owners,
+        "killed_replica": owners[0] if owners else None,
+        "killed_at_trial": kill_at,
+        "partitioned_replica": partitioned_replica,
+        "partitioned_at_trial": partition_at if partition else None,
+        "healed_at_trial": heal_at if partition else None,
+        "lease_timeout_s": lease_timeout_s,
+        "heartbeat_interval_s": heartbeat_interval_s,
+        "failovers": stats["failovers"],
+        "restored_studies": stats["restored_studies"],
+        "recovery_sources": stats["recovery_sources"],
+        "fenced_rejections": fenced_rejections,
+        "stale_append_rejected": bool(partition)
+        and stale_trial_id not in listed_ids,
+        "listed_trials": len(listed),
+        "zero_lost": len(listed) == completed
+        and stale_trial_id not in listed_ids,
+        "router": stats["router"],
+        "leases": stats["leases"],
+        "netchaos": net.counts(),
+        "elapsed_secs": round(elapsed, 3),
+        "suggest_latency_ms": {"p50": _ms(50), "p95": _ms(95), "p99": _ms(99)},
     }
 
 
@@ -777,6 +1037,22 @@ def main() -> None:
         "logs (the shared-nothing durability proof)",
     )
     parser.add_argument(
+        "--replica-mode",
+        choices=("inprocess", "subprocess"),
+        default="inprocess",
+        help="with --distributed: 'subprocess' adds the "
+        "subprocess_partition arm (real replica_main processes, "
+        "lease-based failure detection, cross-process standby "
+        "replication) plus the replication-off bit-identity check",
+    )
+    parser.add_argument(
+        "--partition",
+        action="store_true",
+        help="with --replica-mode subprocess: add a partition-then-heal "
+        "window (netchaos) on the post-failover owner, and assert the "
+        "healed zombie's stale append is fenced out",
+    )
+    parser.add_argument(
         "--mesh-devices",
         type=int,
         default=0,
@@ -886,6 +1162,7 @@ def main() -> None:
                 num_replicas=args.distributed,
                 kill_at=kill_at,
             )
+            report["arms"]["distributed_failover"].pop("_trajectory", None)
             if args.no_shared_fs:
                 print(
                     "[chaos_ab] running arm: replicated_failover "
@@ -900,6 +1177,37 @@ def main() -> None:
                     num_replicas=args.distributed,
                     kill_at=kill_at,
                     delete_wal_dir=True,
+                )
+                report["arms"]["replicated_failover"].pop("_trajectory", None)
+            if args.replica_mode == "subprocess":
+                print(
+                    "[chaos_ab] running check: replication_off_identity "
+                    f"({args.distributed} replicas, in-process, "
+                    "VIZIER_DISTRIBUTED_REPLICATION=0 vs 1)"
+                )
+                report["replication_off_identity"] = (
+                    run_replication_off_identity(
+                        trials=args.trials,
+                        seed=args.seed,
+                        fault_prob=args.fault_prob,
+                        reliability=arms["reliability_on"],
+                        num_replicas=args.distributed,
+                        kill_at=kill_at,
+                    )
+                )
+                print(
+                    f"[chaos_ab] running arm: subprocess_partition "
+                    f"({args.distributed} replica_main processes, kill at "
+                    f"trial {kill_at}, partition={args.partition})"
+                )
+                report["arms"]["subprocess_partition"] = (
+                    run_subprocess_partition_arm(
+                        trials=args.trials,
+                        seed=args.seed,
+                        num_replicas=args.distributed,
+                        kill_at=kill_at,
+                        partition=args.partition,
+                    )
                 )
         if args.mesh_devices:
             print(
@@ -970,6 +1278,40 @@ def main() -> None:
                 and repl["dead_wal_dir_deleted"]
                 and standby_recoveries >= 1
             )
+        if args.replica_mode == "subprocess":
+            identity = report["replication_off_identity"]
+            sub = report["arms"]["subprocess_partition"]
+            subprocess_standby = int(
+                sub["recovery_sources"].get("standby", 0)
+            )
+            report["verdict"].update(
+                {
+                    "subprocess_completed_all": sub["completed_trials"]
+                    == args.trials,
+                    "subprocess_zero_lost": sub["zero_lost"],
+                    "subprocess_standby_recoveries": subprocess_standby,
+                    "subprocess_fenced_rejections": sub[
+                        "fenced_rejections"
+                    ],
+                    "subprocess_stale_append_rejected": sub[
+                        "stale_append_rejected"
+                    ],
+                    "replication_off_bit_identical": identity[
+                        "bit_identical"
+                    ],
+                }
+            )
+            ok = ok and (
+                sub["completed_trials"] == args.trials
+                and sub["zero_lost"]
+                and subprocess_standby >= 1
+                and identity["bit_identical"]
+            )
+            if args.partition:
+                ok = ok and (
+                    sub["fenced_rejections"] >= 1
+                    and sub["stale_append_rejected"]
+                )
     if args.mesh_devices:
         mesh_arm = report["arms"]["mesh_executor"]
         report["verdict"].update(

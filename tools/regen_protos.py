@@ -8,8 +8,17 @@ to those bytes with the protobuf runtime itself: parse the serialized file,
 append the new ``FieldDescriptorProto``s, reserialize, and rewrite the pb2
 module around the new bytes.
 
-The surgery is declarative: ``_NEW_FIELDS`` below mirrors what the ``.proto``
-sources say, and applying it twice is a no-op. Run from the repo root:
+Two declarative tables drive the surgery, both mirroring what the
+``.proto`` sources say, and applying either twice is a no-op:
+
+- ``_NEW_FIELDS`` — additive fields on EXISTING messages (the PR 2/3
+  deadline/trace-context additions);
+- ``_NEW_FILES``  — whole new message files synthesized from scratch (the
+  ``FileDescriptorProto`` is built field by field with the protobuf
+  runtime and serialized exactly as protoc would have): the
+  ``replication_service`` surface lands this way.
+
+Run from the repo root:
 
     python tools/regen_protos.py
 """
@@ -30,6 +39,13 @@ PROTO_DIR = pathlib.Path(__file__).resolve().parent.parent / (
 # file stem -> message name -> [(field name, number, type enum)]
 _DOUBLE = descriptor_pb2.FieldDescriptorProto.TYPE_DOUBLE
 _STRING = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+_BOOL = descriptor_pb2.FieldDescriptorProto.TYPE_BOOL
+_BYTES = descriptor_pb2.FieldDescriptorProto.TYPE_BYTES
+_UINT32 = descriptor_pb2.FieldDescriptorProto.TYPE_UINT32
+_UINT64 = descriptor_pb2.FieldDescriptorProto.TYPE_UINT64
+_MESSAGE = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+_OPTIONAL = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+_REPEATED = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
 _NEW_FIELDS = {
     "vizier_service": {
         # deadline_secs: remaining client deadline budget in seconds (0 = no
@@ -46,6 +62,97 @@ _NEW_FIELDS = {
             ("deadline_secs", 5, _DOUBLE),
             ("trace_context", 6, _STRING),
         ],
+    },
+}
+
+# -- whole-file synthesis -----------------------------------------------------
+#
+# file stem -> ordered message table. Field spec:
+#   (name, number, type, label, message type name or None)
+# Message-typed fields reference siblings in the same file by bare name.
+_R = "ReplicationRecord"
+_NEW_FILES = {
+    # The cross-process replication surface (vizier_tpu.ReplicationService,
+    # served by replica_main next to VizierService; see
+    # vizier_tpu/distributed/replication_service.py). DeliverAppends /
+    # Baseline carry the standby-log write protocol (epoch-fenced;
+    # ``value`` is the acked last-seq on acceptance, the fencing epoch on
+    # rejection); Fence raises an origin's epoch without data (the revive/
+    # failover cutover); Heartbeat is the lease-renewal probe and
+    # piggybacks the receiver's fencing/resync counters; ExportStandby /
+    # ExportState / ApplyRecords are the recovery-plan plumbing a manager
+    # drives failover and revive copy-back through; Resync and FlushStream
+    # poke the replica's origin-side streamer.
+    "replication_service": {
+        "package": "vizier_tpu",
+        "messages": {
+            _R: [
+                ("seq", 1, _UINT64, _OPTIONAL, None),
+                ("opcode", 2, _UINT32, _OPTIONAL, None),
+                ("payload", 3, _BYTES, _OPTIONAL, None),
+            ],
+            "DeliverAppendsRequest": [
+                ("origin", 1, _STRING, _OPTIONAL, None),
+                ("epoch", 2, _UINT64, _OPTIONAL, None),
+                ("records", 3, _MESSAGE, _REPEATED, _R),
+                ("reset", 4, _BOOL, _OPTIONAL, None),
+                ("baseline_seq", 5, _UINT64, _OPTIONAL, None),
+            ],
+            "DeliverAppendsResponse": [
+                ("accepted", 1, _BOOL, _OPTIONAL, None),
+                ("value", 2, _UINT64, _OPTIONAL, None),
+            ],
+            "FenceRequest": [
+                ("origin", 1, _STRING, _OPTIONAL, None),
+                ("epoch", 2, _UINT64, _OPTIONAL, None),
+            ],
+            "FenceResponse": [
+                ("epoch", 1, _UINT64, _OPTIONAL, None),
+            ],
+            "HeartbeatRequest": [
+                ("sender", 1, _STRING, _OPTIONAL, None),
+            ],
+            "HeartbeatResponse": [
+                ("replica_id", 1, _STRING, _OPTIONAL, None),
+                ("seq", 2, _UINT64, _OPTIONAL, None),
+                ("fenced_rejections", 3, _UINT64, _OPTIONAL, None),
+                ("resyncs", 4, _UINT64, _OPTIONAL, None),
+            ],
+            "ExportStandbyRequest": [
+                ("origin", 1, _STRING, _OPTIONAL, None),
+            ],
+            "ExportStandbyResponse": [
+                ("present", 1, _BOOL, _OPTIONAL, None),
+                ("baseline_seq", 2, _UINT64, _OPTIONAL, None),
+                ("epoch", 3, _UINT64, _OPTIONAL, None),
+                ("records", 4, _MESSAGE, _REPEATED, _R),
+            ],
+            "ExportStateRequest": [
+                ("studies", 1, _STRING, _REPEATED, None),
+            ],
+            "ExportStateResponse": [
+                ("seq", 1, _UINT64, _OPTIONAL, None),
+                ("records", 2, _MESSAGE, _REPEATED, _R),
+            ],
+            "ApplyRecordsRequest": [
+                ("records", 1, _MESSAGE, _REPEATED, _R),
+            ],
+            "ApplyRecordsResponse": [
+                ("applied", 1, _UINT32, _OPTIONAL, None),
+            ],
+            "ResyncRequest": [
+                ("successor", 1, _STRING, _OPTIONAL, None),
+            ],
+            "ResyncResponse": [
+                ("requested", 1, _BOOL, _OPTIONAL, None),
+            ],
+            "FlushStreamRequest": [
+                ("timeout_secs", 1, _DOUBLE, _OPTIONAL, None),
+            ],
+            "FlushStreamResponse": [
+                ("flushed", 1, _BOOL, _OPTIONAL, None),
+            ],
+        },
     },
 }
 
@@ -80,9 +187,103 @@ if _descriptor._USE_C_DESCRIPTORS == False:
 '''
 
 
+_HEADER_STANDALONE = '''\
+# -*- coding: utf-8 -*-
+# Generated by the protocol buffer compiler.  DO NOT EDIT!
+# (Synthesized by tools/regen_protos.py — descriptor surgery in lieu of
+# protoc, which is not available in this image.)
+# source: {stem}.proto
+"""Generated protocol buffer code."""
+from google.protobuf.internal import builder as _builder
+from google.protobuf import descriptor as _descriptor
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+# @@protoc_insertion_point(imports)
+
+_sym_db = _symbol_database.Default()
+
+
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile({payload})
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, '{stem}_pb2', globals())
+if _descriptor._USE_C_DESCRIPTORS == False:
+
+  DESCRIPTOR._options = None
+# @@protoc_insertion_point(module_scope)
+'''
+
+
 def _json_name(name: str) -> str:
     head, *rest = name.split("_")
     return head + "".join(part.capitalize() for part in rest)
+
+
+def _synthesize(stem: str, spec: dict) -> bytes:
+    """Builds the serialized ``FileDescriptorProto`` for a ``_NEW_FILES``
+    entry — exactly what protoc would have emitted for the ``.proto``."""
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = f"{stem}.proto"
+    fdp.package = spec["package"]
+    fdp.syntax = "proto3"
+    for message_name, fields in spec["messages"].items():
+        message = fdp.message_type.add(name=message_name)
+        for name, number, ftype, label, type_name in fields:
+            field = message.field.add(
+                name=name,
+                number=number,
+                type=ftype,
+                label=label,
+                json_name=_json_name(name),
+            )
+            if type_name is not None:
+                field.type_name = f".{spec['package']}.{type_name}"
+    return fdp.SerializeToString()
+
+
+def regen_new_file(stem: str) -> bool:
+    """Writes (or refreshes) a synthesized ``<stem>_pb2.py``.
+
+    Returns True when the module was (re)written (False = already
+    byte-identical to the declared schema).
+    """
+    spec = _NEW_FILES[stem]
+    payload = _synthesize(stem, spec)
+    pb2_path = PROTO_DIR / f"{stem}_pb2.py"
+    if pb2_path.exists():
+        current = _extract_serialized(pb2_path.read_text(), stem)
+        if current == payload:
+            return False
+        existing = descriptor_pb2.FileDescriptorProto.FromString(current)
+        declared = descriptor_pb2.FileDescriptorProto.FromString(payload)
+        for message in existing.message_type:
+            target = next(
+                (m for m in declared.message_type if m.name == message.name),
+                None,
+            )
+            if target is None:
+                raise SystemExit(
+                    f"{stem}.{message.name} exists on disk but not in the "
+                    "declared schema; refusing to drop a message."
+                )
+            for field in message.field:
+                new = next(
+                    (f for f in target.field if f.name == field.name), None
+                )
+                if new is None or new.number != field.number or (
+                    new.type != field.type
+                ):
+                    raise SystemExit(
+                        f"{stem}.{message.name}.{field.name} changed "
+                        "number/type; refusing to rewrite it (wire "
+                        "compatibility)."
+                    )
+    pb2_path.write_text(
+        _HEADER_STANDALONE.format(stem=stem, payload=repr(payload))
+    )
+    return True
 
 
 def _extract_serialized(source: str, stem: str) -> bytes:
@@ -135,6 +336,9 @@ def regen(stem: str) -> bool:
 
 def main() -> None:
     rewritten = [stem for stem in sorted(_NEW_FIELDS) if regen(stem)]
+    rewritten += [
+        stem for stem in sorted(_NEW_FILES) if regen_new_file(stem)
+    ]
     if rewritten:
         print(f"Rewrote: {', '.join(f'{s}_pb2.py' for s in rewritten)}")
     else:

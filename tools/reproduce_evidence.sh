@@ -32,13 +32,17 @@ echo "== 3b. failover chaos: kill one replica mid-study (~1 min) =="
 #    complete via router failover + WAL handoff), the replicated_failover
 #    arm (--no-shared-fs: the dead replica's WAL directory is DELETED at
 #    the kill; 50/50 still completes via the successors' replication
-#    standby logs), the mesh_executor arm (device-program failure
-#    isolated to ONE placement of an 8-device mesh), and the runtime
-#    lock-order cross-check — now including the per-placement mesh
-#    dispatch workers AND the replication streamer threads — vs the
-#    static graph
+#    standby logs), the subprocess_partition arm (real replica_main
+#    processes with lease-based failure detection: SIGKILL the owner AND
+#    a netchaos partition-then-heal window; standby recovery over gRPC,
+#    fenced stale-append rejection, replication-off bit-identity), the
+#    mesh_executor arm (device-program failure isolated to ONE placement
+#    of an 8-device mesh), and the runtime lock-order cross-check — now
+#    including the per-placement mesh dispatch workers, the replication
+#    streamer threads, AND the subprocess fleet's lease/netchaos locks —
+#    vs the static graph
 JAX_PLATFORMS=cpu python tools/chaos_ab.py --distributed 4 --mesh-devices 8 \
-  --no-shared-fs --instrument-locks
+  --no-shared-fs --replica-mode subprocess --partition --instrument-locks
 
 echo "== 3b3. SLO-armed observability soak (~2 min) =="
 #    -> OBSERVABILITY_E2E.json (v2): 2-replica tier with SLOs armed +

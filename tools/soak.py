@@ -102,8 +102,20 @@ def main() -> None:
                         help="override the scenario study count")
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--scale", type=float, default=None)
-    parser.add_argument("--target", choices=("inprocess", "replicas"),
+    parser.add_argument("--target", choices=("inprocess", "replicas", "subprocess"),
                         default=None)
+    parser.add_argument(
+        "--replica-mode",
+        choices=("inprocess", "subprocess"),
+        default="inprocess",
+        help="'subprocess' runs the replica tier as REAL replica_main "
+        "processes behind the lease-based SubprocessReplicaManager "
+        "(cross-process standby replication over gRPC; kill/revive are "
+        "SIGKILL + fenced restart) — the severity track against real "
+        "processes. Parity/bit-identity assertions are waived for this "
+        "mode (per-study seeding cannot cross the process boundary); "
+        "the in-process default keeps them, and stays the tier-1 shape.",
+    )
     parser.add_argument("--replicas", type=int, default=0)
     parser.add_argument("--concurrency", type=int, default=0)
     parser.add_argument(
@@ -180,6 +192,10 @@ def main() -> None:
         overrides["num_studies"] = args.studies
     if args.target:
         overrides["target"] = args.target
+    if args.replica_mode == "subprocess" and overrides.get(
+        "target", "replicas"
+    ) != "inprocess":
+        overrides["target"] = "subprocess"
     if args.replicas:
         overrides["replicas"] = args.replicas
     if args.concurrency:
@@ -189,6 +205,13 @@ def main() -> None:
 
     base = models.smoke_config if args.smoke else models.soak_config
     config = base(**{**_env_overrides(), **overrides})
+    if config.target == "subprocess" and not args.skip_reference:
+        # Parity/bit-identity are waived for subprocess tiers (see
+        # --replica-mode help); the sequential arms would only burn the
+        # wall clock the real-process severity track needs.
+        args.skip_reference = True
+        print("[soak] subprocess tier: reference/gated arms skipped "
+              "(parity assertions waived)", flush=True)
     if args.mesh_devices:
         config = dataclasses.replace(
             config,

@@ -215,6 +215,18 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
     _switch("VIZIER_DISTRIBUTED_REPLICATION_BATCH", "int",
             "DistributedConfig", _RUN_DOC,
             "Records per streamed replication batch.", "64"),
+    _switch("VIZIER_DISTRIBUTED_LEASE_TIMEOUT_S", "float",
+            "DistributedConfig", _RUN_DOC,
+            "Seconds without a renewed heartbeat before the fleet manager "
+            "declares a subprocess replica dead and fails it over.", "3.0"),
+    _switch("VIZIER_DISTRIBUTED_HEARTBEAT_INTERVAL_S", "float",
+            "DistributedConfig", _RUN_DOC,
+            "Cadence of the manager's lease-renewal Heartbeat probes to "
+            "subprocess replicas.", "1.0"),
+    _switch("VIZIER_NETCHAOS", "str", "replica_main", _RUN_DOC,
+            "Seeded network fault-injection schedule for a replica's "
+            "outbound replication links (testing.netchaos spec string; "
+            "'' = no injection)."),
     # -- speculative pre-compute (SpeculativeConfig) -----------------------
     _switch("VIZIER_SPECULATIVE", "flag", "SpeculativeConfig", _SRV_DOC,
             "Background pre-compute of the next suggestion batch after "
@@ -277,7 +289,8 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
     _switch("VIZIER_LOADGEN_STUDIES", "int", "ScenarioConfig", _LOAD_DOC,
             "Base study count before scaling.", "64"),
     _switch("VIZIER_LOADGEN_TARGET", "str", "ScenarioConfig", _LOAD_DOC,
-            "Serving target the driver runs against: inprocess | replicas.",
+            "Serving target the driver runs against: inprocess | replicas "
+            "| subprocess (real replica_main processes).",
             "replicas"),
     _switch("VIZIER_LOADGEN_EVENTS", "str", "ScenarioConfig", _LOAD_DOC,
             "Scripted event track, kind[:arg]@fraction entries ('' = the "

@@ -22,7 +22,16 @@ deployment"):
   measures and ``tools/chaos_ab.py --distributed`` kills replicas in.
 - **subprocess / multi-host** — N ``DefaultVizierServer`` processes
   (``python -m vizier_tpu.distributed.replica_main``), routed over real
-  gRPC channels; each process hosts its own Pythia.
+  gRPC channels; each process hosts its own Pythia, persists epoch-fenced
+  standby logs for its rendezvous predecessors on its own disk, and
+  streams its WAL appends to successors over the ``ReplicationService``
+  gRPC surface (``replication_service.py``).
+  ``subprocess_fleet.SubprocessReplicaManager`` spawns and manages the
+  fleet with lease-based failure detection (heartbeat RPCs; death on
+  lease expiry), fence-first failover from standby logs over the wire,
+  and partition tolerance (``testing.netchaos``): a partitioned-away
+  replica that comes back finds its stale appends rejected by fenced
+  standby stores.
 
 ``ShardedDataStore`` is the datastore-granularity analogue: one service
 process partitioning its studies across per-shard stores through the same
@@ -35,19 +44,29 @@ from vizier_tpu.distributed.replication import (
     ReplicationStreamer,
     StandbyStore,
 )
+from vizier_tpu.distributed.replication_service import (
+    GrpcReplicationLink,
+    ReplicaReplicationHost,
+    ReplicationServicer,
+)
 from vizier_tpu.distributed.router_stub import RoutedVizierStub
 from vizier_tpu.distributed.routing import StudyRouter
 from vizier_tpu.distributed.sharded_datastore import ShardedDataStore
+from vizier_tpu.distributed.subprocess_fleet import SubprocessReplicaManager
 from vizier_tpu.distributed.wal import PersistentDataStore, WriteAheadLog
 
 __all__ = [
     "DistributedConfig",
+    "GrpcReplicationLink",
     "PersistentDataStore",
     "ReplicaManager",
+    "ReplicaReplicationHost",
+    "ReplicationServicer",
     "ReplicationStreamer",
     "RoutedVizierStub",
     "ShardedDataStore",
     "StandbyStore",
     "StudyRouter",
+    "SubprocessReplicaManager",
     "WriteAheadLog",
 ]

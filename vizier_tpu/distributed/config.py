@@ -24,7 +24,12 @@ in the central registry (``vizier_tpu.analysis.registry``) and documented in
   rendezvous successors receive its records);
 - ``VIZIER_DISTRIBUTED_REPLICATION_QUEUE``  — per-origin streamer queue
   bound (overflow drops + re-baselines, never blocks the write path);
-- ``VIZIER_DISTRIBUTED_REPLICATION_BATCH``  — records per streamed batch.
+- ``VIZIER_DISTRIBUTED_REPLICATION_BATCH``  — records per streamed batch;
+- ``VIZIER_DISTRIBUTED_LEASE_TIMEOUT_S``   — seconds without a renewed
+  heartbeat before the subprocess fleet manager declares a replica dead
+  (lease-based failure detection — ``distributed.subprocess_fleet``);
+- ``VIZIER_DISTRIBUTED_HEARTBEAT_INTERVAL_S`` — cadence of the manager's
+  lease-renewal Heartbeat probes.
 """
 
 from __future__ import annotations
@@ -41,6 +46,8 @@ DEFAULT_SNAPSHOT_INTERVAL = 256
 DEFAULT_REPLICATION_FACTOR = 2
 DEFAULT_REPLICATION_QUEUE = 4096
 DEFAULT_REPLICATION_BATCH = 64
+DEFAULT_LEASE_TIMEOUT_S = 3.0
+DEFAULT_HEARTBEAT_INTERVAL_S = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +84,13 @@ class DistributedConfig:
     # never blocks on replication); batches cap per-delivery work.
     replication_queue: int = DEFAULT_REPLICATION_QUEUE
     replication_batch: int = DEFAULT_REPLICATION_BATCH
+    # Lease-based failure detection for SUBPROCESS replicas: the fleet
+    # manager renews a per-replica lease on every successful Heartbeat
+    # RPC and declares death when a lease runs out. A slow-but-alive
+    # replica keeps renewing (delays shorter than the timeout never
+    # trigger failover); a partitioned or crashed one expires.
+    lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S
+    heartbeat_interval_s: float = DEFAULT_HEARTBEAT_INTERVAL_S
 
     @classmethod
     def from_env(cls) -> "DistributedConfig":
@@ -118,6 +132,20 @@ class DistributedConfig:
                 _registry.env_int(
                     "VIZIER_DISTRIBUTED_REPLICATION_BATCH",
                     DEFAULT_REPLICATION_BATCH,
+                ),
+            ),
+            lease_timeout_s=max(
+                0.1,
+                _registry.env_float(
+                    "VIZIER_DISTRIBUTED_LEASE_TIMEOUT_S",
+                    DEFAULT_LEASE_TIMEOUT_S,
+                ),
+            ),
+            heartbeat_interval_s=max(
+                0.01,
+                _registry.env_float(
+                    "VIZIER_DISTRIBUTED_HEARTBEAT_INTERVAL_S",
+                    DEFAULT_HEARTBEAT_INTERVAL_S,
                 ),
             ),
         )

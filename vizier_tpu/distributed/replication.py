@@ -55,6 +55,7 @@ import logging
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -315,6 +316,18 @@ class _SuccessorState:
 
     synced: bool = False
     acked_seq: int = 0
+    # Why the NEXT resync of this successor is needed — kept alongside the
+    # unsynced flag so the ``vizier_replication_resyncs{reason}`` counter
+    # attributes each baseline to what actually broke the stream:
+    # "initial" (first contact), "overflow" (queue drop), "transport"
+    # (delivery failed / link died), "epoch_behind" (receiver restarted
+    # with an old epoch), "ack_regressed" (standby log wiped underneath
+    # us), "requested" (a revive's proactive re-baseline).
+    reason: str = "initial"
+
+    def desync(self, reason: str) -> None:
+        self.synced = False
+        self.reason = reason
 
 
 class StreamerFencedError(RuntimeError):
@@ -345,7 +358,9 @@ class ReplicationStreamer:
         baseline_fn: Callable[[str], Tuple[int, List[Record]]],
         queue_size: int = 4096,
         batch_max: int = 64,
+        repair_interval_secs: float = 0.5,
         on_lag: Optional[Callable[[str, int], None]] = None,
+        on_resync: Optional[Callable[[str, str, str], None]] = None,
     ):
         self.origin = origin
         self.epoch = epoch
@@ -354,10 +369,20 @@ class ReplicationStreamer:
         self._baseline_fn = baseline_fn
         self._queue_size = max(1, queue_size)
         self._batch_max = max(1, batch_max)
+        # Self-healing cadence: a successor left unsynced by a failed
+        # delivery (the link died, the peer restarted) is re-baselined on
+        # this throttle even with NO new traffic — quiet studies must not
+        # stay unprotected until the next organic mutation.
+        self._repair_interval = max(0.05, repair_interval_secs)
+        self._next_repair = 0.0
         self._on_lag = on_lag
+        # (origin, successor, reason) observer — the plane's labeled
+        # ``vizier_replication_resyncs`` counter.
+        self._on_resync = on_resync
         self._cond = threading.Condition()
         self._queue: "collections.deque[Record]" = collections.deque()
-        self._pending_resync: set = set()
+        # successor -> reason of the queued proactive resync.
+        self._pending_resync: Dict[str, str] = {}
         self._overflowed = False
         self._closed = False
         self._fenced = False
@@ -388,7 +413,7 @@ class ReplicationStreamer:
             self._queue.append((seq, opcode, payload))
             self._cond.notify()
 
-    def request_resync(self, successor: str) -> None:
+    def request_resync(self, successor: str, reason: str = "requested") -> None:
         """Queues a proactive baseline for ``successor`` (a revived
         replica's standby logs are stale until someone re-baselines them;
         waiting for the next organic record would leave a window where
@@ -396,7 +421,7 @@ class ReplicationStreamer:
         with self._cond:
             if self._closed or self._fenced:
                 return
-            self._pending_resync.add(successor)
+            self._pending_resync[successor] = reason
             self._cond.notify()
 
     def flush(self, timeout_secs: float = 10.0) -> bool:
@@ -459,21 +484,27 @@ class ReplicationStreamer:
                     and not self._pending_resync
                     and not self._closed
                 ):
+                    if (
+                        self._has_unsynced()
+                        and time.monotonic() >= self._next_repair
+                    ):
+                        break  # idle repair pass: re-baseline dead links
                     self._cond.wait(0.2)
                 if self._closed and not self._queue:
                     return
                 batch: List[Record] = []
                 while self._queue and len(batch) < self._batch_max:
                     batch.append(self._queue.popleft())
-                resyncs = sorted(self._pending_resync)
+                resyncs = sorted(self._pending_resync.items())
                 self._pending_resync.clear()
                 overflowed, self._overflowed = self._overflowed, False
                 self._inflight = len(batch) + len(resyncs)
             try:
-                for successor in resyncs:
-                    self._state(successor).synced = False
+                for successor, reason in resyncs:
+                    self._state(successor).desync(reason)
                     self._resync(successor)
                 self._deliver_batch(batch, overflowed)
+                self._repair_unsynced()
             except StreamerFencedError:
                 with self._cond:
                     self._fenced = True
@@ -511,15 +542,37 @@ class ReplicationStreamer:
             state = self._states[successor] = _SuccessorState()
         return state
 
+    def _has_unsynced(self) -> bool:
+        """Worker-private: any known successor currently off-stream?"""
+        return any(not state.synced for state in self._states.values())
+
+    def _repair_unsynced(self) -> None:
+        """Throttled self-healing: retry the baseline of every unsynced
+        successor. Called from the worker after each cycle (and from the
+        idle wakeup), so a healed link or restarted peer is re-protected
+        within ``repair_interval`` even if no new mutation ever arrives.
+        Failed attempts are cheap — the wire link's dead-peer cooldown
+        short-circuits the connect wait."""
+        if not self._has_unsynced():
+            return
+        now = time.monotonic()
+        if now < self._next_repair:
+            return
+        self._next_repair = now + self._repair_interval
+        for successor in sorted(self._states):
+            if not self._states[successor].synced:
+                self._resync(successor)
+
     def _resync(self, successor: str) -> bool:
         """Replaces a successor's standby log with a fresh baseline."""
+        state = self._state(successor)
+        reason = state.reason
         seq, records = self._baseline_fn(successor)
         response = self._deliver_fn(
             successor, self.origin, self.epoch, records, True, seq
         )
-        state = self._state(successor)
         if response is None:  # successor unreachable (dead): retry later
-            state.synced = False
+            state.desync("transport")
             return False
         accepted, value = response
         if not accepted:
@@ -533,6 +586,11 @@ class ReplicationStreamer:
         state.synced = True
         state.acked_seq = value
         self.resyncs += 1
+        if self._on_resync is not None:
+            try:
+                self._on_resync(self.origin, successor, reason)
+            except Exception:  # accounting must not break the stream
+                pass
         recorder_lib.get_recorder().record(
             None,
             "replication_resync",
@@ -540,13 +598,14 @@ class ReplicationStreamer:
             successor=successor,
             baseline_seq=seq,
             records=len(records),
+            reason=reason,
         )
         return True
 
     def _deliver_batch(self, batch: List[Record], overflowed: bool) -> None:
         if overflowed:
             for state in self._states.values():
-                state.synced = False
+                state.desync("overflow")
         per_successor: Dict[str, List[Record]] = {}
         for seq, opcode, payload in batch:
             study_key = wal_lib.study_key_of(opcode, payload)
@@ -574,7 +633,7 @@ class ReplicationStreamer:
                 successor, self.origin, self.epoch, records, False, 0
             )
             if response is None:
-                state.synced = False
+                state.desync("transport")
                 continue
             accepted, value = response
             if not accepted:
@@ -586,14 +645,14 @@ class ReplicationStreamer:
                     )
                 # The receiver is BEHIND (it restarted with an old epoch
                 # on disk): a baseline introduces the current epoch.
-                state.synced = False
+                state.desync("epoch_behind")
                 continue
             state.acked_seq = value
             expected = records[-1][0]
             if value < expected:
                 # The standby log is behind what we just sent: it was
                 # wiped/recreated underneath us. Re-baseline.
-                state.synced = False
+                state.desync("ack_regressed")
         if self._on_lag is not None:
             try:
                 self._on_lag(self.origin, self.lag())
@@ -791,6 +850,7 @@ class ReplicationPlane:
         self._lock = threading.Lock()  # leaf: streamer/epoch maps only
         self._lag_gauge = None
         self._depth_gauge = None
+        self._resync_counter = None
         if registry is not None:
             self._lag_gauge = registry.gauge(
                 "vizier_replication_lag",
@@ -799,6 +859,12 @@ class ReplicationPlane:
             self._depth_gauge = registry.gauge(
                 "vizier_replication_standby_depth",
                 help="Standby-log records held, per origin and holder.",
+            )
+            self._resync_counter = registry.counter(
+                "vizier_replication_resyncs",
+                help="Standby-log re-baselines, per origin and reason "
+                "(initial/overflow/transport/epoch_behind/ack_regressed/"
+                "requested).",
             )
 
     # -- hooks the manager wires --------------------------------------------
@@ -837,6 +903,7 @@ class ReplicationPlane:
             queue_size=self._queue_size,
             batch_max=self._batch_max,
             on_lag=self._record_lag,
+            on_resync=self._record_resync,
         )
         with self._lock:
             self._streamers[origin] = streamer
@@ -920,6 +987,11 @@ class ReplicationPlane:
     def _record_lag(self, origin: str, lag: int) -> None:
         if self._lag_gauge is not None:
             self._lag_gauge.set(float(lag), origin=origin)
+
+    def _record_resync(self, origin: str, successor: str, reason: str) -> None:
+        del successor  # label cardinality: (origin, reason) is enough
+        if self._resync_counter is not None:
+            self._resync_counter.inc(origin=origin, reason=reason)
 
     def streamer_stats(self) -> Dict[str, Dict[str, int]]:
         """origin -> {epoch, lag, resyncs, dropped} (JSON-ready)."""

@@ -443,6 +443,13 @@ class PersistentDataStore(datastore_lib.DataStore):
             self._check_converged()
             self._wal.compact(export_records(self._inner), seq=self._seq)
 
+    def set_append_sink(self, sink: Optional["AppendSink"]) -> None:
+        """Attaches (or replaces) the post-append replication observer —
+        subprocess replicas build the datastore first (the WAL replay must
+        not re-stream history) and hook the streamer in afterwards."""
+        with self._lock:
+            self._on_append = sink
+
     def close(self) -> None:
         self._wal.close()
 

@@ -417,9 +417,82 @@ class _ReplicaTarget:
         self._manager.shutdown()
 
 
+class _DetachedRuntime:
+    """Runtime shim for targets whose serving runtimes live in OTHER
+    processes: the driver cannot reach a subprocess replica's SLO engine
+    or admission controller, so those report sections come back empty
+    (each replica dumps its own via ``--obs-dump-dir`` instead)."""
+
+    slo_engine = None
+
+    def slo_report(self) -> Dict[str, object]:
+        return {}
+
+    def admission_snapshot(self) -> Dict[str, object]:
+        return {"enabled": False}
+
+
+class _SubprocessTarget:
+    """An N-replica fleet of REAL ``replica_main`` processes behind the
+    lease-based ``SubprocessReplicaManager`` (cross-process standby
+    replication over gRPC; kill = SIGKILL, revive = fenced restart +
+    copy-back over the wire). The scenario's env overlay is inherited by
+    the child processes, so the serving planes arm inside each replica;
+    per-study designer seeding does NOT cross the process boundary —
+    parity/bit-identity assertions are waived for this target (the
+    in-process arms carry that evidence)."""
+
+    supports_replicas = True
+    replication_active = True
+
+    def __init__(self, scenario: models.Scenario, reliability, factory):
+        from vizier_tpu.distributed import subprocess_fleet
+
+        del reliability  # replicas configure their own from the env
+        del factory  # subprocess replicas build their own policy factory
+        self.wal_root = tempfile.mkdtemp(prefix="vizier-loadgen-subproc-")
+        self._manager = subprocess_fleet.SubprocessReplicaManager(
+            scenario.config.replicas, wal_root=self.wal_root
+        )
+        self.runtime = _DetachedRuntime()
+
+    @property
+    def stub(self):
+        return self._manager.stub
+
+    def serving_stats(self) -> dict:
+        return self._manager.serving_stats()
+
+    def owner_of(self, study_name: str) -> str:
+        return self._manager.owner_of(study_name)
+
+    def replica_ids(self) -> List[str]:
+        return self._manager.replica_ids()
+
+    def is_alive(self, replica_id: str) -> bool:
+        return self._manager.is_alive(replica_id)
+
+    def kill_replica(self, replica_id: str) -> None:
+        self._manager.kill_replica(replica_id)
+
+    def fail_over(self, replica_id: str) -> int:
+        return self._manager.fail_over(replica_id)
+
+    def revive_replica(self, replica_id: str) -> None:
+        self._manager.revive_replica(replica_id)
+
+    def corrupt_wal(self, replica_id: str) -> Dict[str, object]:
+        return self._manager.corrupt_wal(replica_id)
+
+    def shutdown(self) -> None:
+        self._manager.shutdown()
+
+
 def _build_target(scenario, reliability, factory):
     if scenario.config.target == "replicas":
         return _ReplicaTarget(scenario, reliability, factory)
+    if scenario.config.target == "subprocess":
+        return _SubprocessTarget(scenario, reliability, factory)
     return _InProcessTarget(scenario, reliability, factory)
 
 

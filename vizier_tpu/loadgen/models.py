@@ -80,7 +80,7 @@ def owner_tenant(owner: str) -> str:
         return owner[len(TENANT_OWNER_PREFIX):]
     return owner
 
-_TARGETS = ("inprocess", "replicas")
+_TARGETS = ("inprocess", "replicas", "subprocess")
 _EVENT_KINDS = (
     "kill_replica",
     "revive_replica",
@@ -266,6 +266,11 @@ class ScenarioConfig:
     min_speculative_hits: int = 1
     min_hit_rate: float = 0.0
     max_fallback_rate: float = 0.25
+    # Fleet shed-rate budget, asserted only while ``planes.admission`` is
+    # armed (the default soak runs WITH admission and must not shed under
+    # nominal load; the hot_tenant overload preset raises this to 1.0 —
+    # shedding the hot tenant is its mechanism).
+    max_shed_rate: float = 0.05
     parity_alpha: float = 0.05
     p99_budget_ms: float = 120000.0  # VIZIER_SLO_SUGGEST_P99_MS objective
 
@@ -763,6 +768,7 @@ def hot_tenant_config(**overrides) -> ScenarioConfig:
         chaos_fault_prob=0.0,
         parity_cohort=4,
         max_fallback_rate=1.0,  # degraded-mode serves ARE the mechanism
+        max_shed_rate=1.0,  # shedding the hot tenant IS the mechanism
         planes=PlaneConfig(
             batching=True,
             speculative=False,
@@ -804,9 +810,17 @@ def hot_tenant_config(**overrides) -> ScenarioConfig:
 def soak_config(**overrides) -> ScenarioConfig:
     """The acceptance-scale scenario: ≥1000 Zipf-sized studies across all
     registered program kinds on a 3-replica tier, speculation + batching
-    + mesh + SLO armed, with the SEVERITY event track (2-simultaneous
-    multi_kill + mid-file wal_corrupt + rolling_restart) plus the chaos
-    fault window."""
+    + mesh + SLO + ADMISSION armed, with the SEVERITY event track
+    (2-simultaneous multi_kill + mid-file wal_corrupt + rolling_restart)
+    plus the chaos fault window.
+
+    Admission runs armed by default (the PR 14 follow-on): the soak's
+    nominal load must pass UNDER the overload-protection plane — the
+    report gates assert the shed rate stays inside ``max_shed_rate`` and
+    suggest p99 inside the SLO budget, so a regression that makes the
+    plane shed healthy traffic (or a plane bypass that lets p99 collapse)
+    fails the default soak, not just ``overload_ab``.
+    """
     values: Dict[str, object] = dict(
         name="soak",
         num_studies=1000,
@@ -820,7 +834,14 @@ def soak_config(**overrides) -> ScenarioConfig:
         ard_maxiter=10,
         think_time_s=0.15,
         parity_cohort=10,
-        planes=PlaneConfig.all_on(),
+        planes=dataclasses.replace(PlaneConfig.all_on(), admission=True),
+        # Nominal-load headroom: the closed-loop client pool (concurrency
+        # 8) fits inside the fleet cap, and per-tenant caps sit above any
+        # single tenant's plausible concurrency — a shed under this
+        # scenario is a plane regression, not load.
+        admission_max_inflight=16,
+        admission_tenant_inflight=8,
+        max_shed_rate=0.05,
     )
     values.update(overrides)
     return ScenarioConfig(**values)

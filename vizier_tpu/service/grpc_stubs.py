@@ -13,10 +13,16 @@ from typing import Any, Dict, Tuple
 
 import grpc
 
-from vizier_tpu.service.protos import pythia_service_pb2, study_pb2, vizier_service_pb2
+from vizier_tpu.service.protos import (
+    pythia_service_pb2,
+    replication_service_pb2,
+    study_pb2,
+    vizier_service_pb2,
+)
 
 _V = vizier_service_pb2
 _P = pythia_service_pb2
+_R = replication_service_pb2
 
 # method name -> (request class, response class)
 VIZIER_METHODS: Dict[str, Tuple[Any, Any]] = {
@@ -48,8 +54,23 @@ PYTHIA_METHODS: Dict[str, Tuple[Any, Any]] = {
     "Ping": (_P.PingRequest, _P.PingResponse),
 }
 
+# The cross-process replication plane (standby-log streaming, lease
+# heartbeats, recovery plumbing — vizier_tpu.distributed).
+REPLICATION_METHODS: Dict[str, Tuple[Any, Any]] = {
+    "DeliverAppends": (_R.DeliverAppendsRequest, _R.DeliverAppendsResponse),
+    "Baseline": (_R.DeliverAppendsRequest, _R.DeliverAppendsResponse),
+    "Fence": (_R.FenceRequest, _R.FenceResponse),
+    "Heartbeat": (_R.HeartbeatRequest, _R.HeartbeatResponse),
+    "ExportStandby": (_R.ExportStandbyRequest, _R.ExportStandbyResponse),
+    "ExportState": (_R.ExportStateRequest, _R.ExportStateResponse),
+    "ApplyRecords": (_R.ApplyRecordsRequest, _R.ApplyRecordsResponse),
+    "Resync": (_R.ResyncRequest, _R.ResyncResponse),
+    "FlushStream": (_R.FlushStreamRequest, _R.FlushStreamResponse),
+}
+
 VIZIER_SERVICE_NAME = "vizier_tpu.VizierService"
 PYTHIA_SERVICE_NAME = "vizier_tpu.PythiaService"
+REPLICATION_SERVICE_NAME = "vizier_tpu.ReplicationService"
 
 
 def _wrap(servicer, method_name: str):
@@ -86,6 +107,10 @@ def add_vizier_servicer_to_server(servicer, server) -> None:
 
 def add_pythia_servicer_to_server(servicer, server) -> None:
     _add_servicer(servicer, server, PYTHIA_SERVICE_NAME, PYTHIA_METHODS)
+
+
+def add_replication_servicer_to_server(servicer, server) -> None:
+    _add_servicer(servicer, server, REPLICATION_SERVICE_NAME, REPLICATION_METHODS)
 
 
 class _Stub:
@@ -137,6 +162,11 @@ class VizierServiceStub(_Stub):
 class PythiaServiceStub(_Stub):
     def __init__(self, channel: grpc.Channel):
         super().__init__(channel, PYTHIA_SERVICE_NAME, PYTHIA_METHODS)
+
+
+class ReplicationServiceStub(_Stub):
+    def __init__(self, channel: grpc.Channel):
+        super().__init__(channel, REPLICATION_SERVICE_NAME, REPLICATION_METHODS)
 
 
 # One channel per endpoint for the process lifetime. Stub creation sits on
@@ -247,3 +277,10 @@ def create_vizier_stub(endpoint: str, timeout: float = 10.0) -> VizierServiceStu
 
 def create_pythia_stub(endpoint: str, timeout: float = 10.0) -> PythiaServiceStub:
     return PythiaServiceStub(_shared_channel(endpoint, timeout))
+
+
+def create_replication_stub(
+    endpoint: str, timeout: float = 10.0
+) -> ReplicationServiceStub:
+    """Replication-surface stub on the shared per-endpoint channel."""
+    return ReplicationServiceStub(_shared_channel(endpoint, timeout))
