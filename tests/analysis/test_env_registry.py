@@ -53,18 +53,19 @@ class TestRealTree:
                 )
 
     def test_registry_covers_the_trees_switch_count(self):
-        # 78 in-tree env switches (incl. the 12 VIZIER_DISTRIBUTED* tier
+        # 82 in-tree env switches (incl. the 12 VIZIER_DISTRIBUTED* tier
         # knobs — 6 topology/WAL + 4 replication + 2 lease/heartbeat —
         # the 5 VIZIER_SPARSE* surrogate knobs, the 6 VIZIER_SPECULATIVE*
         # pre-compute knobs, the 6 VIZIER_MESH* execution-plane knobs,
         # the 8 VIZIER_SLO* objectives, the 3 VIZIER_FLIGHT_RECORDER*
         # knobs, VIZIER_OBS_DUMP_DIR, the 5 VIZIER_LOADGEN*
         # traffic-engine knobs, the 11 VIZIER_ADMISSION*
-        # overload-protection knobs, and the VIZIER_NETCHAOS fault
+        # overload-protection knobs, the 4 VIZIER_COMPUTE_TIER*
+        # disaggregated-compute knobs, and the VIZIER_NETCHAOS fault
         # schedule) + 3 bench switches + the 2 reserved grpc constants.
         # Growing the tree means growing this registry.
-        assert len(registry.SWITCHES) == 83
-        assert len(registry.env_switch_names()) == 81
+        assert len(registry.SWITCHES) == 87
+        assert len(registry.env_switch_names()) == 85
 
     def test_known_switches_declared(self):
         for name in (

@@ -617,3 +617,52 @@ class TestBudgetPolicyViaMetadata:
     def test_invalid_value_raises(self):
         with pytest.raises(ValueError, match="acquisition_budget_policy"):
             self._designer_for("always_free_lunch")
+
+
+class TestAcquisitionEvalsViaMetadata:
+    """gRPC-reachable acquisition sweep budget (study metadata ns
+    'gp_ucb_pe' key 'max_acquisition_evaluations') — the remote path a
+    shared compute-tier client uses to bound designer cost, since the
+    key rides the StudySpec across the Pythia surface."""
+
+    def _designer_for(self, metadata_value):
+        from vizier_tpu.pythia import local_policy_supporters
+        from vizier_tpu.service import policy_factory
+
+        config = _config(algorithm="DEFAULT")
+        problem = config.to_problem()
+        if metadata_value is not None:
+            problem.metadata.ns("gp_ucb_pe")[
+                "max_acquisition_evaluations"
+            ] = metadata_value
+        supporter = local_policy_supporters.InRamPolicySupporter(config)
+        policy = policy_factory.DefaultPolicyFactory()(
+            problem, "DEFAULT", supporter, "s"
+        )
+        return policy._designer_factory(problem)
+
+    def test_metadata_bounds_the_sweep(self):
+        designer = self._designer_for("300")
+        assert designer.max_acquisition_evaluations == 300
+
+    def test_absent_key_keeps_the_designer_default(self):
+        from vizier_tpu.designers import gp_ucb_pe
+
+        designer = self._designer_for(None)
+        default = gp_ucb_pe.VizierGPUCBPEBandit(
+            _config(algorithm="DEFAULT").to_problem()
+        ).max_acquisition_evaluations
+        assert designer.max_acquisition_evaluations == default
+
+    def test_zero_means_designer_default(self):
+        designer = self._designer_for("0")
+        default = self._designer_for(None).max_acquisition_evaluations
+        assert designer.max_acquisition_evaluations == default
+
+    def test_invalid_value_raises_at_policy_construction(self):
+        with pytest.raises(ValueError, match="max_acquisition_evaluations"):
+            self._designer_for("lots")
+
+    def test_negative_value_raises(self):
+        with pytest.raises(ValueError, match="max_acquisition_evaluations"):
+            self._designer_for("-5")

@@ -1,0 +1,698 @@
+#!/usr/bin/env python
+"""Compute-tier A/B: N frontends sharing ONE Pythia compute server vs N
+self-contained replicas, same-run, same workload.
+
+The disaggregated tier exists to raise ONE number: batch-flush occupancy.
+A self-contained replica's batch executor only ever sees its own studies
+— at one study per replica every flush is a singleton (occupancy ≈ 1).
+Routing the whole fleet's suggests to one shared
+:class:`~vizier_tpu.service.pythia_service.PythiaServicer` lets
+same-bucket computations from DIFFERENT frontends fuse into one vmapped
+flush (occupancy ≈ N; the reference's ``DistributedPythiaVizierServer``
+topology, arXiv:2408.11527 §4).
+
+Both arms run the SAME workload: N GP studies with identical search-space
+shapes (one padding bucket; identical per-study acquisition budgets via
+the ``gp_ucb_pe.max_acquisition_evaluations`` study-metadata key, which
+rides the StudySpec across the gRPC hop), each study owned by its own
+frontend and driven by its own client thread through the full service
+surface (``VizierClient`` → ``SuggestTrials`` → Pythia). Only the Pythia
+topology differs:
+
+- **shared_tier** — 8 in-process ``DefaultVizierServer`` frontends, each
+  wrapped with :class:`~vizier_tpu.distributed.compute_tier.
+  RemotePythiaStub`, dispatching to one REAL
+  ``distributed.pythia_server_main`` subprocess (its ``--frontends``
+  routed read-back resolving each study to the frontend that owns it);
+- **self_contained** — 8 in-process stacks, each with its own local
+  Pythia and its own batch executor (the subprocess-fleet shape).
+
+Three more gates ride the same run:
+
+- **kill** — a fresh compute server is SIGKILLed mid-run; every
+  in-flight-and-after suggest must complete via the frontends' local
+  fallback (50/50, zero client-visible errors);
+- **bit-identity** — ``VIZIER_COMPUTE_TIER`` unset vs ``=0``:
+  ``maybe_wrap_pythia`` must return the local Pythia UNCHANGED (identity)
+  and the full suggest trajectories must be bit-identical;
+- **fan-in** — the compute server's observability dump is merged with the
+  frontends' span dumps (``observability.fleet``): the remote-hop spans
+  must carry all N ``frontend=`` attributions.
+
+Evidence lands in ``COMPUTE_TIER_AB.json``. Acceptance: shared-tier mean
+batch-flush occupancy >= 4x the self-contained arm at 8 frontends,
+suggest p50/p99 reported for both arms, kill completes 50/50, off-switch
+bit-identical.
+
+Usage:  python tools/compute_tier_ab.py [--frontends 8] [--rounds 2]
+            [--out COMPUTE_TIER_AB.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("VIZIER_DISABLE_MESH", "1")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from vizier_tpu import pyvizier as vz  # noqa: E402
+from vizier_tpu.distributed import compute_tier, routing  # noqa: E402
+from vizier_tpu.reliability import ReliabilityConfig  # noqa: E402
+from vizier_tpu.service import proto_converters as pc  # noqa: E402
+from vizier_tpu.service import vizier_client  # noqa: E402
+from vizier_tpu.service.protos import vizier_service_pb2  # noqa: E402
+from vizier_tpu.service.vizier_server import DefaultVizierServer  # noqa: E402
+from vizier_tpu.serving.config import ServingConfig  # noqa: E402
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _sphere(parameters: dict) -> float:
+    return -sum((float(v) - 0.3) ** 2 for v in parameters.values())
+
+
+def _study_config(dim: int, acq_evals: int, algorithm: str = "") -> vz.StudyConfig:
+    config = vz.StudyConfig(algorithm=algorithm) if algorithm else vz.StudyConfig()
+    for d in range(dim):
+        config.search_space.root.add_float_param(f"x{d}", 0.0, 1.0)
+    config.metric_information.append(
+        vz.MetricInformation(name="obj", goal=vz.ObjectiveMetricGoal.MAXIMIZE)
+    )
+    if acq_evals:
+        # The remote-client path to the designer's sweep budget: the key
+        # rides the StudySpec through the Pythia surface, so the shared
+        # compute server applies the SAME budget as the in-process arm
+        # (service.policy_factory validates it at policy construction).
+        config.metadata.ns("gp_ucb_pe")["max_acquisition_evaluations"] = str(
+            acq_evals
+        )
+    return config
+
+
+def _reliability() -> ReliabilityConfig:
+    return ReliabilityConfig(
+        retry_max_attempts=8,
+        retry_base_delay_secs=0.1,
+        retry_max_delay_secs=0.5,
+    )
+
+
+def _owned_study_names(frontend_ids) -> dict:
+    """rid -> a study name the fleet's rendezvous router assigns to rid.
+
+    The compute server reads trials back through a ``RoutedVizierStub``
+    over the SAME router, so each study's read-back must land on the
+    frontend that actually holds it."""
+    router = routing.StudyRouter(list(frontend_ids))
+    names = {}
+    for rid in frontend_ids:
+        for salt in range(10_000):
+            name = f"owners/ab/studies/{rid}-s{salt}"
+            if router.replica_for(name) == rid:
+                names[rid] = name
+                break
+        else:  # pragma: no cover - rendezvous covers 8 ids long before 10k
+            raise SystemExit(f"No study name routed to {rid} in 10k salts")
+    return names
+
+
+def _create_and_seed(servicer, study_name: str, config, start_trials: int, seed: int):
+    """Creates the study and seeds ``start_trials`` completed trials."""
+    import numpy as np
+
+    servicer.CreateStudy(
+        vizier_service_pb2.CreateStudyRequest(
+            parent="owners/ab", study=pc.study_to_proto(config, study_name)
+        )
+    )
+    rng = np.random.default_rng(seed)
+    dim = len(config.search_space.parameters)
+    for _ in range(start_trials):
+        params = {f"x{d}": float(rng.uniform()) for d in range(dim)}
+        t = vz.Trial(parameters=params)
+        t.complete(vz.Measurement(metrics={"obj": _sphere(params)}))
+        servicer.CreateTrial(
+            vizier_service_pb2.CreateTrialRequest(
+                parent=study_name, trial=pc.trial_to_proto(t)
+            )
+        )
+
+
+def _spawn_compute_server(
+    *, frontends: str, obs_dump_dir: str, max_wait_ms: float, batch_size: int
+):
+    """One REAL pythia_server_main subprocess; returns (proc, endpoint)."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "VIZIER_BATCH_MAX_WAIT_MS": str(max_wait_ms),
+        "VIZIER_BATCH_MAX_SIZE": str(batch_size),
+    }
+    cmd = [
+        sys.executable,
+        "-m",
+        "vizier_tpu.distributed.pythia_server_main",
+        "--server-id",
+        "compute-ab",
+        "--port",
+        "0",
+    ]
+    if frontends:
+        cmd += ["--frontends", frontends]
+    if obs_dump_dir:
+        cmd += ["--obs-dump-dir", obs_dump_dir]
+    proc = subprocess.Popen(
+        cmd, cwd=str(_REPO), env=env, stdout=subprocess.PIPE, text=True
+    )
+    line = proc.stdout.readline().strip()
+    if not line.startswith("READY "):
+        proc.kill()
+        raise SystemExit(f"compute server failed to start: {line!r}")
+    return proc, line.split(" ", 1)[1]
+
+
+def _stop_server(srv) -> None:
+    """Stops a DefaultVizierServer AND its serving runtime's background
+    planes (batch-executor threads would otherwise outlive the arm)."""
+    srv.stop(0)
+    srv.pythia_servicer.shutdown()
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    rank = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = rank - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _drive_clients(stacks, *, warmup_rounds: int, rounds: int) -> list:
+    """One client thread per (frontend, study): warmup (unrecorded, pays
+    XLA compiles), then lockstep measured rounds — every frontend issues
+    its suggest in the same window, the arrival pattern the tier exists to
+    fuse — completing each suggestion so the next round trains on fresh
+    data. Returns sorted per-suggest latencies (seconds)."""
+    latencies: list = []
+    lat_lock = threading.Lock()
+    # Lockstep across BOTH warmup and measured rounds so every round's
+    # suggests arrive together in both arms (identical workload shape).
+    round_barrier = threading.Barrier(len(stacks))
+    errors: list = []
+
+    def client(servicer, study_name):
+        c = vizier_client.VizierClient(
+            servicer, study_name, "w", reliability=_reliability()
+        )
+        for r in range(warmup_rounds + rounds):
+            round_barrier.wait()
+            t0 = time.perf_counter()
+            (trial,) = c.get_suggestions(1)
+            dt = time.perf_counter() - t0
+            if r >= warmup_rounds:
+                with lat_lock:
+                    latencies.append(dt)
+            params = dict(trial.parameters.as_dict())
+            c.complete_trial(
+                trial.id, vz.Measurement(metrics={"obj": _sphere(params)})
+            )
+
+    def wrapped(servicer, study_name):
+        try:
+            client(servicer, study_name)
+        except Exception as e:  # noqa: BLE001 - surfaced after join
+            errors.append(f"{study_name}: {e!r}")
+            # Unblock peers parked on the barrier.
+            round_barrier.abort()
+
+    threads = [
+        threading.Thread(target=wrapped, args=(servicer, name))
+        for servicer, name in stacks
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise SystemExit(f"client threads failed: {errors}")
+    latencies.sort()
+    return latencies
+
+
+def _latency_summary(latencies, label_count) -> dict:
+    return {
+        "suggest_p50_ms": round(_percentile(latencies, 50) * 1e3, 1),
+        "suggest_p99_ms": round(_percentile(latencies, 99) * 1e3, 1),
+        "suggestions": len(latencies),
+        "frontends": label_count,
+    }
+
+
+def _counter_total(metrics_snapshot: dict, name: str) -> float:
+    family = metrics_snapshot.get(name) or {}
+    return float(sum((family.get("series") or {}).values()))
+
+
+def _occupancy_of(metrics_snapshot: dict) -> float:
+    """Mean batch-flush occupancy from the ``vizier_batch_occupancy``
+    histogram — suggests fused per flush, counting SOLO flushes as 1 (the
+    executor runs a lone slot sequentially, so the ``batched_suggests``
+    counter alone would undercount the self-contained arm to zero). Same
+    formula as ``observability.fleet.compute_tier_section``."""
+    family = metrics_snapshot.get("vizier_batch_occupancy") or {}
+    total = count = 0.0
+    for series in (family.get("series") or {}).values():
+        total += float(series.get("sum", 0.0))
+        count += float(series.get("count", 0.0))
+    return total / count if count else 0.0
+
+
+def run_shared_arm(args, dump_dir: str) -> dict:
+    ids = [f"fe{i}" for i in range(args.frontends)]
+    names = _owned_study_names(ids)
+    config = _study_config(args.dim, args.acq_evals)
+
+    # Frontends: local Pythia is the FALLBACK only — batching off so the
+    # 8 idle executors don't shadow the tier's occupancy evidence.
+    servers = {rid: DefaultVizierServer(
+        serving_config=ServingConfig(batching=False)
+    ) for rid in ids}
+    proc = None
+    try:
+        frontends_spec = ",".join(
+            f"{rid}={servers[rid].endpoint}" for rid in ids
+        )
+        proc, endpoint = _spawn_compute_server(
+            frontends=frontends_spec,
+            obs_dump_dir=dump_dir,
+            max_wait_ms=args.max_wait_ms,
+            batch_size=args.frontends,
+        )
+        stubs = {}
+        for rid in ids:
+            stub = compute_tier.RemotePythiaStub(
+                endpoint,
+                local=servers[rid].pythia_servicer,
+                replica_id=rid,
+                config=compute_tier.ComputeTierConfig(
+                    enabled=True, endpoint=endpoint
+                ),
+            )
+            servers[rid].servicer.set_pythia(stub)
+            stubs[rid] = stub
+        for i, rid in enumerate(ids):
+            _create_and_seed(
+                servers[rid].servicer,
+                names[rid],
+                config,
+                args.start_trials,
+                seed=i + 1,
+            )
+        latencies = _drive_clients(
+            [(servers[rid].servicer, names[rid]) for rid in ids],
+            warmup_rounds=args.warmup_rounds,
+            rounds=args.rounds,
+        )
+        stub_stats = {rid: stubs[rid].stats() for rid in ids}
+        fallbacks = sum(s["fallback_serves"] for s in stub_stats.values())
+        remote_calls = sum(s["remote_calls"] for s in stub_stats.values())
+        if fallbacks:
+            raise SystemExit(
+                f"shared arm leaked {fallbacks} local-fallback serves — the "
+                "tier went down mid-measurement; occupancy evidence invalid"
+            )
+
+        # Graceful SIGTERM so the server writes its observability dump —
+        # the occupancy evidence lives in the CHILD's metrics registry.
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        proc = None
+    finally:
+        if proc is not None:
+            proc.kill()
+            proc.wait()
+        for srv in servers.values():
+            _stop_server(srv)
+
+    metrics = json.loads(
+        (pathlib.Path(dump_dir) / "compute-ab-metrics.json").read_text()
+    )
+    flushes = _counter_total(metrics, "vizier_serving_batch_flushes")
+    batched = _counter_total(metrics, "vizier_serving_batched_suggests")
+    occupancy = _occupancy_of(metrics)
+
+    # Merge the frontends' spans with the compute server's dump: the hop
+    # spans must attribute every frontend (the fleet fan-in view).
+    from vizier_tpu.observability import fleet as fleet_lib
+    from vizier_tpu.observability import tracing as tracing_lib
+
+    fleet_lib.dump_process(dump_dir, "frontends", tracer=tracing_lib.get_tracer())
+    fan_in = fleet_lib.fleet_report(dump_dir)["compute_tier"]
+
+    return {
+        **_latency_summary(latencies, args.frontends),
+        "mean_batch_occupancy": round(occupancy, 2),
+        "batch_flushes": int(flushes),
+        "batched_suggests": int(batched),
+        "remote_calls": remote_calls,
+        "fallback_serves": fallbacks,
+        "fan_in": fan_in["fan_in"],
+        "fan_in_frontends": fan_in["frontends"],
+        "compute_server_occupancy_histogram": fan_in["batch_occupancy"],
+    }
+
+
+def run_self_contained_arm(args) -> dict:
+    ids = [f"fe{i}" for i in range(args.frontends)]
+    names = _owned_study_names(ids)
+    config = _study_config(args.dim, args.acq_evals)
+    # The SAME batching knobs the shared tier ran with — each replica just
+    # has a private executor, so its flushes only ever see its own study.
+    serving_config = ServingConfig(
+        batch_max_wait_ms=args.max_wait_ms, batch_max_size=args.frontends
+    )
+    servers = {
+        rid: DefaultVizierServer(serving_config=serving_config) for rid in ids
+    }
+    try:
+        for i, rid in enumerate(ids):
+            _create_and_seed(
+                servers[rid].servicer,
+                names[rid],
+                config,
+                args.start_trials,
+                seed=i + 1,
+            )
+        latencies = _drive_clients(
+            [(servers[rid].servicer, names[rid]) for rid in ids],
+            warmup_rounds=args.warmup_rounds,
+            rounds=args.rounds,
+        )
+        flushes = batched = 0
+        total = count = 0.0
+        for srv in servers.values():
+            snap = srv.pythia_servicer.serving_stats()
+            flushes += snap.get("batch_flushes", 0)
+            batched += snap.get("batched_suggests", 0)
+            metrics = srv.pythia_servicer.serving_runtime.metrics.snapshot()
+            family = metrics.get("vizier_batch_occupancy") or {}
+            for series in (family.get("series") or {}).values():
+                total += float(series.get("sum", 0.0))
+                count += float(series.get("count", 0.0))
+    finally:
+        for srv in servers.values():
+            _stop_server(srv)
+    occupancy = total / count if count else 0.0
+    return {
+        **_latency_summary(latencies, args.frontends),
+        "mean_batch_occupancy": round(occupancy, 2),
+        "batch_flushes": int(flushes),
+        "batched_suggests": int(batched),
+    }
+
+
+def run_kill_phase(args) -> dict:
+    """SIGKILL the compute server mid-run: every suggest still completes
+    via the frontends' local fallback (RANDOM_SEARCH — the kill gate
+    measures the degradation path, not designer compute)."""
+    ids = ["ka", "kb"]
+    per_frontend = args.kill_suggests // len(ids)
+    config = _study_config(args.dim, 0, algorithm="RANDOM_SEARCH")
+    servers = {rid: DefaultVizierServer() for rid in ids}
+    proc = None
+    completed = {rid: 0 for rid in ids}
+    errors: list = []
+    try:
+        # No --frontends: RANDOM_SEARCH needs no trial read-back, and the
+        # kill phase wants a server it can lose without a routed stub
+        # half-connected to dead frontends.
+        proc, endpoint = _spawn_compute_server(
+            frontends="",
+            obs_dump_dir="",
+            max_wait_ms=5.0,
+            batch_size=8,
+        )
+        stubs = {}
+        for rid in ids:
+            stub = compute_tier.RemotePythiaStub(
+                endpoint,
+                local=servers[rid].pythia_servicer,
+                replica_id=rid,
+                config=compute_tier.ComputeTierConfig(
+                    enabled=True, endpoint=endpoint, health_interval_s=0.5
+                ),
+            )
+            servers[rid].servicer.set_pythia(stub)
+            stubs[rid] = stub
+        for i, rid in enumerate(ids):
+            name = f"owners/ab/studies/kill-{rid}"
+            servers[rid].servicer.CreateStudy(
+                vizier_service_pb2.CreateStudyRequest(
+                    parent="owners/ab", study=pc.study_to_proto(config, name)
+                )
+            )
+        kill_at = args.kill_suggests * 2 // 5  # ~40% in, mid-run by design
+        progress = threading.Lock()
+        killed = threading.Event()
+
+        def client(rid):
+            name = f"owners/ab/studies/kill-{rid}"
+            c = vizier_client.VizierClient(
+                servers[rid].servicer, name, "w", reliability=_reliability()
+            )
+            for _ in range(per_frontend):
+                (trial,) = c.get_suggestions(1)
+                c.complete_trial(
+                    trial.id, vz.Measurement(metrics={"obj": 0.5})
+                )
+                with progress:
+                    completed[rid] += 1
+                    total = sum(completed.values())
+                if total >= kill_at and not killed.is_set():
+                    killed.set()
+                    proc.kill()  # SIGKILL: no drain, no dump, no goodbye
+
+        def wrapped(rid):
+            try:
+                client(rid)
+            except Exception as e:  # noqa: BLE001 - surfaced after join
+                errors.append(f"{rid}: {e!r}")
+
+        threads = [threading.Thread(target=wrapped, args=(rid,)) for rid in ids]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        proc.wait()
+        proc = None
+        stub_stats = {rid: stubs[rid].stats() for rid in ids}
+    finally:
+        if proc is not None:
+            proc.kill()
+            proc.wait()
+        for srv in servers.values():
+            _stop_server(srv)
+    total = sum(completed.values())
+    remote_calls = sum(s["remote_calls"] for s in stub_stats.values())
+    fallback_serves = sum(s["fallback_serves"] for s in stub_stats.values())
+    ok = (
+        not errors
+        and total == args.kill_suggests
+        and remote_calls > 0
+        and fallback_serves > 0
+    )
+    return {
+        "completed": f"{total}/{args.kill_suggests}",
+        "client_errors": errors,
+        "remote_calls_before_kill": remote_calls,
+        "fallback_serves_after_kill": fallback_serves,
+        "ok": bool(ok),
+    }
+
+
+def run_bit_identity(args) -> dict:
+    """``VIZIER_COMPUTE_TIER`` unset vs ``=0``: ``maybe_wrap_pythia`` must
+    be an identity (no stub layer at all) and the GP suggest trajectories
+    through the full service must match bit for bit."""
+
+    def run(env_value):
+        saved = os.environ.pop("VIZIER_COMPUTE_TIER", None)
+        if env_value is not None:
+            os.environ["VIZIER_COMPUTE_TIER"] = env_value
+        try:
+            srv = DefaultVizierServer(
+                serving_config=ServingConfig(
+                    batch_max_wait_ms=args.max_wait_ms,
+                    batch_max_size=args.frontends,
+                )
+            )
+            try:
+                wrapped = compute_tier.maybe_wrap_pythia(
+                    srv.pythia_servicer, replica_id="r0"
+                )
+                identity = wrapped is srv.pythia_servicer
+                srv.servicer.set_pythia(wrapped)
+                name = "owners/ab/studies/offswitch"
+                _create_and_seed(
+                    srv.servicer,
+                    name,
+                    _study_config(args.dim, args.acq_evals),
+                    args.start_trials,
+                    seed=7,
+                )
+                c = vizier_client.VizierClient(
+                    srv.servicer, name, "w", reliability=_reliability()
+                )
+                trajectory = []
+                for _ in range(args.rounds):
+                    (trial,) = c.get_suggestions(1)
+                    params = dict(trial.parameters.as_dict())
+                    trajectory.append(sorted(params.items()))
+                    c.complete_trial(
+                        trial.id, vz.Measurement(metrics={"obj": _sphere(params)})
+                    )
+                return identity, trajectory
+            finally:
+                _stop_server(srv)
+        finally:
+            if env_value is not None:
+                del os.environ["VIZIER_COMPUTE_TIER"]
+            if saved is not None:
+                os.environ["VIZIER_COMPUTE_TIER"] = saved
+
+    identity_unset, traj_unset = run(None)
+    identity_zero, traj_zero = run("0")
+    return {
+        "wrap_is_identity": bool(identity_unset and identity_zero),
+        "trajectories_match": bool(traj_unset == traj_zero),
+        "rounds": args.rounds,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--frontends", type=int, default=8)
+    parser.add_argument("--rounds", type=int, default=2)
+    parser.add_argument("--warmup-rounds", type=int, default=1)
+    # 9 completed seed trials land in the pad_trials=16 bucket; warmup plus
+    # measured rounds grow each study to <= 16, so every arm stays on one
+    # compiled program per bucket (no mid-measurement recompile).
+    parser.add_argument("--start-trials", type=int, default=9)
+    parser.add_argument("--dim", type=int, default=4)
+    parser.add_argument(
+        "--acq-evals",
+        type=int,
+        default=300,
+        help="per-study acquisition sweep budget, applied via the "
+        "gp_ucb_pe/max_acquisition_evaluations study-metadata key so BOTH "
+        "arms (and the remote compute server) share one designer cost",
+    )
+    parser.add_argument(
+        "--max-wait-ms",
+        type=float,
+        default=300.0,
+        help="batch-executor flush window in every arm; generous so the "
+        "8 frontends' concurrent suggests actually meet in one flush",
+    )
+    parser.add_argument("--kill-suggests", type=int, default=50)
+    parser.add_argument("--out", default="COMPUTE_TIER_AB.json")
+    args = parser.parse_args()
+
+    from vizier_tpu.converters import padding as padding_lib
+
+    schedule = padding_lib.DEFAULT_PADDING
+    end_trials = args.start_trials + args.warmup_rounds + args.rounds
+    if schedule.pad_trials(args.start_trials) != schedule.pad_trials(end_trials):
+        raise SystemExit(
+            f"start_trials={args.start_trials} grows to {end_trials} across "
+            "a padding-bucket boundary; shrink --rounds or move "
+            "--start-trials."
+        )
+
+    # Fast client polling: the A/B measures tier topology, not the
+    # client's long-poll cadence.
+    vizier_client.environment_variables.polling_delay_secs = 0.005
+
+    config = dict(
+        frontends=args.frontends,
+        rounds=args.rounds,
+        warmup_rounds=args.warmup_rounds,
+        start_trials=args.start_trials,
+        dim=args.dim,
+        designer="VizierGPUCBPEBandit",
+        acq_evals=args.acq_evals,
+        max_wait_ms=args.max_wait_ms,
+        kill_suggests=args.kill_suggests,
+        backend=os.environ.get("JAX_PLATFORMS", ""),
+    )
+
+    print("[compute_tier_ab] running arm: shared_tier", flush=True)
+    with tempfile.TemporaryDirectory(prefix="compute_tier_ab_") as dump_dir:
+        shared = run_shared_arm(args, dump_dir)
+    print(f"[compute_tier_ab] shared_tier: {json.dumps(shared)}", flush=True)
+
+    print("[compute_tier_ab] running arm: self_contained", flush=True)
+    self_contained = run_self_contained_arm(args)
+    print(
+        f"[compute_tier_ab] self_contained: {json.dumps(self_contained)}",
+        flush=True,
+    )
+
+    print("[compute_tier_ab] running kill phase", flush=True)
+    kill = run_kill_phase(args)
+    print(f"[compute_tier_ab] kill: {json.dumps(kill)}", flush=True)
+
+    print("[compute_tier_ab] checking VIZIER_COMPUTE_TIER=0 bit-identity",
+          flush=True)
+    bit_identity = run_bit_identity(args)
+    print(f"[compute_tier_ab] bit_identity: {json.dumps(bit_identity)}",
+          flush=True)
+
+    ratio = shared["mean_batch_occupancy"] / max(
+        self_contained["mean_batch_occupancy"], 1e-9
+    )
+    report = {
+        "config": config,
+        "shared_tier": shared,
+        "self_contained": self_contained,
+        "kill": kill,
+        "bit_identity": bit_identity,
+        "verdict": {
+            "occupancy_ratio": round(ratio, 2),
+            "meets_4x_at_8_frontends": bool(
+                ratio >= 4.0 and args.frontends >= 8
+            ),
+            "kill_completed": kill["completed"],
+            "kill_via_local_fallback": kill["ok"],
+            "compute_tier_off_bit_identical": bool(
+                bit_identity["wrap_is_identity"]
+                and bit_identity["trajectories_match"]
+            ),
+        },
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    pathlib.Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report["verdict"], indent=2))
+    ok = (
+        report["verdict"]["meets_4x_at_8_frontends"]
+        and report["verdict"]["kill_via_local_fallback"]
+        and report["verdict"]["compute_tier_off_bit_identical"]
+    )
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,18 @@ echo "== 3b4. full-stack loadgen soak (slow arm, ~20 min) =="
 #    (docs/guides/loadtest.md; render with tools/obs_report.py --soak)
 JAX_PLATFORMS=cpu python tools/soak.py --mesh-devices 2
 
+echo "== 3b6. disaggregated compute tier A/B (~1 min) =="
+#    -> COMPUTE_TIER_AB.json: 8 frontends sharing ONE real
+#    pythia_server_main subprocess vs 8 self-contained replicas on the
+#    same-bucket GP workload (target: shared batch-flush occupancy >= 4x
+#    the self-contained arm, p50/p99 both arms), a mid-run compute-server
+#    SIGKILL completing 50/50 via each frontend's local fallback, and the
+#    VIZIER_COMPUTE_TIER=0 bit-identity check (wrap identity + matching
+#    trajectories); the fleet merge attributes all 8 frontends on the
+#    remote-hop spans (docs/guides/running_the_service.md
+#    "Disaggregated compute tier")
+JAX_PLATFORMS=cpu python tools/compute_tier_ab.py
+
 echo "== 3b2. mesh-sharded batch execution A/B (~4 min) =="
 #    -> MESH_AB.json: 8 distinct concurrent shape buckets through the
 #    single-device executor vs an 8-placement mesh executor on 8

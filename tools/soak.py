@@ -102,8 +102,20 @@ def main() -> None:
                         help="override the scenario study count")
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--scale", type=float, default=None)
-    parser.add_argument("--target", choices=("inprocess", "replicas", "subprocess"),
+    parser.add_argument("--target",
+                        choices=("inprocess", "replicas", "subprocess",
+                                 "shared_compute"),
                         default=None)
+    parser.add_argument(
+        "--compute-tier",
+        action="store_true",
+        help="run the subprocess fleet behind ONE shared Pythia compute "
+        "server (the disaggregated tier): every replica_main frontend is "
+        "spawned with --compute-endpoint, so suggest traffic crosses the "
+        "remote hop and fuses in the shared batch executor. Shorthand "
+        "for --target shared_compute; the scripted event track gains "
+        "kill_compute/revive_compute events.",
+    )
     parser.add_argument(
         "--replica-mode",
         choices=("inprocess", "subprocess"),
@@ -196,6 +208,8 @@ def main() -> None:
         "target", "replicas"
     ) != "inprocess":
         overrides["target"] = "subprocess"
+    if args.compute_tier:
+        overrides["target"] = "shared_compute"
     if args.replicas:
         overrides["replicas"] = args.replicas
     if args.concurrency:
@@ -205,12 +219,15 @@ def main() -> None:
 
     base = models.smoke_config if args.smoke else models.soak_config
     config = base(**{**_env_overrides(), **overrides})
-    if config.target == "subprocess" and not args.skip_reference:
+    if (
+        config.target in ("subprocess", "shared_compute")
+        and not args.skip_reference
+    ):
         # Parity/bit-identity are waived for subprocess tiers (see
         # --replica-mode help); the sequential arms would only burn the
         # wall clock the real-process severity track needs.
         args.skip_reference = True
-        print("[soak] subprocess tier: reference/gated arms skipped "
+        print(f"[soak] {config.target} tier: reference/gated arms skipped "
               "(parity assertions waived)", flush=True)
     if args.mesh_devices:
         config = dataclasses.replace(

@@ -28,7 +28,8 @@ GROUPS_LIST=(
   "tests/loadgen"
   "tests/serving"
   "tests/observability"
-  "tests/service tests/reliability tests/distributed tests/surrogates tests/pythia tests/pyvizier"
+  "tests/service tests/reliability tests/distributed tests/surrogates tests/pythia tests/pyvizier --ignore=tests/distributed/test_compute_tier.py"
+  "tests/distributed/test_compute_tier.py"
   "tests/designers tests/algorithms tests/converters tests/models"
   "tests/benchmarks tests/pyglove tests/test_aux.py tests/test_conformance_and_surrogates.py tests/test_imports.py tests/test_round1_extras.py"
 )

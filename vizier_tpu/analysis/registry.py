@@ -227,6 +227,27 @@ SWITCHES: Tuple[EnvSwitch, ...] = (
             "Seeded network fault-injection schedule for a replica's "
             "outbound replication links (testing.netchaos spec string; "
             "'' = no injection)."),
+    # -- disaggregated compute tier (ComputeTierConfig) --------------------
+    _switch("VIZIER_COMPUTE_TIER", "flag", "ComputeTierConfig", _RUN_DOC,
+            "Disaggregated compute tier: frontends dispatch Pythia "
+            "suggest/early-stop to one shared standalone compute server "
+            "(opt-in; unset/0 = the bit-identical self-contained path).",
+            "0"),
+    _switch("VIZIER_COMPUTE_TIER_ENDPOINT", "str", "ComputeTierConfig",
+            _RUN_DOC,
+            "host:port of the shared Pythia compute server ('' with the "
+            "tier enabled behaves as tier-down: every request takes the "
+            "fallback path)."),
+    _switch("VIZIER_COMPUTE_TIER_FALLBACK", "str", "ComputeTierConfig",
+            _RUN_DOC,
+            "Degradation mode when the tier is unreachable: 'local' "
+            "serves from the frontend's own minimal Pythia; 'fail' "
+            "surfaces the transport error to the client.", "local"),
+    _switch("VIZIER_COMPUTE_TIER_HEALTH_INTERVAL_S", "float",
+            "ComputeTierConfig", _RUN_DOC,
+            "Cooldown after a compute-tier failure before a frontend "
+            "re-probes the remote endpoint (the fallback serves "
+            "meanwhile).", "1.0"),
     # -- speculative pre-compute (SpeculativeConfig) -----------------------
     _switch("VIZIER_SPECULATIVE", "flag", "SpeculativeConfig", _SRV_DOC,
             "Background pre-compute of the next suggestion batch after "

@@ -79,6 +79,15 @@ def main(argv=None) -> None:
         "fenced epoch so the fresh baseline announces it)",
     )
     parser.add_argument(
+        "--compute-endpoint",
+        default="",
+        help="host:port of a shared Pythia compute server "
+        "(distributed.pythia_server_main); arms the disaggregated "
+        "compute tier for this frontend — Pythia dispatch goes remote "
+        "with graceful local fallback. '' = $VIZIER_COMPUTE_TIER* "
+        "switches decide (default: self-contained local Pythia)",
+    )
+    parser.add_argument(
         "--shutdown-grace",
         type=float,
         default=5.0,
@@ -145,6 +154,26 @@ def main(argv=None) -> None:
     # Tag this process's request spans so a merged fleet dump stays
     # attributable even if files are renamed.
     server.servicer.replica_id = args.replica_id
+
+    # Disaggregated compute tier (opt-in): route Pythia dispatch to the
+    # shared compute server, keeping the local Pythia as the graceful
+    # degradation path. With the tier off this is a no-op and the replica
+    # is bit-identical to the self-contained topology.
+    from vizier_tpu.distributed import compute_tier as compute_tier_lib
+
+    pythia_endpoint = compute_tier_lib.maybe_wrap_pythia(
+        server.pythia_servicer,
+        replica_id=args.replica_id,
+        endpoint=args.compute_endpoint,
+    )
+    if pythia_endpoint is not server.pythia_servicer:
+        server.servicer.set_pythia(pythia_endpoint)
+        print(
+            f"[{args.replica_id}] compute tier armed: "
+            f"{pythia_endpoint.stats()['endpoint']}",
+            file=sys.stderr,
+            flush=True,
+        )
 
     if replicate:
         # Origin side: stream this replica's appends to each study's

@@ -74,6 +74,10 @@ from vizier_tpu.service.protos import replication_service_pb2 as _pb
 
 _logger = logging.getLogger(__name__)
 
+# Fleet-member id of the shared compute server (disaggregated compute
+# tier). One per fleet: the whole point is fleet-wide batch fusion.
+COMPUTE_ID = "compute-0"
+
 
 def _pick_port() -> int:
     s = socket.socket()
@@ -260,6 +264,7 @@ class SubprocessReplicaManager:
         obs_dump_dir: str = "",
         start_health_loop: bool = True,
         spawn_timeout_s: float = 60.0,
+        compute_tier: bool = False,
     ):
         self.config = config or config_lib.DistributedConfig.from_env()
         self._num_replicas = max(2, num_replicas or self.config.num_replicas)
@@ -310,20 +315,45 @@ class SubprocessReplicaManager:
             f"{rid}={rec.endpoint}" for rid, rec in self._replicas.items()
         )
 
-        # Control plane: the replication surface of every replica, with
-        # bounded transport retries and the netchaos manager-side links.
+        # Disaggregated compute tier: one shared Pythia compute server the
+        # whole fleet dispatches to (distributed.compute_tier). A fleet
+        # member for leasing/failover purposes, but it owns no studies —
+        # its "failover" is just a respawn, with frontends riding their
+        # local-Pythia fallback through the gap.
+        self._compute: Optional[_ReplicaProcess] = None
+        self._compute_restarts = 0
+        if compute_tier:
+            self._compute = _ReplicaProcess(
+                COMPUTE_ID, _pick_port(), os.path.join(wal_root, COMPUTE_ID)
+            )
+
+        # Control plane: the replication surface of every replica (plus
+        # the compute server's Heartbeat-only surface), with bounded
+        # transport retries and the netchaos manager-side links.
+        control_endpoints = {
+            rid: rec.endpoint for rid, rec in self._replicas.items()
+        }
+        if self._compute is not None:
+            control_endpoints[COMPUTE_ID] = self._compute.endpoint
         self._control = repl_service.GrpcReplicationLink(
-            {rid: rec.endpoint for rid, rec in self._replicas.items()},
+            control_endpoints,
             src_id="manager",
             netchaos=netchaos,
             connect_timeout_secs=5.0,
         )
 
+        if self._compute is not None:
+            self._spawn_compute(self._compute)
         for rid in replica_ids:
             self._spawn(self._replicas[rid], epoch=1)
-        self._await_ready(list(self._replicas.values()))
+        records = list(self._replicas.values())
+        if self._compute is not None:
+            records.append(self._compute)
+        self._await_ready(records)
         for rid in replica_ids:
             self.lease.renew(rid)
+        if self._compute is not None:
+            self.lease.renew(COMPUTE_ID)
 
         self._stub = router_stub.RoutedVizierStub(
             {
@@ -373,8 +403,29 @@ class SubprocessReplicaManager:
             "--replication-epoch",
             str(epoch),
         ]
+        if self._compute is not None:
+            args += ["--compute-endpoint", self._compute.endpoint]
         if self._obs_dump_dir:
             args += ["--obs-dump-dir", self._obs_dump_dir]
+        self._popen(rec, args)
+
+    def _spawn_compute(self, rec: _ReplicaProcess) -> None:
+        args = [
+            sys.executable,
+            "-m",
+            "vizier_tpu.distributed.pythia_server_main",
+            "--server-id",
+            rec.replica_id,
+            "--port",
+            str(rec.port),
+            "--frontends",
+            self._peers_arg,
+        ]
+        if self._obs_dump_dir:
+            args += ["--obs-dump-dir", self._obs_dump_dir]
+        self._popen(rec, args)
+
+    def _popen(self, rec: _ReplicaProcess, args: List[str]) -> None:
         os.makedirs(self._wal_root, exist_ok=True)
         log = open(rec.log_path, "ab")
         try:
@@ -460,12 +511,22 @@ class SubprocessReplicaManager:
         stats["router"] = self.router.snapshot()
         stats["replicas"] = self._stub.stats()["replicas"]
         stats["leases"] = self.lease.snapshot()
+        if self._compute is not None:
+            with self._lock:
+                restarts = self._compute_restarts
+            stats["compute_tier"] = {
+                "endpoint": self._compute.endpoint,
+                "alive": self._compute.running(),
+                "restarts": restarts,
+            }
         return stats
 
     def shutdown(self, grace_s: float = 10.0) -> None:
         self.stop_health_loop()
         with self._lock:
             records = list(self._replicas.values())
+        if self._compute is not None:
+            records.append(self._compute)
         for rec in records:
             if rec.running():
                 rec.proc.send_signal(signal.SIGTERM)
@@ -538,7 +599,35 @@ class SubprocessReplicaManager:
         for rid in candidates:
             if self.lease.expired(rid, now):
                 self._declare_dead(rid, reason="lease_expired")
+        self._check_compute_health()
         return self.router.snapshot()
+
+    def _check_compute_health(self) -> None:
+        """Compute-server arm of the sweep: renew its lease, and respawn
+        it on expiry. No studies live there, so its failover IS the
+        respawn — frontends serve from their local fallback in between."""
+        if self._compute is None:
+            return
+        try:
+            self._control.call_once(
+                COMPUTE_ID, "Heartbeat", _pb.HeartbeatRequest(sender="manager")
+            )
+        except Exception:
+            pass  # no renewal; the lease keeps draining
+        else:
+            self.lease.renew(COMPUTE_ID)
+            return
+        if self.lease.expired(COMPUTE_ID):
+            recorder_lib.get_recorder().record(
+                None,
+                "replica_declared_dead",
+                replica=COMPUTE_ID,
+                reason="lease_expired",
+            )
+            try:
+                self.revive_compute_server()
+            except Exception as e:  # next sweep retries
+                _logger.warning("Compute-server respawn failed: %s", e)
 
     def _on_endpoint_failure(self, replica_id: str, error: BaseException) -> None:
         """Routed-stub failure hook. A transport fault alone is NOT death
@@ -614,6 +703,62 @@ class SubprocessReplicaManager:
                 pass
         recorder_lib.get_recorder().record(
             None, "replica_killed", replica=replica_id
+        )
+
+    def has_compute_tier(self) -> bool:
+        return self._compute is not None
+
+    def compute_endpoint(self) -> str:
+        if self._compute is None:
+            raise RuntimeError("This fleet has no compute tier.")
+        return self._compute.endpoint
+
+    def compute_is_alive(self) -> bool:
+        return self._compute is not None and self._compute.running()
+
+    def kill_compute_server(self) -> None:
+        """SIGKILLs the shared compute server (a real crash). Frontends
+        degrade to their local Pythia; the health loop (or an explicit
+        :meth:`revive_compute_server`) brings the tier back."""
+        if self._compute is None:
+            raise RuntimeError("This fleet has no compute tier.")
+        rec = self._compute
+        if rec.running():
+            rec.proc.kill()
+            try:
+                rec.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
+        recorder_lib.get_recorder().record(
+            None, "replica_killed", replica=COMPUTE_ID
+        )
+
+    def revive_compute_server(self) -> None:
+        """Respawns the compute server on its old port (idempotent: a
+        running server is left alone). No fencing and no copy-back — the
+        tier is stateless from the fleet's point of view; the shared
+        designer cache simply re-warms."""
+        if self._compute is None:
+            raise RuntimeError("This fleet has no compute tier.")
+        rec = self._compute
+        with self._failover_lock:
+            if rec.running():
+                return
+            self._spawn_compute(rec)
+            self._await_ready([rec])
+            # Evict the manager-side channel stuck in reconnect backoff;
+            # each FRONTEND evicts its own channel via the RemotePythiaStub
+            # cooldown/reconnect path — close_channel here only fixes this
+            # process's cache.
+            from vizier_tpu.service import grpc_stubs
+
+            grpc_stubs.close_channel(rec.endpoint)
+            self._control.set_endpoint(COMPUTE_ID, rec.endpoint)
+            self.lease.renew(COMPUTE_ID)
+            with self._lock:
+                self._compute_restarts += 1
+        recorder_lib.get_recorder().record(
+            None, "replica_revive", replica=COMPUTE_ID, was_failed_over=False
         )
 
     def partition_replica(self, replica_id: str) -> None:

@@ -444,15 +444,24 @@ class _SubprocessTarget:
 
     supports_replicas = True
     replication_active = True
+    supports_compute_tier = False
 
-    def __init__(self, scenario: models.Scenario, reliability, factory):
+    def __init__(
+        self,
+        scenario: models.Scenario,
+        reliability,
+        factory,
+        compute_tier: bool = False,
+    ):
         from vizier_tpu.distributed import subprocess_fleet
 
         del reliability  # replicas configure their own from the env
         del factory  # subprocess replicas build their own policy factory
         self.wal_root = tempfile.mkdtemp(prefix="vizier-loadgen-subproc-")
         self._manager = subprocess_fleet.SubprocessReplicaManager(
-            scenario.config.replicas, wal_root=self.wal_root
+            scenario.config.replicas,
+            wal_root=self.wal_root,
+            compute_tier=compute_tier,
         )
         self.runtime = _DetachedRuntime()
 
@@ -488,11 +497,36 @@ class _SubprocessTarget:
         self._manager.shutdown()
 
 
+class _SharedComputeTarget(_SubprocessTarget):
+    """The subprocess fleet PLUS one shared Pythia compute server: every
+    frontend replica is spawned with ``--compute-endpoint`` pointed at the
+    tier, so their Suggest/EarlyStop traffic crosses the remote hop and
+    fuses in ONE batch executor. Killing the compute server must lose
+    zero studies — frontends degrade to their local minimal Pythia until
+    the manager's health loop (or a scripted revive event) restarts it."""
+
+    supports_compute_tier = True
+
+    def __init__(self, scenario: models.Scenario, reliability, factory):
+        super().__init__(scenario, reliability, factory, compute_tier=True)
+
+    def compute_is_alive(self) -> bool:
+        return self._manager.compute_is_alive()
+
+    def kill_compute_server(self) -> None:
+        self._manager.kill_compute_server()
+
+    def revive_compute_server(self) -> None:
+        self._manager.revive_compute_server()
+
+
 def _build_target(scenario, reliability, factory):
     if scenario.config.target == "replicas":
         return _ReplicaTarget(scenario, reliability, factory)
     if scenario.config.target == "subprocess":
         return _SubprocessTarget(scenario, reliability, factory)
+    if scenario.config.target == "shared_compute":
+        return _SharedComputeTarget(scenario, reliability, factory)
     return _InProcessTarget(scenario, reliability, factory)
 
 
@@ -698,6 +732,18 @@ class _EventEngine:
                         restarted.append(replica)
                     record["revived_first"] = dead
                     record["restarted"] = restarted
+            elif event.kind == "kill_compute":
+                if not getattr(self._target, "supports_compute_tier", False):
+                    record["skipped"] = "no compute tier"
+                else:
+                    self._target.kill_compute_server()
+                    record["compute_alive"] = self._target.compute_is_alive()
+            elif event.kind == "revive_compute":
+                if not getattr(self._target, "supports_compute_tier", False):
+                    record["skipped"] = "no compute tier"
+                else:
+                    self._target.revive_compute_server()
+                    record["compute_alive"] = self._target.compute_is_alive()
             elif event.kind == "wal_corrupt":
                 replica = self._resolve_replica(event.arg, event.kind)
                 record["replica"] = replica

@@ -80,12 +80,19 @@ def owner_tenant(owner: str) -> str:
         return owner[len(TENANT_OWNER_PREFIX):]
     return owner
 
-_TARGETS = ("inprocess", "replicas", "subprocess")
+_TARGETS = ("inprocess", "replicas", "subprocess", "shared_compute")
 _EVENT_KINDS = (
     "kill_replica",
     "revive_replica",
     "chaos_on",
     "chaos_off",
+    # Disaggregated compute tier (target "shared_compute"):
+    # kill_compute — SIGKILL the shared Pythia compute server; frontends
+    #   must ride their local-Pythia fallback with zero lost studies.
+    # revive_compute — respawn it (idempotent: the manager's health loop
+    #   may already have brought it back).
+    "kill_compute",
+    "revive_compute",
     # Severity track (replica tiers with >= 3 replicas):
     # multi_kill — kill N replicas SIMULTANEOUSLY (arg = N, default 2);
     #   the fleet must fail all of them over in one sweep with zero lost
@@ -554,6 +561,16 @@ def default_event_track(
         )
         events.append(
             EventSpec(max(2, int(total_trials * 0.70)), "revive_replica", "owner:0")
+        )
+    if config.target == "shared_compute":
+        # The tier's own severity arc: crash the shared compute server
+        # mid-run (frontends degrade to local Pythia, zero lost studies),
+        # then bring it back under live traffic.
+        events.append(
+            EventSpec(max(1, int(total_trials * 0.40)), "kill_compute")
+        )
+        events.append(
+            EventSpec(max(2, int(total_trials * 0.70)), "revive_compute")
         )
     return tuple(sorted(events, key=lambda e: (e.at_completed, e.kind)))
 

@@ -341,7 +341,7 @@ def build_report(
         )
 
     if config.planes.slo:
-        if config.target == "subprocess":
+        if config.target in ("subprocess", "shared_compute"):
             # Each replica process runs its own SLO engine (armed by the
             # inherited env overlay) and dumps it via --obs-dump-dir; the
             # driver has no in-process engine to read, so the roll-up
@@ -392,7 +392,7 @@ def build_report(
     # against the in-process reference is structurally meaningless there
     # and is WAIVED (recorded, not silently passed) — the in-process arms
     # carry the parity/bit-identity evidence for the same code paths.
-    parity_waived = config.target == "subprocess"
+    parity_waived = config.target in ("subprocess", "shared_compute")
 
     parity = None
     if parity_waived:

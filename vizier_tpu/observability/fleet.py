@@ -195,6 +195,52 @@ def slo_series(metrics_snapshot: dict) -> Dict[str, Any]:
     return out
 
 
+# Frontend-side spans of the remote Pythia hop (distributed.compute_tier
+# stamps frontend=<replica_id> on these, so a merged dump can attribute
+# fan-in per frontend).
+_COMPUTE_TIER_SPANS = (
+    "compute_tier.remote_suggest",
+    "compute_tier.remote_early_stop",
+)
+
+
+def compute_tier_section(
+    merged: List[dict], metrics: Dict[str, dict]
+) -> Dict[str, Any]:
+    """The disaggregated-compute view of a merged dump: which frontends
+    crossed the remote Pythia hop (fan-in), and the compute server's
+    batch-flush occupancy — the number the tier exists to raise (N
+    frontends' same-bucket suggests fusing into one vmapped flush)."""
+    per_frontend: Dict[str, int] = {}
+    remote_spans = 0
+    for span in merged:
+        if span.get("name") not in _COMPUTE_TIER_SPANS:
+            continue
+        remote_spans += 1
+        frontend = (span.get("attributes") or {}).get("frontend") or span.get(
+            "source", ""
+        )
+        per_frontend[frontend] = per_frontend.get(frontend, 0) + 1
+    occupancy: Dict[str, float] = {}
+    for source, snapshot in sorted(metrics.items()):
+        family = snapshot.get("vizier_batch_occupancy")
+        if not isinstance(family, dict):
+            continue
+        total = count = 0.0
+        for series in (family.get("series") or {}).values():
+            total += float(series.get("sum", 0.0))
+            count += float(series.get("count", 0.0))
+        if count > 0:
+            occupancy[source] = round(total / count, 3)
+    return {
+        "remote_spans": remote_spans,
+        "frontends": sorted(per_frontend),
+        "fan_in": len(per_frontend),
+        "per_frontend": dict(sorted(per_frontend.items())),
+        "batch_occupancy": occupancy,
+    }
+
+
 def fleet_report(dump_dir: str) -> Dict[str, Any]:
     """The merged fleet view of one dump directory (JSON-ready)."""
     loaded = load_fleet_dir(dump_dir)
@@ -214,6 +260,7 @@ def fleet_report(dump_dir: str) -> Dict[str, Any]:
         "cross_replica_examples": crossing[:10],
         "failover_timeline": failover_timeline(loaded["recorder"]),
         "slo": slo,
+        "compute_tier": compute_tier_section(merged, loaded["metrics"]),
     }
 
 
@@ -255,4 +302,16 @@ def render_fleet_report(report: Dict[str, Any]) -> str:
         lines.append("failover timeline: (no events)")
     if report["slo"]:
         lines.append("slo gauges: " + ", ".join(sorted(report["slo"])))
+    tier = report.get("compute_tier") or {}
+    if tier.get("remote_spans"):
+        occupancy = tier.get("batch_occupancy") or {}
+        occ_note = (
+            "; ".join(f"{src} occupancy {val}" for src, val in occupancy.items())
+            or "no occupancy histograms"
+        )
+        lines.append(
+            f"compute tier: {tier['remote_spans']} remote hops from "
+            f"{tier['fan_in']} frontend(s) "
+            f"({', '.join(tier['frontends'])}); {occ_note}"
+        )
     return "\n".join(lines)

@@ -16,6 +16,33 @@ from vizier_tpu.pythia import policy_supporter as supporter_lib
 _ALLOWED_BUDGET_POLICIES = ("first_pick_full", "per_batch", "per_pick")
 
 
+def _validated_acq_evals(problem_statement) -> int:
+    """Study-metadata acquisition-sweep budget (0 = designer default).
+
+    Namespace ``gp_ucb_pe``, key ``max_acquisition_evaluations``: like
+    ``acquisition_budget_policy`` this is the remote client's only path
+    to a designer kwarg — the value travels inside the StudySpec, so a
+    shared compute server applies the requesting study's budget without
+    any per-process configuration. Raises on non-integer or negative
+    values so a typo surfaces on the first suggest.
+    """
+    ns = problem_statement.metadata.ns("gp_ucb_pe")
+    raw = ns.get("max_acquisition_evaluations")
+    if raw is None:
+        return 0
+    try:
+        evals = int(raw)
+    except (TypeError, ValueError):
+        evals = -1
+    if evals < 0:
+        raise ValueError(
+            "Invalid study metadata ns 'gp_ucb_pe' key "
+            f"'max_acquisition_evaluations': {raw!r}. "
+            "Expected a non-negative integer (0 = designer default)."
+        )
+    return evals
+
+
 class DefaultPolicyFactory:
     """Maps well-known algorithm names to policies.
 
@@ -94,6 +121,7 @@ class DefaultPolicyFactory:
                     f"'acquisition_budget_policy': {requested_policy!r}. "
                     f"Allowed values: {', '.join(_ALLOWED_BUDGET_POLICIES)}."
                 )
+            _validated_acq_evals(problem_statement)
             try:
                 from vizier_tpu.designers import gp_ucb_pe
 
@@ -111,6 +139,13 @@ class DefaultPolicyFactory:
                     )
                     if requested:
                         kwargs["acquisition_budget_policy"] = requested
+                    # Same remote-client contract for the acquisition
+                    # sweep size: the key rides the StudySpec through the
+                    # Pythia surface, so a disaggregated compute server
+                    # honors it with no out-of-band configuration.
+                    evals = _validated_acq_evals(p)
+                    if evals:
+                        kwargs["max_acquisition_evaluations"] = evals
                     return gp_ucb_pe.VizierGPUCBPEBandit(p, **kwargs)
 
             except ImportError:  # pragma: no cover - transitional fallback

@@ -10,6 +10,7 @@ by design, unlike the reference's ``jax_enable_x64`` at ``:50-57``.)
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 import time
 import traceback
@@ -69,13 +70,14 @@ class PythiaServicer:
         self._policy_factory = policy_factory or policy_factory_lib.DefaultPolicyFactory(
             serving_runtime=self._serving
         )
-        # Cache for policies that declare should_be_cached.
+        # Cache for policies that declare should_be_cached, keyed by
+        # (study_name, algorithm, config_hash).
         self._policy_cache = {}
-        # study_name -> (serialized StudySpec, parsed StudyConfig). The
-        # spec is immutable after creation except metadata, and the bytes
-        # equality check catches exactly those updates — so the hot path
+        # study_name -> (config hash, parsed StudyConfig). The hash (over
+        # the serialized StudySpec) catches metadata updates AND the
+        # shared-compute-tier delete/recreate turnover — so the hot path
         # skips a full Python proto->pyvizier parse per suggest without
-        # ever serving a stale search space.
+        # ever serving a stale search space (see _parsed_study_config).
         self._config_cache = {}
         # Early-stopping policies cached per study (regression rule holds a
         # trained GBM; see EarlyStop dispatch).
@@ -170,31 +172,68 @@ class PythiaServicer:
             del self._policy_cache[key]
 
     def _parsed_study_config(self, request) -> vz.StudyConfig:
-        """The request's StudyConfig, cached per study by spec bytes."""
+        """The request's StudyConfig, cached by (study name, config hash).
+
+        The hash (over the serialized StudySpec) is the cache's identity
+        check AND the shared compute tier's staleness detector: against
+        one shared Pythia, two frontends racing ``DeleteStudy``/
+        ``CreateStudy`` for the same resource name have no invalidation
+        RPC to this process, so a hash TURNOVER is the only signal that
+        the name now means a different study. On turnover every per-study
+        cache pinned to the previous incarnation is dropped — the parsed
+        config, the policy cache, the stopping policies, and (through the
+        runtime) the designer-state cache.
+        """
         spec = request.study_descriptor.config
         spec_bytes = spec.SerializeToString()
-        cached = self._config_cache.get(request.study_name)
-        if cached is not None and cached[0] == spec_bytes:
+        config_hash = hashlib.sha1(spec_bytes).hexdigest()[:16]
+        study_name = request.study_name
+        cached = self._config_cache.get(study_name)
+        if cached is not None and cached[0] == config_hash:
             return cached[1]
+        if cached is not None:
+            # Same resource name, different config bytes: a delete/
+            # recreate (or a metadata update, which can change policy
+            # construction — e.g. the acquisition-budget override) from
+            # ANY frontend. Drop state keyed to the stale incarnation.
+            self._stopping_policies.pop(study_name, None)
+            for key in [k for k in self._policy_cache if k[0] == study_name]:
+                del self._policy_cache[key]
         config = pc.study_config_from_proto(spec)
-        if request.study_name:
-            self._config_cache[request.study_name] = (spec_bytes, config)
+        if study_name:
+            self._config_cache[study_name] = (config_hash, config)
+            self._serving.note_study_config(study_name, config_hash)
         return config
 
+    def _request_config_hash(self, request) -> str:
+        """The request's own config hash — NOT a read-back from the parse
+        cache: two frontends racing different incarnations of one study
+        name interleave freely here, and keying a policy by the OTHER
+        request's hash would serve incarnation A under B's key."""
+        spec_bytes = request.study_descriptor.config.SerializeToString()
+        return hashlib.sha1(spec_bytes).hexdigest()[:16]
+
     def _get_policy(
-        self, study_config: vz.StudyConfig, algorithm: str, study_name: str
+        self,
+        study_config: vz.StudyConfig,
+        algorithm: str,
+        study_name: str,
+        config_hash: str = "",
     ) -> policy_lib.Policy:
         supporter = service_policy_supporter.ServicePolicySupporter(
             study_name, self._vizier
         )
-        cached = self._policy_cache.get((study_name, algorithm))
+        # Keyed by (study, algorithm, config hash): a cached policy must
+        # die with the config incarnation it was constructed from.
+        key = (study_name, algorithm, config_hash)
+        cached = self._policy_cache.get(key)
         if cached is not None:
             return cached
         policy = self._policy_factory(
             study_config.to_problem(), algorithm, supporter, study_name
         )
         if policy.should_be_cached:
-            self._policy_cache[(study_name, algorithm)] = policy
+            self._policy_cache[key] = policy
         return policy
 
     def Suggest(
@@ -230,12 +269,16 @@ class PythiaServicer:
         if not self._serving.config.coalescing:
             return self._suggest_compute(request)
         # Compute-level request coalescing: concurrent suggests against the
-        # SAME study state (name, algorithm, trial frontier, count) collapse
-        # onto one designer computation; followers receive their own copy of
-        # the response (protos are mutable and cross servicer threads).
+        # SAME study state (name, config incarnation, algorithm, trial
+        # frontier, count) collapse onto one designer computation;
+        # followers receive their own copy of the response (protos are
+        # mutable and cross servicer threads). The config hash keeps two
+        # frontends racing a delete/recreate of one study name from
+        # coalescing onto the OTHER incarnation's computation.
         key = (
             "suggest",
             request.study_name,
+            self._request_config_hash(request),
             request.algorithm,
             int(request.study_descriptor.max_trial_id),
             int(request.count),
@@ -504,7 +547,12 @@ class PythiaServicer:
                 # a per-request algorithm override goes on a shallow copy so
                 # it never leaks into later requests for the same study.
                 config = dataclasses.replace(config, algorithm=algorithm)
-            policy = self._get_policy(config, algorithm, request.study_name)
+            policy = self._get_policy(
+                config,
+                algorithm,
+                request.study_name,
+                self._request_config_hash(request),
+            )
             descriptor = vz.StudyDescriptor(
                 config=config,
                 guid=request.study_descriptor.guid,
@@ -653,7 +701,11 @@ class PythiaServicer:
     ) -> pythia_service_pb2.PythiaEarlyStopResponse:
         response = pythia_service_pb2.PythiaEarlyStopResponse()
         try:
-            config = pc.study_config_from_proto(request.study_descriptor.config)
+            # Through the parse cache (not a fresh proto->pyvizier parse):
+            # EarlyStop polls ride the same (study, config-hash) identity
+            # as Suggest, so a delete/recreate turnover also drops the
+            # cached stopping policies below.
+            config = self._parsed_study_config(request)
             if config.automated_stopping_config is not None:
                 # Studies with a stopping spec pick their rule (median curve
                 # or curve-regression); otherwise the algorithm's own policy
@@ -683,7 +735,10 @@ class PythiaServicer:
                     )
             else:
                 policy = self._get_policy(
-                    config, request.algorithm or config.algorithm, request.study_name
+                    config,
+                    request.algorithm or config.algorithm,
+                    request.study_name,
+                    self._request_config_hash(request),
                 )
             descriptor = vz.StudyDescriptor(
                 config=config,

@@ -311,6 +311,30 @@ class ServingRuntime:
         self.flight_recorder.invalidate(study_name)
         return self.designer_cache.invalidate(study_name)
 
+    def note_study_config(self, study_name: str, config_hash: str) -> bool:
+        """Pins per-study serving state to one StudyConfig incarnation.
+
+        Called by the servicer with every request's parsed-config hash.
+        On a hash turnover — the shared-compute-tier delete/recreate race,
+        where another frontend's ``DeleteStudy`` invalidation cannot reach
+        this process — everything TRAINED against the previous incarnation
+        (designer entry, breaker, speculative slot) is dropped so it is
+        never served again. The flight-recorder ring survives: it is
+        forensic history keyed by time, not derived state, and a metadata
+        update (a legitimate hash turnover — e.g. the budget-policy knobs
+        ride metadata) must not erase the study's earlier events. Returns
+        True when a turnover was detected.
+        """
+        changed = self.designer_cache.note_config_hash(study_name, config_hash)
+        if changed:
+            # note_config_hash already dropped the designer entry itself.
+            self.breakers.invalidate(study_name)
+            if self.speculative_engine is not None:
+                self.speculative_engine.invalidate(
+                    study_name, reason="config_turnover"
+                )
+        return changed
+
     def speculative_invalidate(self, study_name: str, reason: str = "") -> None:
         """Drops only the study's speculative slot/job (frontier surgery,
         surrogate crossover); the designer entry itself stays live."""

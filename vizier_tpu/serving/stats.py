@@ -31,6 +31,9 @@ class ServingStats:
         "cache_evictions_ttl",
         "cache_evictions_lru",
         "cache_invalidations",
+        # Config-hash turnover drops (shared compute tier: a frontend's
+        # delete/recreate detected via the request's StudySpec hash).
+        "cache_invalidations_config",
         "coalesced_requests",  # followers served from a shared computation
         "coalesced_computations",  # leader runs that had >= 1 follower
         "warm_trains",
